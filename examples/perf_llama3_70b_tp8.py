@@ -1,0 +1,28 @@
+"""Llama-3-70B tp8 (+selective recompute) on TPU v5p — the TP/SP
+allreduce+allgather costing path (north-star config 2)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_strategy_config
+
+
+def main():
+    st = get_strategy_config("tp8_pp1_dp1_mbs1")
+    st.world_size = 64
+    st.enable_recompute = True
+    st.recompute_granularity = "selective_recompute"
+    st.attn_recompute = True
+    st.mlp_recompute = True
+    st.__post_init__()
+    perf = PerfLLM()
+    perf.configure(strategy=st, model="llama3-70b", system="tpu_v5p_256")
+    perf.run_estimate()
+    return perf.analysis()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Estimate a Llama-3-8B tp1/pp2/dp4 training step on a TPU v5e-256 slice.
+
+Mirrors the reference's canonical example
+(``examples/perf_llama3_8b_tp1_pp2.py:17-29``): configure -> run_estimate
+-> analysis.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+
+
+def main():
+    perf = PerfLLM()
+    perf.configure(
+        strategy="tp1_pp2_dp4_mbs1",
+        model="llama3-8b",
+        system="tpu_v5e_256",
+    )
+    perf.run_estimate()
+    result = perf.analysis(save_path=os.environ.get("SIMU_SAVE_PATH"))
+    return result
+
+
+if __name__ == "__main__":
+    main()

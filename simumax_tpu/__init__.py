@@ -1,0 +1,24 @@
+"""simumax_tpu — a TPU-native static analytical simulator for LLM
+distributed training.
+
+Given three JSON configs (model architecture, parallelism strategy, TPU
+system description) it predicts iteration time, MFU, throughput and
+per-stage peak HBM without running a training job, via an analytical
+roofline + pipeline cost model and a discrete-event multi-rank simulator.
+
+Capability parity target: MooreThreads/SimuMax (see SURVEY.md), re-designed
+TPU-first: ICI-torus/DCN mesh-aware collective costing, XLA operator
+efficiency tables, JAX self-calibration.
+"""
+
+from simumax_tpu.version import __version__
+from simumax_tpu.core.config import ModelConfig, StrategyConfig, SystemConfig
+from simumax_tpu.perf import PerfLLM
+
+__all__ = [
+    "__version__",
+    "ModelConfig",
+    "StrategyConfig",
+    "SystemConfig",
+    "PerfLLM",
+]

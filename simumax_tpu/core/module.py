@@ -1,0 +1,343 @@
+"""MetaModule framework (L2): the "nn.Module of the simulator".
+
+Reference: ``simumax/core/base_struct.py:233-1204`` (``MetaModule`` child
+auto-registration, ``__call__`` protocol, ``_comp_leaf_*`` template
+methods, recompute segment marking, hooks, annotated ``__repr__``).
+
+Redesign notes (TPU-first):
+* collectives are declared by leaves as :class:`CollectiveCall` records on
+  a named parallel dim; the framework costs them over the dim's
+  :class:`CommPath` (ICI torus spans / DCN) — there is no per-leaf NCCL
+  plumbing;
+* the same declarations later drive the discrete-event simulator, so leaf
+  ops carry no job-construction code of their own.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+from simumax_tpu.core.config import ModelConfig, StrategyConfig, SystemConfig
+from simumax_tpu.core.records import (
+    ActivationInfo,
+    CollectiveCall,
+    ComputeInfo,
+    CostInfo,
+    ParamInfo,
+    PathDebugContext,
+    RecomputeStatus,
+)
+from simumax_tpu.core.tensor import TensorSpec
+
+TensorOrTuple = Union[TensorSpec, Tuple[TensorSpec, ...]]
+
+
+class BuildContext:
+    """Everything a module needs to cost itself: the three configs plus the
+    mesh placement of every parallel dim (built by ``PerfLLM.analysis_net``,
+    reference ``perf_llm.py:369-474``)."""
+
+    def __init__(
+        self,
+        strategy: StrategyConfig,
+        model: ModelConfig,
+        system: SystemConfig,
+        paths: Optional[Dict[str, object]] = None,
+    ):
+        self.strategy = strategy
+        self.model = model
+        self.system = system
+        self.paths = paths or {}
+        self.debug = PathDebugContext()
+
+    def path(self, dim: str):
+        if dim not in self.paths:
+            raise KeyError(f"no CommPath placed for dim {dim!r}")
+        return self.paths[dim]
+
+
+class MetaModule:
+    """Base symbolic module. Subclasses either override :meth:`forward`
+    (composites — children are auto-registered on attribute assignment) or
+    the leaf template methods (ops)."""
+
+    is_leaf = False
+
+    def __init__(self, ctx: BuildContext, name: str = ""):
+        self.ctx = ctx
+        self.name = name or type(self).__name__
+        self._children: List[Tuple[str, "MetaModule"]] = []
+        self.parent: Optional["MetaModule"] = None
+        # recompute wiring
+        self.recompute = False  # whole-subtree checkpoint flag
+        self.recompute_status = RecomputeStatus.NONE
+        self.in_recompute = False
+        # filled by __call__
+        self.inputs: Tuple[TensorSpec, ...] = ()
+        self.outputs: Tuple[TensorSpec, ...] = ()
+        self.compute_info = ComputeInfo()
+        self.act_info = ActivationInfo()
+        self.raw_act_info = ActivationInfo()
+        self.param_info = ParamInfo()
+        self.cost_info = CostInfo()
+        self.collective_calls: List[CollectiveCall] = []
+        self._called = False
+        self._pre_hooks: List[Callable] = []
+        self._post_hooks: List[Callable] = []
+
+    # -- structure ---------------------------------------------------------
+    _NON_CHILD_ATTRS = ("parent", "recompute_segment")
+
+    def __setattr__(self, key, value):
+        if isinstance(value, MetaModule) and key not in self._NON_CHILD_ATTRS:
+            value.parent = self
+            if not value.name or value.name == type(value).__name__:
+                value.name = key
+            children = self.__dict__.setdefault("_children", [])
+            children.append((key, value))
+        super().__setattr__(key, value)
+
+    def add_child(self, name: str, module: "MetaModule") -> "MetaModule":
+        module.parent = self
+        module.name = name
+        self._children.append((name, module))
+        return module
+
+    def children(self) -> Iterator["MetaModule"]:
+        for _, c in self._children:
+            yield c
+
+    def leaves(self) -> Iterator["MetaModule"]:
+        if self.is_leaf:
+            yield self
+        else:
+            for c in self.children():
+                yield from c.leaves()
+
+    def called_leaves(self) -> List["MetaModule"]:
+        """Leaves in actual forward call order."""
+        return [l for l in self.leaves() if l._called]
+
+    def path_name(self) -> str:
+        parts = [self.name]
+        p = self.parent
+        while p is not None:
+            parts.append(p.name)
+            p = p.parent
+        return ".".join(reversed(parts))
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, fn: Callable):
+        self._pre_hooks.append(fn)
+
+    def register_forward_hook(self, fn: Callable):
+        self._post_hooks.append(fn)
+
+    # -- call protocol -----------------------------------------------------
+    def __call__(self, *ins: TensorSpec) -> TensorOrTuple:
+        for h in self._pre_hooks:
+            h(self, ins)
+        self.inputs = tuple(i for i in ins if isinstance(i, TensorSpec))
+        if self.is_leaf:
+            outs = self.forward_spec(*ins)
+            self.outputs = outs if isinstance(outs, tuple) else (outs,)
+            self._comp_leaf_info()
+        else:
+            outs = self.forward(*ins)
+            self.outputs = outs if isinstance(outs, tuple) else (outs,)
+            self._aggregate()
+        self._called = True
+        for h in self._post_hooks:
+            h(self, ins, outs)
+        self.ctx.debug.record(self.path_name(), self.cost_info, self.compute_info)
+        return outs
+
+    # -- composite default -------------------------------------------------
+    def forward(self, x: TensorSpec) -> TensorSpec:
+        for c in self.children():
+            x = c(x)
+        return x
+
+    def _aggregate(self):
+        kids = [c for c in self.children() if c._called]
+        self.compute_info = sum((c.compute_info for c in kids), ComputeInfo())
+        self.act_info = sum((c.act_info for c in kids), ActivationInfo())
+        self.raw_act_info = sum((c.raw_act_info for c in kids), ActivationInfo())
+        self.param_info = sum((c.param_info for c in kids), ParamInfo())
+        self.cost_info = sum((c.cost_info for c in kids), CostInfo())
+        self.collective_calls = [cc for c in kids for cc in c.collective_calls]
+        if self.inputs:
+            self.act_info.input_bytes = sum(t.bytes for t in self.inputs)
+        if self.outputs:
+            self.act_info.output_bytes = sum(t.bytes for t in self.outputs)
+
+    # -- leaf template methods (override in ops) ---------------------------
+    def forward_spec(self, *ins: TensorSpec) -> TensorOrTuple:
+        raise NotImplementedError
+
+    def op_flops(self) -> Dict[str, float]:
+        return {}
+
+    def op_accessed(self) -> Dict[str, float]:
+        return {}
+
+    def bw_key(self, phase: str) -> str:  # HBM bandwidth class per phase
+        return "default"
+
+    def comp_key(self, phase: str) -> Tuple[str, Optional[str]]:
+        """(op efficiency table, canonical shape key) for this phase."""
+        return ("default", None)
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo()
+
+    def extra_param_info(self) -> ParamInfo:
+        return ParamInfo()
+
+    def collectives(self) -> List[CollectiveCall]:
+        return []
+
+    # -- parameter accounting helper ---------------------------------------
+    def make_param_info(self, numel: float, is_moe: bool = False) -> ParamInfo:
+        """Standard Megatron mixed-precision Adam accounting:
+        bf16 weight + fp32 main grad (``use_fp32_accum_grad``) + optimizer
+        state (fp32 master + 2 moments) sharded over dp*cp (edp for MoE
+        params) under ZeRO-1 (reference e.g. ``dense_module.py:448-454``).
+        """
+        st = self.ctx.strategy
+        if numel <= 0:
+            return ParamInfo()
+        w = numel * st.element_size
+        g = numel * st.grad_element_size
+        state = numel * 12.0  # fp32 master + exp_avg + exp_avg_sq
+        shard = st.edp_size if is_moe else st.dp_size * st.cp_size
+        if st.zero_state >= 1:
+            state = state / max(1, shard)
+        if is_moe:
+            return ParamInfo(
+                moe_weight_bytes=w, moe_grad_bytes=g, moe_state_bytes=state,
+                moe_numel=numel,
+            )
+        return ParamInfo(
+            weight_bytes=w, grad_bytes=g, state_bytes=state, dense_numel=numel
+        )
+
+    # -- leaf accounting ----------------------------------------------------
+    def _comp_leaf_info(self):
+        sysc: SystemConfig = self.ctx.system
+        flops = self.op_flops()
+        accessed = self.op_accessed()
+        self.compute_info = ComputeInfo(
+            fwd_flops=flops.get("fwd", 0.0),
+            bwd_act_flops=flops.get("bwd_act", 0.0),
+            bwd_w_flops=flops.get("bwd_w", 0.0),
+            fwd_accessed=accessed.get("fwd", 0.0),
+            bwd_act_accessed=accessed.get("bwd_act", 0.0),
+            bwd_w_accessed=accessed.get("bwd_w", 0.0),
+        )
+        self.param_info = self.extra_param_info()
+        info = self.activation_info()
+        info.input_bytes = sum(t.bytes for t in self.inputs)
+        info.output_bytes = sum(t.bytes for t in self.outputs)
+        self.raw_act_info = info
+        self.act_info = ActivationInfo(**vars(info))
+        self.collective_calls = list(self.collectives())
+
+        cost = CostInfo()
+        for phase in ("fwd", "bwd_act", "bwd_w"):
+            f = getattr(self.compute_info, f"{phase}_flops")
+            b = getattr(self.compute_info, f"{phase}_accessed")
+            if f <= 0 and b <= 0:
+                continue
+            op_key, shape_key = self.comp_key(phase)
+            comp_t = sysc.compute_op_accuracy_time(op_key, f, shape_key)
+            mem_t = sysc.compute_mem_access_time(b, self.bw_key(phase)) if b > 0 else 0.0
+            cost.compute.add(phase, sysc.compute_end2end_time(comp_t, mem_t))
+        for call in self.collective_calls:
+            path = self.ctx.path(call.dim)
+            call.time = sysc.compute_net_op_time(call.op, call.size_bytes, path)
+            if call.exposed:
+                cost.net_exposed.add(call.phase, call.time)
+            else:
+                cost.net_hidden.add(call.phase, call.time)
+        # recompute: the fwd work is replayed before bwd_act
+        if self.in_recompute:
+            cost.recompute_time = cost.compute.fwd + cost.net_exposed.fwd
+            # effective steady-state cache: only the segment input survives
+            self.act_info.cache_bytes = 0.0
+            if self.recompute_status == RecomputeStatus.FIRST:
+                self.act_info.cache_bytes = self.act_info.input_bytes
+        self.cost_info = cost
+
+    # -- recompute marking (reference ``base_struct.py:499-529``) ----------
+    def mark_recompute(self):
+        """Mark this subtree as one checkpointed segment."""
+        self.recompute = True
+        leaves = list(self.leaves())
+        for i, leaf in enumerate(leaves):
+            leaf.in_recompute = True
+            leaf.recompute_segment = self
+            if i == 0:
+                leaf.recompute_status = RecomputeStatus.FIRST
+            elif i == len(leaves) - 1:
+                leaf.recompute_status = RecomputeStatus.LAST
+            else:
+                leaf.recompute_status = RecomputeStatus.MIDDLE
+
+    # -- repr ---------------------------------------------------------------
+    def __repr__(self):
+        lines = [self._repr_line()]
+        for _, c in self._children:
+            child_repr = repr(c)
+            lines.extend("  " + l for l in child_repr.splitlines())
+        return "\n".join(lines)
+
+    def _repr_line(self):
+        extra = ""
+        if self._called:
+            extra = (
+                f" fwd={self.cost_info.fwd_time*1e3:.3f}ms"
+                f" bwd={self.cost_info.bwd_time*1e3:.3f}ms"
+                f" cache={self.act_info.cache_bytes/2**20:.1f}MiB"
+            )
+        rc = " [ckpt]" if self.recompute or self.in_recompute else ""
+        return f"{self.name}({type(self).__name__}){rc}{extra}"
+
+
+class LeafModule(MetaModule):
+    is_leaf = True
+
+
+class GemmBase(LeafModule):
+    """Shared GEMM shape-key bookkeeping (reference ``LinearBase``
+    ``base_struct.py:1136-1154``): canonical ``b=,m=,k=,n=,layout=,...``
+    efficiency-lookup keys per backprop stage. On TPU the layout tag
+    records the contraction structure XLA sees, and the low-precision path
+    is int8 (native MXU) rather than fp8."""
+
+    def __init__(self, ctx, name="", quantized: bool = False):
+        super().__init__(ctx, name)
+        self.quantized = quantized and ctx.strategy.fp8
+
+    @property
+    def matmul_op_key(self) -> str:
+        if self.quantized:
+            return f"{self.ctx.strategy.quant_dtype}_matmul"
+        return "matmul"
+
+    def gemm_mnk(self, phase: str) -> Tuple[int, int, int, int]:
+        """Return (b, m, k, n) of the GEMM executed in ``phase``."""
+        raise NotImplementedError
+
+    def gemm_shape_key(self, phase: str) -> str:
+        b, m, k, n = self.gemm_mnk(phase)
+        layout = {"fwd": "NN", "bwd_act": "NT", "bwd_w": "TN"}[phase]
+        acc = phase == "bwd_w" and self.ctx.strategy.use_fp32_accum_grad
+        out_dtype = "fp32" if acc else self.ctx.strategy.dtype
+        return (
+            f"b={b}, m={m}, k={k}, n={n}, layout={layout}, "
+            f"accumulate={acc}, out_dtype={out_dtype}"
+        )
+
+    def comp_key(self, phase: str):
+        return (self.matmul_op_key, self.gemm_shape_key(phase))

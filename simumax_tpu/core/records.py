@@ -1,0 +1,217 @@
+"""Accounting records (L1): per-module compute / activation / parameter /
+cost bookkeeping with ``+`` aggregation.
+
+Reference: ``simumax/core/model_struct.py`` (``ModuleComputeInfo:40``,
+``ActivationInfo:112``, ``ModuleMemoryInfo:240``, ``ModuleCostInfo:323``,
+``PathDebugContext:199``, ``RecomputeStatus:15``) — re-shaped into four flat
+dataclasses keyed by the three backprop phases ``fwd`` / ``bwd_act``
+(dgrad) / ``bwd_w`` (wgrad).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+PHASES = ("fwd", "bwd_act", "bwd_w")
+
+
+class RecomputeStatus(enum.Enum):
+    NONE = 0
+    FIRST = 1  # first leaf of a checkpointed segment: caches segment input
+    MIDDLE = 2
+    LAST = 3
+
+
+def _addable(cls):
+    """Give a numeric dataclass field-wise __add__/__radd__ (sum-friendly)."""
+
+    def __add__(self, other):
+        if other == 0:
+            return self
+        kw = {}
+        for f in field_names:
+            a, b = getattr(self, f), getattr(other, f)
+            kw[f] = a + b
+        return cls(**kw)
+
+    field_names = [f.name for f in cls.__dataclass_fields__.values()]  # type: ignore[attr-defined]
+    cls.__add__ = __add__
+    cls.__radd__ = __add__
+    return cls
+
+
+@_addable
+@dataclass
+class ComputeInfo:
+    """FLOPs + HBM bytes accessed per phase."""
+
+    fwd_flops: float = 0.0
+    bwd_act_flops: float = 0.0
+    bwd_w_flops: float = 0.0
+    fwd_accessed: float = 0.0
+    bwd_act_accessed: float = 0.0
+    bwd_w_accessed: float = 0.0
+
+    @property
+    def bwd_flops(self) -> float:
+        return self.bwd_act_flops + self.bwd_w_flops
+
+    @property
+    def total_flops(self) -> float:
+        return self.fwd_flops + self.bwd_flops
+
+
+@_addable
+@dataclass
+class ActivationInfo:
+    """Activation-memory accounting for one module (all per-microbatch,
+    per-device bytes)."""
+
+    #: bytes held from fwd until this module's bwd (the "activation cache")
+    cache_bytes: float = 0.0
+    #: transient extra bytes live only while the fwd op runs
+    fwd_temp_bytes: float = 0.0
+    #: transient extra bytes live only while the bwd op runs
+    bwd_temp_bytes: float = 0.0
+    #: module input / output sizes (for replay & p2p sizing)
+    input_bytes: float = 0.0
+    output_bytes: float = 0.0
+
+
+@_addable
+@dataclass
+class ParamInfo:
+    """Weight / grad / optimizer-state bytes, dense vs expert (MoE) split
+    (reference ``ModuleMemoryInfo`` model_struct.py:240)."""
+
+    weight_bytes: float = 0.0
+    grad_bytes: float = 0.0
+    state_bytes: float = 0.0
+    moe_weight_bytes: float = 0.0
+    moe_grad_bytes: float = 0.0
+    moe_state_bytes: float = 0.0
+    #: raw (unsharded-optimizer) elements, for DP-comm sizing
+    dense_numel: float = 0.0
+    moe_numel: float = 0.0
+
+    @property
+    def total_bytes(self) -> float:
+        return (
+            self.weight_bytes
+            + self.grad_bytes
+            + self.state_bytes
+            + self.moe_weight_bytes
+            + self.moe_grad_bytes
+            + self.moe_state_bytes
+        )
+
+
+@dataclass
+class CollectiveCall:
+    """One collective issued by a leaf in a given phase.
+
+    ``point`` orders it against the leaf's compute within the phase
+    ('pre' before, 'post' after) — the discrete-event simulator replays
+    these as real jobs; the analytical path adds ``time`` when ``exposed``.
+    """
+
+    phase: str  # fwd | bwd_act | bwd_w
+    op: str  # all_gather | reduce_scatter | all_reduce | all2all | p2p
+    dim: str  # parallel dim name -> CommPath (tp/cp/dp/ep/etp/edp/pp)
+    size_bytes: float
+    point: str = "pre"  # pre | post
+    exposed: bool = True
+    time: float = 0.0  # filled by the framework
+
+
+@_addable
+@dataclass
+class _PhaseTimes:
+    fwd: float = 0.0
+    bwd_act: float = 0.0
+    bwd_w: float = 0.0
+
+    def get(self, phase: str) -> float:
+        return getattr(self, phase)
+
+    def add(self, phase: str, v: float):
+        setattr(self, phase, getattr(self, phase) + v)
+
+    @property
+    def bwd(self) -> float:
+        return self.bwd_act + self.bwd_w
+
+    @property
+    def total(self) -> float:
+        return self.fwd + self.bwd_act + self.bwd_w
+
+
+@dataclass
+class CostInfo:
+    """Per-phase times (reference ``ModuleCostInfo`` model_struct.py:323).
+
+    ``compute`` is the rooflined on-chip time, ``net_exposed`` the
+    serialized collective time, ``net_hidden`` collectives assumed
+    overlapped (counted for traces but not the critical path).
+    """
+
+    compute: _PhaseTimes = field(default_factory=_PhaseTimes)
+    net_exposed: _PhaseTimes = field(default_factory=_PhaseTimes)
+    net_hidden: _PhaseTimes = field(default_factory=_PhaseTimes)
+    recompute_time: float = 0.0  # extra fwd replay before bwd_act
+
+    def __add__(self, other):
+        if other == 0:
+            return self
+        return CostInfo(
+            compute=self.compute + other.compute,
+            net_exposed=self.net_exposed + other.net_exposed,
+            net_hidden=self.net_hidden + other.net_hidden,
+            recompute_time=self.recompute_time + other.recompute_time,
+        )
+
+    __radd__ = __add__
+
+    def phase_time(self, phase: str) -> float:
+        return self.compute.get(phase) + self.net_exposed.get(phase)
+
+    @property
+    def fwd_time(self) -> float:
+        return self.phase_time("fwd")
+
+    @property
+    def bwd_time(self) -> float:
+        return (
+            self.phase_time("bwd_act") + self.phase_time("bwd_w") + self.recompute_time
+        )
+
+    @property
+    def total_time(self) -> float:
+        return self.fwd_time + self.bwd_time
+
+    @property
+    def total_net_exposed(self) -> float:
+        return self.net_exposed.total
+
+
+@dataclass
+class PathDebugContext:
+    """Per-path cost probe carrier (reference ``model_struct.py:199``)."""
+
+    enabled: bool = False
+    rows: List[Dict] = field(default_factory=list)
+
+    def record(self, path: str, cost: "CostInfo", compute: "ComputeInfo"):
+        if not self.enabled:
+            return
+        self.rows.append(
+            {
+                "path": path,
+                "fwd_ms": cost.fwd_time * 1e3,
+                "bwd_ms": cost.bwd_time * 1e3,
+                "net_ms": cost.total_net_exposed * 1e3,
+                "fwd_gflops": compute.fwd_flops / 1e9,
+            }
+        )

@@ -1,0 +1,39 @@
+"""Formatting / small helpers (reference ``simumax/core/utils.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def human_time(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.3f} s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.3f} ms"
+    return f"{seconds * 1e6:.2f} us"
+
+
+def humanize_result(d: Any) -> Any:
+    """Recursively prettify keys ending in _bytes/_time (reference
+    ``convert_final_result_to_human_format`` core/utils.py:146-170)."""
+    if isinstance(d, dict):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, (int, float)) and k.endswith("_bytes"):
+                out[k.replace("_bytes", "")] = human_bytes(v)
+            elif isinstance(v, (int, float)) and k.endswith("_time"):
+                out[k.replace("_time", "")] = human_time(v)
+            else:
+                out[k] = humanize_result(v)
+        return out
+    if isinstance(d, list):
+        return [humanize_result(x) for x in d]
+    return d

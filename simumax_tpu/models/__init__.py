@@ -1,0 +1,1 @@
+from simumax_tpu.models.llm import LLMModel, LLMBlock  # noqa: F401

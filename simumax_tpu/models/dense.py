@@ -1,0 +1,629 @@
+"""Dense analytical ops (L3).
+
+Reference: ``simumax/core/transformer/dense_module.py`` (Embedding:18,
+LinearCol:195, LinearRow:511, LayerNorm:784, CoreAttention:1061,
+RotaryEmbedding:1806, Swiglu/Gelu:1874, ParallelCE:2097, Attention:2454,
+MLP:2888).
+
+Shape conventions (all sizes are **per-device, per-microbatch**):
+
+* ``s_cp``  = seq_len / cp — the sequence shard attention-external ops see
+  under context parallelism;
+* ``s_sp``  = s_cp / tp when Megatron sequence-parallel is on — the shard
+  between TP regions;
+* TP collectives ride the ``tp`` CommPath (innermost ICI axis), CP a2a the
+  ``cp`` path, etc. Collective ``size_bytes`` is always the *full logical
+  tensor* being communicated (matching ``SystemConfig.compute_net_op_time``
+  semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from simumax_tpu.core.module import BuildContext, GemmBase, LeafModule, MetaModule
+from simumax_tpu.core.records import ActivationInfo, CollectiveCall
+from simumax_tpu.core.tensor import TensorSpec
+
+
+def _st(ctx: BuildContext):
+    return ctx.strategy
+
+
+# --------------------------------------------------------------------------
+# Shape-only "function" ops (reference ``transformer/function.py``)
+# --------------------------------------------------------------------------
+
+
+class AddFunction(LeafModule):
+    """Residual add: memory-bound, no cache (bwd is fan-out passthrough)."""
+
+    def forward_spec(self, a: TensorSpec, b: TensorSpec) -> TensorSpec:
+        assert a.shape == b.shape, (a.shape, b.shape)
+        return a.with_shape(*a.shape)
+
+    def op_accessed(self) -> Dict[str, float]:
+        n = self.outputs[0].bytes
+        return {"fwd": 3 * n}
+
+
+class SplitFunction(LeafModule):
+    """Split last dim into parts; zero-cost shape op."""
+
+    def __init__(self, ctx, sizes, name=""):
+        super().__init__(ctx, name)
+        self.sizes = sizes
+
+    def forward_spec(self, x: TensorSpec):
+        assert sum(self.sizes) == x.shape[-1]
+        return tuple(x.with_shape(*x.shape[:-1], sz) for sz in self.sizes)
+
+
+class ConcatFunction(LeafModule):
+    def __init__(self, ctx, dim=-1, name=""):
+        super().__init__(ctx, name)
+        self.dim = dim
+
+    def forward_spec(self, *xs: TensorSpec):
+        base = list(xs[0].shape)
+        base[self.dim] = sum(x.shape[self.dim] for x in xs)
+        return xs[0].with_shape(*base)
+
+    def op_accessed(self) -> Dict[str, float]:
+        n = self.outputs[0].bytes
+        return {"fwd": 2 * n, "bwd_act": 2 * n}
+
+
+# --------------------------------------------------------------------------
+# Embedding
+# --------------------------------------------------------------------------
+
+
+class Embedding(LeafModule):
+    """TP-sharded vocab embedding (reference ``dense_module.py:18-193``):
+    fwd TP all-reduce (or SP reduce-scatter); bwd-W all-gather under SP;
+    ZeRO-1 state sharding."""
+
+    def __init__(self, ctx, name="embedding"):
+        super().__init__(ctx, name)
+        st = _st(ctx)
+        self.vocab = ctx.model.padded_vocab_size
+        self.hidden = ctx.model.hidden_size
+        self.numel = self.vocab * self.hidden // st.tp_size
+
+    def forward_spec(self, ids: TensorSpec) -> TensorSpec:
+        st = _st(self.ctx)
+        b, s = ids.shape
+        if st.enable_sequence_parallel:
+            s = s // st.tp_size
+        return TensorSpec((b, s, self.hidden), st.dtype)
+
+    def op_accessed(self) -> Dict[str, float]:
+        out = self.outputs[0]
+        full = out.bytes * (_st(self.ctx).tp_size if _st(self.ctx).enable_sequence_parallel else 1)
+        # lookup write + bwd scatter-add read/write of fp32 grad
+        return {"fwd": 2 * full, "bwd_w": 2 * full + self.inputs[0].bytes}
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(cache_bytes=self.inputs[0].numel() * 4)  # ids
+
+    def extra_param_info(self):
+        return self.make_param_info(self.numel)
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.tp_size == 1:
+            return []
+        out = self.outputs[0]
+        full = out.bytes * (st.tp_size if st.enable_sequence_parallel else 1)
+        calls = []
+        if st.enable_sequence_parallel:
+            calls.append(CollectiveCall("fwd", "reduce_scatter", "tp", full, "post"))
+            calls.append(CollectiveCall("bwd_w", "all_gather", "tp", full, "pre"))
+        else:
+            calls.append(CollectiveCall("fwd", "all_reduce", "tp", full, "post"))
+        return calls
+
+
+# --------------------------------------------------------------------------
+# Norms
+# --------------------------------------------------------------------------
+
+
+class LayerNorm(LeafModule):
+    """RMS/LayerNorm (reference ``dense_module.py:784-995``): memory-bound,
+    caches its input; weight is dense state."""
+
+    def __init__(self, ctx, hidden=None, name="norm"):
+        super().__init__(ctx, name)
+        self.hidden = hidden or ctx.model.hidden_size
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        return x
+
+    def op_flops(self) -> Dict[str, float]:
+        n = self.inputs[0].numel()
+        return {"fwd": 4 * n, "bwd_act": 8 * n}
+
+    def op_accessed(self) -> Dict[str, float]:
+        nb = self.inputs[0].bytes
+        fused = _st(self.ctx).use_fused_norm
+        return {
+            "fwd": (2 if fused else 3) * nb,
+            "bwd_act": (3 if fused else 4) * nb,
+            "bwd_w": nb,  # weight-grad reduction pass
+        }
+
+    def activation_info(self) -> ActivationInfo:
+        nb = self.inputs[0].bytes
+        rows = self.inputs[0].numel() / self.hidden
+        return ActivationInfo(cache_bytes=nb + rows * 4)  # input + rstd
+
+    def extra_param_info(self):
+        return self.make_param_info(self.hidden)
+
+
+# --------------------------------------------------------------------------
+# Linear layers
+# --------------------------------------------------------------------------
+
+
+class LinearCol(GemmBase):
+    """Column-parallel linear (reference ``dense_module.py:195-509``).
+
+    Under SP: fwd all-gather of the seq-sharded input, bwd-act
+    reduce-scatter of the input grad, bwd-w re-all-gather of the input for
+    the wgrad GEMM. Without SP (tp>1): bwd-act all-reduce.
+    """
+
+    def __init__(self, ctx, in_features, out_features, name="linear_col",
+                 quantized=False, skip_comm=False):
+        super().__init__(ctx, name, quantized=quantized)
+        st = _st(ctx)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.out_local = out_features // st.tp_size
+        self.numel = in_features * self.out_local
+        self.skip_comm = skip_comm  # e.g. duplicated (non-TP) linear
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        st = _st(self.ctx)
+        b, s, k = x.shape
+        assert k == self.in_features, (k, self.in_features, self.name)
+        if st.enable_sequence_parallel and st.tp_size > 1 and not self.skip_comm:
+            s = s * st.tp_size  # gathered inside the TP region
+        return TensorSpec((b, s, self.out_local), st.dtype)
+
+    def gemm_mnk(self, phase: str):
+        out = self.outputs[0]
+        m = out.shape[0] * out.shape[1]
+        k, n = self.in_features, self.out_local
+        if phase == "fwd":
+            return (1, m, k, n)
+        if phase == "bwd_act":
+            return (1, m, n, k)
+        return (1, k, m, n)
+
+    def op_flops(self) -> Dict[str, float]:
+        _, m, k, n = self.gemm_mnk("fwd")
+        f = 2.0 * m * k * n
+        return {"fwd": f, "bwd_act": f, "bwd_w": f}
+
+    def op_accessed(self) -> Dict[str, float]:
+        st = _st(self.ctx)
+        e = st.element_size
+        _, m, k, n = self.gemm_mnk("fwd")
+        io = (m * k + k * n + m * n) * e
+        wgrad_extra = k * n * (st.grad_element_size - e)  # fp32 accum out
+        return {"fwd": io, "bwd_act": io, "bwd_w": io + wgrad_extra}
+
+    def activation_info(self) -> ActivationInfo:
+        st = _st(self.ctx)
+        # cache the *pre-gather* input under SP (re-gathered for wgrad)
+        cached = self.inputs[0].bytes
+        temp = 0.0
+        if st.enable_sequence_parallel and st.tp_size > 1 and not self.skip_comm:
+            temp = cached * st.tp_size  # gathered copy live during compute
+        return ActivationInfo(cache_bytes=cached, fwd_temp_bytes=temp,
+                              bwd_temp_bytes=temp)
+
+    def extra_param_info(self):
+        return self.make_param_info(self.numel)
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.tp_size == 1 or self.skip_comm:
+            return []
+        _, m, k, _ = self.gemm_mnk("fwd")
+        full_in = m * k * st.element_size
+        if st.enable_sequence_parallel:
+            return [
+                CollectiveCall("fwd", "all_gather", "tp", full_in, "pre"),
+                CollectiveCall("bwd_act", "reduce_scatter", "tp", full_in, "post"),
+                CollectiveCall("bwd_w", "all_gather", "tp", full_in, "pre"),
+            ]
+        return [CollectiveCall("bwd_act", "all_reduce", "tp", full_in, "post")]
+
+
+class LinearRow(GemmBase):
+    """Row-parallel linear (reference ``dense_module.py:511-783``):
+    fwd reduce-scatter (SP) / all-reduce (TP); bwd-act all-gather under SP.
+    """
+
+    def __init__(self, ctx, in_features, out_features, name="linear_row",
+                 quantized=False, skip_comm=False):
+        super().__init__(ctx, name, quantized=quantized)
+        st = _st(ctx)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_local = in_features // st.tp_size
+        self.numel = self.in_local * out_features
+        self.skip_comm = skip_comm
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        st = _st(self.ctx)
+        b, s, k = x.shape
+        assert k == self.in_local, (k, self.in_local, self.name)
+        if st.enable_sequence_parallel and st.tp_size > 1 and not self.skip_comm:
+            s = s // st.tp_size  # scattered back to seq shards
+        return TensorSpec((b, s, self.out_features), st.dtype)
+
+    def gemm_mnk(self, phase: str):
+        x = self.inputs[0]
+        m = x.shape[0] * x.shape[1]
+        k, n = self.in_local, self.out_features
+        if phase == "fwd":
+            return (1, m, k, n)
+        if phase == "bwd_act":
+            return (1, m, n, k)
+        return (1, k, m, n)
+
+    def op_flops(self) -> Dict[str, float]:
+        _, m, k, n = self.gemm_mnk("fwd")
+        f = 2.0 * m * k * n
+        return {"fwd": f, "bwd_act": f, "bwd_w": f}
+
+    def op_accessed(self) -> Dict[str, float]:
+        st = _st(self.ctx)
+        e = st.element_size
+        _, m, k, n = self.gemm_mnk("fwd")
+        io = (m * k + k * n + m * n) * e
+        wgrad_extra = k * n * (st.grad_element_size - e)
+        return {"fwd": io, "bwd_act": io, "bwd_w": io + wgrad_extra}
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+
+    def extra_param_info(self):
+        return self.make_param_info(self.numel)
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.tp_size == 1 or self.skip_comm:
+            return []
+        _, m, _, n = self.gemm_mnk("fwd")
+        full_out = m * n * st.element_size
+        if st.enable_sequence_parallel:
+            return [
+                CollectiveCall("fwd", "reduce_scatter", "tp", full_out, "post"),
+                CollectiveCall("bwd_act", "all_gather", "tp", full_out, "pre"),
+            ]
+        return [CollectiveCall("fwd", "all_reduce", "tp", full_out, "post")]
+
+
+# --------------------------------------------------------------------------
+# Attention core
+# --------------------------------------------------------------------------
+
+
+class RotaryEmbedding(LeafModule):
+    """RoPE application to q,k: memory-bound (reference
+    ``dense_module.py:1806-1873``)."""
+
+    def forward_spec(self, q: TensorSpec, k: TensorSpec):
+        return q, k
+
+    def op_accessed(self) -> Dict[str, float]:
+        nb = sum(t.bytes for t in self.inputs)
+        return {"fwd": 2 * nb, "bwd_act": 2 * nb}
+
+
+class CoreAttention(LeafModule):
+    """Scaled-dot-product attention cost model (reference
+    ``dense_module.py:1061-1604``): flash vs math paths, causal sparsity,
+    GQA; CP handled by the enclosing :class:`Attention` via
+    :class:`ContextParallelA2A` / KV all-gather (ring) wrappers.
+
+    Inputs q,k,v are per-device: ``[b, sq, hl, d]`` / ``[b, skv, kvl, d]``.
+    """
+
+    def __init__(self, ctx, head_dim_v=None, name="core_attention"):
+        super().__init__(ctx, name)
+        self.head_dim_v = head_dim_v
+
+    def forward_spec(self, q: TensorSpec, k: TensorSpec, v: TensorSpec):
+        b, sq, hl, d = q.shape
+        dv = v.shape[-1]
+        return TensorSpec((b, sq, hl, dv), q.dtype)
+
+    def _dims(self):
+        q, k, v = self.inputs
+        b, sq, hl, d = q.shape
+        skv = k.shape[1]
+        dv = v.shape[-1]
+        return b, sq, skv, hl, d, dv
+
+    def op_flops(self) -> Dict[str, float]:
+        st = _st(self.ctx)
+        b, sq, skv, hl, d, dv = self._dims()
+        sparse = st.attention_sparse_ratio  # causal skips this fraction
+        qk = 2.0 * b * hl * sq * skv * d
+        pv = 2.0 * b * hl * sq * skv * dv
+        fwd = (qk + pv) * (1.0 - sparse)
+        bwd = 2.5 * fwd if st.use_flash_sdp else 2.0 * fwd
+        return {"fwd": fwd, "bwd_act": bwd}
+
+    def op_accessed(self) -> Dict[str, float]:
+        st = _st(self.ctx)
+        b, sq, skv, hl, d, dv = self._dims()
+        e = st.element_size
+        kvl = self.inputs[1].shape[2]
+        qo = b * sq * hl * (d + dv) * e
+        kv = b * skv * kvl * (d + dv) * e
+        lse = b * hl * sq * 4
+        if st.use_flash_sdp:
+            return {"fwd": qo + kv + lse, "bwd_act": 2 * (qo + kv) + lse}
+        # math path materializes the score matrix
+        score = b * hl * sq * skv * e
+        return {"fwd": qo + kv + 2 * score, "bwd_act": 2 * (qo + kv) + 4 * score}
+
+    def comp_key(self, phase):
+        b, sq, skv, hl, d, dv = self._dims()
+        kvl = self.inputs[1].shape[2]
+        causal = sq == skv
+        key = (
+            f"b={b}, sq={sq}, skv={skv}, hn={hl}, kv_hn={kvl}, hd={d}, "
+            f"hd_v={dv}, causal={causal}, dtype={_st(self.ctx).dtype}"
+        )
+        return ("sdp_fwd" if phase == "fwd" else "sdp_bwd", key)
+
+    def activation_info(self) -> ActivationInfo:
+        st = _st(self.ctx)
+        b, sq, skv, hl, d, dv = self._dims()
+        e = st.element_size
+        kvl = self.inputs[1].shape[2]
+        lse = b * hl * sq * 4
+        if st.use_flash_sdp:
+            # flash caches q,k,v,o,lse
+            cache = (
+                b * sq * hl * d * e
+                + b * skv * kvl * (d + dv) * e
+                + b * sq * hl * dv * e
+                + lse
+            )
+            return ActivationInfo(cache_bytes=cache)
+        score = b * hl * sq * skv * e
+        cache = b * sq * hl * d * e + b * skv * kvl * (d + dv) * e + 2 * score
+        return ActivationInfo(cache_bytes=cache, fwd_temp_bytes=score)
+
+    def bw_key(self, phase):
+        return "default"
+
+
+class ContextParallelA2A(LeafModule):
+    """One Ulysses-style CP all-to-all stage: re-shard ``[b, s/cp, H, d]``
+    (seq-sharded) <-> ``[b, s, H/cp, d]`` (head-sharded) over the cp axis
+    (reference ``_get_cp_a2a_stage_specs`` dense_module.py:1158-1186).
+
+    ``direction='scatter_heads'`` gathers sequence / scatters heads (the
+    pre-attention direction); 'gather_seq' is the inverse. The backward of
+    each is the opposite a2a with the same volume, so fwd/bwd sizes match.
+    """
+
+    def __init__(self, ctx, direction="scatter_heads", name="cp_a2a"):
+        super().__init__(ctx, name)
+        self.direction = direction
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        cp = _st(self.ctx).cp_size
+        b, s, h, d = x.shape
+        if self.direction == "scatter_heads":
+            return x.with_shape(b, s * cp, h // cp, d)
+        return x.with_shape(b, s // cp, h * cp, d)
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.cp_size == 1:
+            return []
+        # full logical tensor = per-chip shard * cp (net-op contract)
+        nbytes = self.inputs[0].bytes * st.cp_size
+        exposed = st.cp_a2a_mode == "sync_cp"
+        return [
+            CollectiveCall("fwd", "all2all", "cp", nbytes, "pre", exposed=exposed),
+            CollectiveCall("bwd_act", "all2all", "cp", nbytes, "post", exposed=exposed),
+        ]
+
+    def activation_info(self) -> ActivationInfo:
+        # the re-sharded copy is a transient; source freed after a2a
+        return ActivationInfo(fwd_temp_bytes=self.inputs[0].bytes)
+
+
+class KVAllGather(LeafModule):
+    """CP ``all_gather`` (ring-attention family) KV gather: fwd all-gather
+    of k or v over cp, bwd reduce-scatter of its grad. The reference only
+    costs the net time and raises on flops (``dense_module.py:1521-1524``);
+    here it is a complete op."""
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        cp = _st(self.ctx).cp_size
+        b, s, hl, d = x.shape
+        return x.with_shape(b, s * cp, hl, d)
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.cp_size == 1:
+            return []
+        full = self.outputs[0].bytes
+        return [
+            CollectiveCall("fwd", "all_gather", "cp", full, "pre"),
+            CollectiveCall("bwd_act", "reduce_scatter", "cp", full, "post"),
+        ]
+
+    def activation_info(self) -> ActivationInfo:
+        # gathered KV live through attention fwd (and re-gathered in bwd)
+        full = self.outputs[0].bytes
+        return ActivationInfo(fwd_temp_bytes=full, bwd_temp_bytes=full)
+
+
+# --------------------------------------------------------------------------
+# Activations / losses
+# --------------------------------------------------------------------------
+
+
+class Swiglu(LeafModule):
+    """SwiGLU activation (reference ``dense_module.py:1874-2096``):
+    memory-bound; input is the concatenated ``[.., 2*f]`` projection."""
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        return x.split_dim(-1, 2)
+
+    def op_accessed(self) -> Dict[str, float]:
+        i, o = self.inputs[0].bytes, self.outputs[0].bytes
+        return {"fwd": i + o, "bwd_act": 2 * i + o}
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+
+
+class Gelu(LeafModule):
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        return x
+
+    def op_accessed(self) -> Dict[str, float]:
+        n = self.inputs[0].bytes
+        return {"fwd": 2 * n, "bwd_act": 3 * n}
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+
+
+class ParallelCE(LeafModule):
+    """Vocab-parallel cross-entropy (reference ``dense_module.py:2097-2363``):
+    three TP all-reduces of ``[b, s]`` fp32 scalars (max, predicted logit,
+    sum-exp); the fused variant batches two into one collective and keeps
+    only the bf16 logits cached."""
+
+    def forward_spec(self, logits: TensorSpec) -> TensorSpec:
+        b, s, v = logits.shape
+        return TensorSpec((b, s), "fp32")
+
+    def op_accessed(self) -> Dict[str, float]:
+        st = _st(self.ctx)
+        lg = self.inputs[0].bytes
+        if st.use_fused_ce:
+            return {"fwd": 2 * lg, "bwd_act": 2 * lg}
+        probs = self.inputs[0].numel() * 4
+        return {"fwd": 2 * lg + probs, "bwd_act": 2 * probs}
+
+    def bw_key(self, phase):
+        return "ce_fusion" if _st(self.ctx).use_fused_ce else "ce"
+
+    def activation_info(self) -> ActivationInfo:
+        st = _st(self.ctx)
+        if st.use_fused_ce:
+            return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+        return ActivationInfo(
+            cache_bytes=self.inputs[0].numel() * 4,  # fp32 softmax probs
+            fwd_temp_bytes=self.inputs[0].numel() * 4,
+        )
+
+    def collectives(self) -> List[CollectiveCall]:
+        st = _st(self.ctx)
+        if st.tp_size == 1:
+            return []
+        b, s, _ = self.inputs[0].shape
+        scalar = b * s * 4.0
+        ncalls = 2 if st.use_fused_ce else 3
+        return [
+            CollectiveCall("fwd", "all_reduce", "tp", scalar, "post")
+            for _ in range(ncalls)
+        ]
+
+
+# --------------------------------------------------------------------------
+# Composites
+# --------------------------------------------------------------------------
+
+
+class Attention(MetaModule):
+    """GQA/MHA attention (reference ``dense_module.py:2454-2568``):
+    LinearCol(qkv) -> split -> RoPE -> [CP re-shard] -> CoreAttention ->
+    [CP re-shard back] -> LinearRow(out)."""
+
+    def __init__(self, ctx, name="attention", quantized=False):
+        super().__init__(ctx, name)
+        m, st = ctx.model, ctx.strategy
+        self.hd = m.head_size
+        self.q_out = m.head_num * m.head_size
+        self.kv_out = m.kv_head_num * m.head_size
+        self.qkv_proj = LinearCol(
+            ctx, m.hidden_size, self.q_out + 2 * self.kv_out, "qkv_proj",
+            quantized=quantized,
+        )
+        self.rope = RotaryEmbedding(ctx, name="rope")
+        if st.cp_size > 1 and st.cp_comm_type == "a2a":
+            self.cp_q = ContextParallelA2A(ctx, "scatter_heads", "cp_a2a_q")
+            self.cp_k = ContextParallelA2A(ctx, "scatter_heads", "cp_a2a_k")
+            self.cp_v = ContextParallelA2A(ctx, "scatter_heads", "cp_a2a_v")
+            self.cp_o = ContextParallelA2A(ctx, "gather_seq", "cp_a2a_o")
+        elif st.cp_size > 1 and st.cp_comm_type == "all_gather":
+            self.kv_gather_k = KVAllGather(ctx, name="kv_allgather_k")
+            self.kv_gather_v = KVAllGather(ctx, name="kv_allgather_v")
+        self.core = CoreAttention(ctx, name="core_attention")
+        self.out_proj = LinearRow(
+            ctx, self.q_out, m.hidden_size, "out_proj", quantized=quantized
+        )
+
+    def forward(self, x: TensorSpec) -> TensorSpec:
+        st = _st(self.ctx)
+        m = self.ctx.model
+        qkv = self.qkv_proj(x)
+        b, s, _ = qkv.shape
+        tp = st.tp_size
+        hl = m.head_num // tp
+        kvl = max(m.kv_head_num // tp, 1)
+        q = qkv.with_shape(b, s, hl, self.hd)
+        k = qkv.with_shape(b, s, kvl, self.hd)
+        v = qkv.with_shape(b, s, kvl, self.hd)
+        q, k = self.rope(q, k)
+        if st.cp_size > 1 and st.cp_comm_type == "a2a":
+            q = self.cp_q(q)
+            k = self.cp_k(k)
+            v = self.cp_v(v)
+        elif st.cp_size > 1 and st.cp_comm_type == "all_gather":
+            k = self.kv_gather_k(k)
+            v = self.kv_gather_v(v)
+        o = self.core(q, k, v)
+        if st.cp_size > 1 and st.cp_comm_type == "a2a":
+            o = self.cp_o(o)
+        b2, s2, hl2, dv = o.shape
+        return self.out_proj(o.with_shape(b2, s2, hl2 * dv))
+
+
+class MLP(MetaModule):
+    """Dense MLP (reference ``dense_module.py:2888-2988``)."""
+
+    def __init__(self, ctx, ffn=None, name="mlp", quantized=False,
+                 tp_override=None):
+        super().__init__(ctx, name)
+        m = ctx.model
+        self.ffn = ffn or m.intermediate_size
+        fan = 2 * self.ffn if m.use_swiglu else self.ffn
+        self.up_proj = LinearCol(ctx, m.hidden_size, fan, "up_proj",
+                                 quantized=quantized)
+        self.act = Swiglu(ctx, name="swiglu") if m.use_swiglu else Gelu(ctx, name="gelu")
+        self.down_proj = LinearRow(ctx, self.ffn, m.hidden_size, "down_proj",
+                                   quantized=quantized)
+
+    def forward(self, x: TensorSpec) -> TensorSpec:
+        return self.down_proj(self.act(self.up_proj(x)))

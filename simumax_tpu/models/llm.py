@@ -1,0 +1,258 @@
+"""LLM block / per-stage model chunk (L3 top).
+
+Reference: ``simumax/core/transformer/language_model.py`` (``LLMBlock:98``,
+``LLMModel:210``, activation replay ``compute_activations:355-467``,
+``PeakPoint:12``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from simumax_tpu.core.module import BuildContext, MetaModule
+from simumax_tpu.core.records import RecomputeStatus
+from simumax_tpu.core.tensor import TensorSpec
+from simumax_tpu.models.dense import (
+    AddFunction,
+    Attention,
+    Embedding,
+    LayerNorm,
+    LinearCol,
+    MLP,
+    ParallelCE,
+)
+
+
+@dataclass
+class PeakPoint:
+    path: str = ""
+    stage: str = ""
+    bytes: float = 0.0
+
+
+class LLMBlock(MetaModule):
+    """One transformer layer (reference ``language_model.py:98-207``):
+    input norm -> attention -> residual -> pre-MLP norm -> MLP/ExpertMLP ->
+    residual, with per-layer recompute wiring."""
+
+    def __init__(self, ctx: BuildContext, layer_idx: int, idx_in_stage: int,
+                 name=""):
+        super().__init__(ctx, name or f"layer{layer_idx}")
+        self.layer_idx = layer_idx
+        m, st = ctx.model, ctx.strategy
+        quantized = st.fp8
+        self.input_norm = LayerNorm(ctx, name="input_norm")
+        if m.attention_type == "mla":
+            try:
+                from simumax_tpu.models.mla import MLAAttention
+            except ImportError as e:  # pragma: no cover
+                raise NotImplementedError(
+                    "MLA attention is not available in this build"
+                ) from e
+
+            self.attention = MLAAttention(ctx, quantized=quantized)
+        else:
+            self.attention = Attention(ctx, quantized=quantized)
+        self.add_attn = AddFunction(ctx, name="residual_attn")
+        self.pre_mlp_norm = LayerNorm(ctx, name="pre_mlp_norm")
+        self.is_moe_layer = (
+            m.model_type == "moe" and layer_idx >= m.dense_layers
+        )
+        if self.is_moe_layer:
+            from simumax_tpu.models.moe import ExpertMLP
+
+            self.mlp = ExpertMLP(ctx, quantized=quantized)
+        else:
+            self.mlp = MLP(ctx, quantized=quantized)
+        self.add_mlp = AddFunction(ctx, name="residual_mlp")
+        self._wire_recompute(idx_in_stage)
+
+    def _wire_recompute(self, idx_in_stage: int):
+        rc = self.ctx.strategy.recompute
+        if not rc.enabled or not rc.layer_recomputes(idx_in_stage):
+            return
+        if rc.granularity == "full_block":
+            self.mark_recompute()
+            return
+        # selective
+        if rc.sdp_recompute:
+            core = getattr(self.attention, "core", None)
+            if core is not None:
+                core.mark_recompute()
+        if rc.attn_recompute:
+            self.attention.mark_recompute()
+        if rc.attn_norm_recompute:
+            self.input_norm.mark_recompute()
+        if rc.mlp_recompute:
+            self.mlp.mark_recompute()
+        if rc.mlp_norm_recompute:
+            self.pre_mlp_norm.mark_recompute()
+
+    def forward(self, x: TensorSpec) -> TensorSpec:
+        h = self.input_norm(x)
+        h = self.attention(h)
+        x = self.add_attn(x, h)
+        h = self.pre_mlp_norm(x)
+        h = self.mlp(h)
+        return self.add_mlp(x, h)
+
+
+class LLMModel(MetaModule):
+    """One PP-stage model chunk (reference ``language_model.py:210-607``):
+    optional Embedding (preprocess), N LLMBlocks, optional final norm +
+    LM head + ParallelCE (postprocess)."""
+
+    def __init__(
+        self,
+        ctx: BuildContext,
+        layer_num: int,
+        layer_offset: int = 0,
+        preprocess: bool = True,
+        postprocess: bool = True,
+        stage_idx: int = 0,
+        chunk_idx: int = 0,
+        name: str = "",
+    ):
+        super().__init__(ctx, name or f"stage{stage_idx}")
+        self.layer_num = layer_num
+        self.layer_offset = layer_offset
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+        self.stage_idx = stage_idx
+        self.chunk_idx = chunk_idx
+        m = ctx.model
+        if preprocess:
+            self.embedding = Embedding(ctx)
+        self.blocks: List[LLMBlock] = []
+        for i in range(layer_num):
+            blk = LLMBlock(ctx, layer_offset + i, i)
+            self.add_child(f"layer{layer_offset + i}", blk)
+            self.blocks.append(blk)
+        if postprocess:
+            self.final_norm = LayerNorm(ctx, name="final_norm")
+            self.lm_head = LinearCol(
+                ctx, m.hidden_size, m.padded_vocab_size, "lm_head"
+            )
+            self.ce = ParallelCE(ctx, name="parallel_ce")
+        self.peak_point: Optional[PeakPoint] = None
+
+    # -- symbolic run ------------------------------------------------------
+    def input_spec(self) -> TensorSpec:
+        st = self.ctx.strategy
+        b, s = st.micro_batch_size, st.seq_len
+        s_cp = s // st.cp_size
+        if self.preprocess:
+            return TensorSpec((b, s_cp), "int32")
+        s_sp = s_cp // st.tp_size if st.enable_sequence_parallel else s_cp
+        return TensorSpec((b, s_sp, self.ctx.model.hidden_size), st.dtype)
+
+    def forward(self, x: TensorSpec) -> TensorSpec:
+        if self.preprocess:
+            x = self.embedding(x)
+        for blk in self.blocks:
+            x = blk(x)
+        if self.postprocess:
+            x = self.final_norm(x)
+            x = self.lm_head(x)
+            x = self.ce(x)
+        return x
+
+    def run(self) -> TensorSpec:
+        return self(self.input_spec())
+
+    # -- p2p message size --------------------------------------------------
+    def boundary_bytes(self) -> float:
+        """Bytes of the hidden-state tensor crossing a PP boundary
+        (reference ``core/utils.py:203-212``)."""
+        st = self.ctx.strategy
+        s_cp = st.seq_len // st.cp_size
+        s_sp = s_cp // st.tp_size if st.enable_sequence_parallel else s_cp
+        return (
+            st.micro_batch_size
+            * s_sp
+            * self.ctx.model.hidden_size
+            * st.element_size
+        )
+
+    # -- activation replay (reference ``language_model.py:355-467``) -------
+    def compute_activations(self) -> PeakPoint:
+        """Walk the called leaves fwd then bwd (with recompute segment
+        replay), tracking the live activation set; returns the peak.
+
+        Conservation invariant: the live set must return to ~0 after the
+        backward walk (reference ``language_model.py:462-465``).
+        """
+        leaves = self.called_leaves()
+        live = 0.0
+        peak = PeakPoint()
+
+        def bump(path: str, stage: str, candidate: float):
+            nonlocal peak
+            if candidate > peak.bytes:
+                peak = PeakPoint(path, stage, candidate)
+
+        # ---- forward walk
+        for leaf in leaves:
+            live += leaf.act_info.cache_bytes
+            bump(leaf.path_name(), "fwd", live + leaf.raw_act_info.fwd_temp_bytes)
+
+        # ---- backward walk with recompute replay
+        replayed = set()
+        i = len(leaves) - 1
+        while i >= 0:
+            leaf = leaves[i]
+            seg = getattr(leaf, "recompute_segment", None)
+            if leaf.in_recompute and seg is not None and id(seg) not in replayed:
+                replayed.add(id(seg))
+                seg_leaves = [
+                    l
+                    for l in leaves
+                    if getattr(l, "recompute_segment", None) is seg
+                ]
+                # replay fwd: raw caches come alive again; the saved segment
+                # input (FIRST leaf's effective cache) is reused, not
+                # re-allocated, and is freed with FIRST's raw cache below.
+                saved = seg_leaves[0].act_info.cache_bytes
+                for sl in seg_leaves:
+                    live += sl.raw_act_info.cache_bytes
+                    bump(sl.path_name(), "recompute",
+                         live - saved + sl.raw_act_info.fwd_temp_bytes)
+                live -= saved
+                # consume raw caches in reverse as bwd proceeds
+                for sl in reversed(seg_leaves):
+                    bump(sl.path_name(), "bwd", live + sl.raw_act_info.bwd_temp_bytes)
+                    live -= sl.raw_act_info.cache_bytes
+                i -= len(seg_leaves)
+                continue
+            bump(leaf.path_name(), "bwd", live + leaf.raw_act_info.bwd_temp_bytes)
+            live -= leaf.act_info.cache_bytes
+            i -= 1
+
+        assert abs(live) < 1024, (
+            f"activation conservation violated: {live} bytes left live"
+        )
+        self.peak_point = peak
+        return peak
+
+    # -- tables ------------------------------------------------------------
+    def op_table(self) -> List[dict]:
+        """Per-leaf cost/memory rows (reference ``language_model.py:514``)."""
+        rows = []
+        for leaf in self.called_leaves():
+            rows.append(
+                {
+                    "path": leaf.path_name(),
+                    "fwd_ms": leaf.cost_info.fwd_time * 1e3,
+                    "bwd_ms": leaf.cost_info.bwd_time * 1e3,
+                    "net_ms": leaf.cost_info.total_net_exposed * 1e3,
+                    "fwd_gflops": leaf.compute_info.fwd_flops / 1e9,
+                    "cache_mib": leaf.act_info.cache_bytes / 2**20,
+                    "weight_mib": (
+                        leaf.param_info.weight_bytes
+                        + leaf.param_info.moe_weight_bytes
+                    )
+                    / 2**20,
+                }
+            )
+        return rows

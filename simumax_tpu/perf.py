@@ -1,0 +1,530 @@
+"""PerfLLM orchestrator (L4).
+
+Reference: ``simumax/core/perf_llm.py`` — ``configure`` (:1426),
+``run_estimate`` (:489), ``build``/``get_num_layers_to_build`` (:539-835),
+``analysis_mem`` (:1599-1969), ``analysis_cost`` (:1971-2910) with the
+event-matched 1F1B replay (``calculate_1f1b_bubble`` :2097), DP comm
+(:1513) and Megatron-style optimizer timing (:1470), straggler inflation
+(:255-291), and ``analysis`` (:3585-3668).
+
+TPU redesign: ``analysis_net`` places every parallel dim on the ICI torus
+/ DCN via ``SystemConfig.place_group`` (mesh-axis model) instead of
+choosing NVLink/PCIe link classes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Union
+
+from simumax_tpu.core.config import (
+    GiB,
+    ModelConfig,
+    StrategyConfig,
+    SystemConfig,
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.core.module import BuildContext
+from simumax_tpu.core.utils import human_bytes, human_time
+from simumax_tpu.models.llm import LLMModel
+
+
+def _resolve(cfg, cls, getter):
+    if isinstance(cfg, cls):
+        return cfg
+    if isinstance(cfg, dict):
+        return cls.init_from_dict(cfg)
+    if isinstance(cfg, str):
+        if os.path.isfile(cfg):
+            return cls.init_from_config_file(cfg)
+        return getter(cfg)
+    raise TypeError(f"cannot resolve {cls.__name__} from {type(cfg)}")
+
+
+class PerfBase:
+    """Config plumbing shared by perf frontends."""
+
+    def __init__(self):
+        self.strategy: Optional[StrategyConfig] = None
+        self.model_config: Optional[ModelConfig] = None
+        self.system: Optional[SystemConfig] = None
+
+    def configure(
+        self,
+        strategy: Union[str, dict, StrategyConfig],
+        model: Union[str, dict, ModelConfig],
+        system: Union[str, dict, SystemConfig],
+    ):
+        self.strategy = _resolve(strategy, StrategyConfig, get_strategy_config)
+        self.model_config = _resolve(model, ModelConfig, get_model_config)
+        self.system = _resolve(system, SystemConfig, get_system_config)
+        self.strategy.sanity_check()
+        self.model_config.sanity_check()
+        self._cross_sanity_check()
+        return self
+
+    def _cross_sanity_check(self):
+        """Reference ``perf_llm.py:1381-1424``."""
+        st, m, sysc = self.strategy, self.model_config, self.system
+        assert st.world_size <= sysc.total_chips, (
+            f"strategy world_size {st.world_size} exceeds system "
+            f"{sysc.total_chips} chips"
+        )
+        head_shard = st.tp_size
+        if st.cp_size > 1 and st.cp_comm_type == "a2a":
+            head_shard *= st.cp_size  # Ulysses scatters heads over cp too
+        assert m.head_num % head_shard == 0, (
+            f"head_num {m.head_num} must divide tp"
+            f"{'*cp' if head_shard != st.tp_size else ''} ({head_shard})"
+        )
+        if m.kv_head_num < st.tp_size:
+            pass  # kv heads replicated within tp; allowed
+        if m.model_type == "moe":
+            assert m.expert_num % st.ep_size == 0, "expert_num % ep != 0"
+        total_stages = st.pp_size * st.vp_size
+        layers = m.layer_num
+        if st.num_layers_in_first_pipeline_stage:
+            layers -= st.num_layers_in_first_pipeline_stage
+        if st.num_layers_in_last_pipeline_stage:
+            layers -= st.num_layers_in_last_pipeline_stage
+        # remaining layers must split evenly over remaining virtual stages
+        rem = total_stages
+        if st.num_layers_in_first_pipeline_stage:
+            rem -= 1
+        if st.num_layers_in_last_pipeline_stage:
+            rem -= 1
+        eff = layers + (
+            1 if st.account_for_embedding_in_pipeline_split else 0
+        ) + (1 if st.account_for_loss_in_pipeline_split else 0)
+        assert eff % max(rem, 1) == 0, (
+            f"{layers} layers do not split evenly over {rem} virtual stages"
+        )
+
+
+class PerfLLM(PerfBase):
+    """Analytical perf/memory estimation for one (system, strategy, model)
+    triple. Usage: ``configure() -> run_estimate() -> analysis_mem() /
+    analysis_cost() / analysis() / simulate()``."""
+
+    def __init__(self):
+        super().__init__()
+        self.ctx: Optional[BuildContext] = None
+        self.chunks: Dict[tuple, LLMModel] = {}  # (stage, vpp_rank) -> chunk
+        self._mem_result = None
+        self._cost_result = None
+
+    # ------------------------------------------------------------------
+    # Net placement (reference ``analysis_net`` perf_llm.py:369-474)
+    # ------------------------------------------------------------------
+    def analysis_net(self) -> Dict[str, object]:
+        st, sysc = self.strategy, self.system
+        tp, cp, dp, pp = st.tp_size, st.cp_size, st.dp_size, st.pp_size
+        ep, etp = st.ep_size, st.etp_size
+        paths = {
+            "tp": sysc.place_group("tp", 1, tp),
+            "cp": sysc.place_group("cp", tp, cp),
+            "dp": sysc.place_group("dp", tp * cp, dp),
+            "dp_cp": sysc.place_group("dp_cp", tp, cp * dp),
+            "pp": sysc.place_group("pp", tp * cp * dp, pp),
+            # MoE dims: etp shares the tp placement; ep strides over etp
+            "etp": sysc.place_group("etp", 1, etp),
+            "ep": sysc.place_group("ep", etp, ep),
+            "edp": sysc.place_group("edp", etp * ep, st.edp_size),
+        }
+        return paths
+
+    # ------------------------------------------------------------------
+    # Stage chunking (reference ``get_num_layers_to_build`` perf_llm.py:539)
+    # ------------------------------------------------------------------
+    def stage_layer_counts(self) -> List[List[int]]:
+        """Return counts[stage][vpp_rank] = number of transformer layers."""
+        st, m = self.strategy, self.model_config
+        pp, vp = st.pp_size, st.vp_size
+        total_v = pp * vp
+        counts = [[0] * vp for _ in range(pp)]
+        layers = m.layer_num
+        eff = layers
+        if st.account_for_embedding_in_pipeline_split:
+            eff += 1
+        if st.account_for_loss_in_pipeline_split:
+            eff += 1
+        first = st.num_layers_in_first_pipeline_stage
+        last = st.num_layers_in_last_pipeline_stage
+        per_v = [0] * total_v
+        if first or last:
+            rem_v = total_v - (1 if first else 0) - (1 if last else 0)
+            rem_layers = layers - (first or 0) - (last or 0)
+            base = rem_layers // max(rem_v, 1)
+            for v in range(total_v):
+                per_v[v] = base
+            if first:
+                per_v[0] = first
+            if last:
+                per_v[-1] = last
+        else:
+            base = eff // total_v
+            for v in range(total_v):
+                per_v[v] = base
+            if st.account_for_embedding_in_pipeline_split:
+                per_v[0] -= 1
+            if st.account_for_loss_in_pipeline_split:
+                per_v[-1] -= 1
+        # virtual stage v = chunk * pp + stage (Megatron interleaving)
+        for v in range(total_v):
+            chunk, stage = divmod(v, pp)
+            counts[stage][chunk] = per_v[v]
+        assert sum(sum(c) for c in counts) == layers
+        return counts
+
+    def build(self):
+        """Construct per-(stage, vpp_rank) model chunks
+        (reference ``build`` perf_llm.py:676-835)."""
+        st = self.strategy
+        self.model_config.maybe_pad_vocab_size(st.tp_size)
+        paths = self.analysis_net()
+        self.ctx = BuildContext(st, self.model_config, self.system, paths)
+        counts = self.stage_layer_counts()
+        self.chunks = {}
+        offset = 0
+        # build in virtual-stage (layer) order so offsets are consecutive
+        for v in range(st.pp_size * st.vp_size):
+            chunk_idx, stage = divmod(v, st.pp_size)
+            n = counts[stage][chunk_idx]
+            pre = v == 0
+            post = v == st.pp_size * st.vp_size - 1
+            self.chunks[(stage, chunk_idx)] = LLMModel(
+                self.ctx,
+                layer_num=n,
+                layer_offset=offset,
+                preprocess=pre,
+                postprocess=post,
+                stage_idx=stage,
+                chunk_idx=chunk_idx,
+                name=f"stage{stage}_chunk{chunk_idx}",
+            )
+            offset += n
+
+    def _run(self):
+        """Symbolic forward over every chunk (reference ``_run``
+        perf_llm.py:2938-3047)."""
+        for chunk in self.chunks.values():
+            chunk.run()
+            chunk.compute_activations()
+
+    def run_estimate(self):
+        assert self.strategy is not None, "call configure() first"
+        self.system.reset_status()
+        self.build()
+        self._run()
+        self._mem_result = None
+        self._cost_result = None
+        return self
+
+    # ------------------------------------------------------------------
+    # Memory analysis (reference perf_llm.py:1599-1969)
+    # ------------------------------------------------------------------
+    def stage_chunks(self, stage: int) -> List[LLMModel]:
+        return [c for (s, _), c in sorted(self.chunks.items()) if s == stage]
+
+    def analysis_mem(self) -> dict:
+        if self._mem_result is not None:
+            return self._mem_result
+        st = self.strategy
+        pp, mbc, vp = st.pp_size, st.micro_batch_num, st.vp_size
+        stages = []
+        for s in range(pp):
+            chunks = self.stage_chunks(s)
+            model_mem = sum(c.param_info.total_bytes for c in chunks)
+            cache_per_mb = sum(c.act_info.cache_bytes for c in chunks)
+            replay_peak = max((c.peak_point.bytes for c in chunks), default=0.0)
+            if vp == 1:
+                live = min(mbc, pp - s)
+            else:
+                # interleaved: stage s keeps up to pp*(vp-1) + (pp-s) in
+                # flight spread over its vp chunks (Megatron bound)
+                live = min(mbc * vp, pp * (vp - 1) + (pp - s))
+                cache_per_mb = cache_per_mb / vp  # per chunk-microbatch
+            peak = model_mem + max(live - 1, 0) * cache_per_mb + replay_peak
+            stages.append(
+                {
+                    "stage": s,
+                    "model_bytes": model_mem,
+                    "act_cache_per_microbatch_bytes": cache_per_mb,
+                    "live_microbatches": live,
+                    "replay_peak_bytes": replay_peak,
+                    "peak_bytes": peak,
+                    "peak_gib": peak / GiB,
+                }
+            )
+        cap = self.system.mem_bytes * st.mem_factor
+        result = {
+            "stages": stages,
+            "max_peak_bytes": max(s["peak_bytes"] for s in stages),
+            "max_peak_gib": max(s["peak_bytes"] for s in stages) / GiB,
+            "hbm_capacity_gib": self.system.mem_bytes / GiB,
+            "usable_gib": cap / GiB,
+            "fits": all(s["peak_bytes"] <= cap for s in stages),
+        }
+        self._mem_result = result
+        return result
+
+    # ------------------------------------------------------------------
+    # Cost analysis
+    # ------------------------------------------------------------------
+    def _stage_phase_inputs(self, stage: int) -> dict:
+        """Per-stage fwd/bwd compute + p2p times (reference
+        ``_compute_single_batch_phase_inputs`` perf_llm.py:2644)."""
+        chunks = self.stage_chunks(stage)
+        fwd = sum(c.cost_info.fwd_time for c in chunks)
+        bwd = sum(c.cost_info.bwd_time for c in chunks)
+        p2p_bytes = chunks[0].boundary_bytes()
+        p2p = self.system.compute_net_op_time("p2p", p2p_bytes, self.ctx.path("pp"))
+        return {"fwd": fwd, "bwd": bwd, "p2p": p2p}
+
+    def calculate_1f1b_bubble(self, phase_inputs: List[dict]) -> dict:
+        """Event-matched non-interleaved 1F1B replay (reference
+        ``calculate_1f1b_bubble`` perf_llm.py:2097-2306): per-stage op
+        queues with p2p dependencies, no collective batching subtleties —
+        on TPU the p2p is an XLA collective-permute on the pp mesh axis.
+        """
+        st = self.strategy
+        pp, mbc = st.pp_size, st.micro_batch_num
+        if pp == 1:
+            ph = phase_inputs[0]
+            total = mbc * (ph["fwd"] + ph["bwd"])
+            return {"total": total, "bubble": 0.0, "per_stage_end": [total]}
+
+        # build the standard Megatron 1F1B op order per stage
+        orders: List[List[tuple]] = []
+        for s in range(pp):
+            w = min(mbc, pp - s - 1)
+            ops = [("F", i) for i in range(w)]
+            f, b = w, 0
+            while f < mbc or b < mbc:
+                if f < mbc:
+                    ops.append(("F", f))
+                    f += 1
+                if b < mbc:
+                    ops.append(("B", b))
+                    b += 1
+            orders.append(ops)
+
+        F_end = [[0.0] * mbc for _ in range(pp)]
+        B_end = [[0.0] * mbc for _ in range(pp)]
+        stage_clock = [0.0] * pp
+        # iterate op queues round-robin until all done (dependencies always
+        # resolvable because 1F1B is deadlock-free)
+        idx = [0] * pp
+        remaining = sum(len(o) for o in orders)
+        while remaining:
+            progressed = False
+            for s in range(pp):
+                while idx[s] < len(orders[s]):
+                    kind, i = orders[s][idx[s]]
+                    ph = phase_inputs[s]
+                    if kind == "F":
+                        dep = 0.0 if s == 0 else F_end[s - 1][i]
+                        if s > 0 and dep == 0.0:
+                            break  # dependency not ready yet
+                        start = max(stage_clock[s], dep + (ph["p2p"] if s > 0 else 0.0))
+                        end = start + ph["fwd"]
+                        F_end[s][i] = end
+                    else:
+                        dep = 0.0 if s == pp - 1 else B_end[s + 1][i]
+                        if s < pp - 1 and dep == 0.0:
+                            break
+                        start = max(
+                            stage_clock[s], dep + (ph["p2p"] if s < pp - 1 else 0.0)
+                        )
+                        end = start + ph["bwd"]
+                        B_end[s][i] = end
+                    stage_clock[s] = end
+                    idx[s] += 1
+                    remaining -= 1
+                    progressed = True
+            assert progressed, "1F1B schedule deadlocked (internal error)"
+
+        per_stage_end = [stage_clock[s] for s in range(pp)]
+        total = max(per_stage_end)
+        work0 = mbc * (phase_inputs[0]["fwd"] + phase_inputs[0]["bwd"])
+        return {
+            "total": total,
+            "bubble": total - work0,
+            "per_stage_end": per_stage_end,
+        }
+
+    def _compute_dp_time(self) -> dict:
+        """Bucketed DP grad reduce-scatter + param all-gather, dense over
+        dp_cp and MoE over edp (reference ``_compute_dp_time``
+        perf_llm.py:1513-1597)."""
+        st, sysc = self.strategy, self.system
+        # use the busiest stage's parameter set (stage 0)
+        dense_numel = moe_numel = 0.0
+        for c in self.stage_chunks(0):
+            dense_numel += c.param_info.dense_numel
+            moe_numel += c.param_info.moe_numel
+        g_el = 2.0 if st.grad_reduce_in_bf16 else 4.0
+        p_el = st.element_size
+        t = 0.0
+        detail = {}
+        if st.dp_size * st.cp_size > 1 and dense_numel:
+            path = self.ctx.path("dp_cp")
+            op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
+            rs = sysc.compute_net_op_time(op, dense_numel * g_el, path)
+            ag = (
+                sysc.compute_net_op_time("all_gather", dense_numel * p_el, path)
+                if st.zero_state >= 1
+                else 0.0
+            )
+            detail["dense_grad_rs_time"] = rs
+            detail["dense_param_ag_time"] = ag
+            t += rs + ag
+        if st.edp_size > 1 and moe_numel:
+            path = self.ctx.path("edp")
+            op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
+            rs = sysc.compute_net_op_time(op, moe_numel * g_el, path)
+            ag = (
+                sysc.compute_net_op_time("all_gather", moe_numel * p_el, path)
+                if st.zero_state >= 1
+                else 0.0
+            )
+            detail["moe_grad_rs_time"] = rs
+            detail["moe_param_ag_time"] = ag
+            t += rs + ag
+        detail["total"] = t
+        return detail
+
+    def _compute_optim_time(self) -> float:
+        """Megatron distributed-optimizer step phases, memory-bound on HBM
+        (reference ``_compute_optim_time`` perf_llm.py:1470-1511)."""
+        st, sysc = self.strategy, self.system
+        numel = 0.0
+        for c in self.stage_chunks(0):
+            numel += c.param_info.dense_numel + c.param_info.moe_numel
+        shard = numel / max(1, st.dp_size * st.cp_size) if st.zero_state else numel
+        t = 0.0
+        t += sysc.compute_mem_access_time(numel * st.grad_element_size)  # zero grad
+        t += sysc.compute_mem_access_time(shard * 4)  # l2 norm read
+        t += sysc.compute_mem_access_time(shard * 28)  # adam r/w m,v,master+grad
+        t += sysc.compute_mem_access_time(shard * (4 + st.element_size))  # cast copy
+        return t
+
+    def straggler_ratio(self) -> float:
+        """Machine-variance inflation (reference perf_llm.py:255-291)."""
+        st = self.strategy
+        if not st.enable_straggler_model:
+            return 1.0
+        sysc = self.system
+        hosts = max(1, st.world_size // max(1, sysc.chips_per_slice))
+        n = min(hosts, st.dp_size, max(st.edp_size, 1))
+        if n <= 1:
+            return 1.0
+        nhat = math.log2(n)
+        return 1.0 + nhat / (nhat + 1.0) * 0.09 * math.sqrt(nhat)
+
+    def analysis_cost(self) -> dict:
+        if self._cost_result is not None:
+            return self._cost_result
+        st, m = self.strategy, self.model_config
+        phase_inputs = [self._stage_phase_inputs(s) for s in range(st.pp_size)]
+        pp_res = self.calculate_1f1b_bubble(phase_inputs)
+        dp_res = self._compute_dp_time()
+        optim = self._compute_optim_time()
+        iter_time = pp_res["total"] + dp_res["total"] + optim
+        ratio = self.straggler_ratio()
+        iter_time *= ratio
+
+        tokens = st.tokens_per_iter
+        model_flops = m.train_flops_per_token(st.seq_len) * tokens
+        per_chip = model_flops / st.world_size / iter_time
+        peak = self.system.accelerator.op["default"].tflops * 1e12
+        # net exposure accounting (stage 0 representative)
+        chunks0 = self.stage_chunks(0)
+        net_exposed = sum(c.cost_info.total_net_exposed for c in chunks0)
+        result = {
+            "iter_time": iter_time,
+            "iter_time_ms": iter_time * 1e3,
+            "pp_total_time": pp_res["total"],
+            "bubble_time": pp_res["bubble"],
+            "dp_comm": dp_res,
+            "optim_time": optim,
+            "straggle_ratio": ratio,
+            "mfu": per_chip / peak,
+            "tflops_per_chip": per_chip / 1e12,
+            "tokens_per_sec": tokens / iter_time,
+            "tgs": tokens / iter_time / st.world_size,
+            "stage_phase_inputs": phase_inputs,
+            "net_exposed_per_microbatch": net_exposed,
+        }
+        self._cost_result = result
+        return result
+
+    # ------------------------------------------------------------------
+    # Combined report (reference ``analysis`` perf_llm.py:3585-3668)
+    # ------------------------------------------------------------------
+    def analysis(self, save_path: Optional[str] = None, verbose: bool = True) -> dict:
+        mem = self.analysis_mem()
+        cost = self.analysis_cost()
+        st = self.strategy
+        result = {
+            "base_info": {
+                "model": self.model_config.model_name,
+                "system": self.system.sys_name,
+                "world_size": st.world_size,
+                "parallelism": {
+                    "tp": st.tp_size, "cp": st.cp_size, "pp": st.pp_size,
+                    "dp": st.dp_size, "ep": st.ep_size, "etp": st.etp_size,
+                    "vp": st.vp_size,
+                },
+                "seq_len": st.seq_len,
+                "global_batch_size": st.global_batch_size,
+                "param_numel": self.model_config.param_numel(),
+            },
+            "mem_result": mem,
+            "compute_result": cost,
+            "net_info": {k: p.describe() for k, p in self.ctx.paths.items()},
+            "efficiency_misses": self.system.miss_efficiency,
+        }
+        if verbose:
+            self._print_summary(result)
+        if save_path:
+            os.makedirs(save_path, exist_ok=True)
+            for key in ("base_info", "mem_result", "compute_result", "net_info"):
+                with open(os.path.join(save_path, f"{key}.json"), "w") as f:
+                    json.dump(result[key], f, indent=2, default=str)
+        return result
+
+    def _print_summary(self, result: dict):
+        cost, mem = result["compute_result"], result["mem_result"]
+        info = result["base_info"]
+        p = info["parallelism"]
+        print(
+            f"== {info['model']} on {info['system']} "
+            f"(world={info['world_size']} tp={p['tp']} cp={p['cp']} "
+            f"pp={p['pp']} dp={p['dp']} ep={p['ep']}) =="
+        )
+        print(
+            f"iter time {human_time(cost['iter_time'])}  "
+            f"MFU {cost['mfu']*100:.2f}%  "
+            f"TFLOPS/chip {cost['tflops_per_chip']:.1f}  "
+            f"TGS {cost['tgs']:.1f}"
+        )
+        print(
+            f"peak HBM {mem['max_peak_gib']:.2f} GiB / "
+            f"{mem['hbm_capacity_gib']:.0f} GiB  fits={mem['fits']}"
+        )
+        misses = result["efficiency_misses"]
+        if misses:
+            nmiss = sum(len(v) for v in misses.values())
+            print(f"[calibration] {nmiss} efficiency-table misses "
+                  f"(run simumax_tpu.calibration to refine)")
+
+    # simulate() is provided by L5 (simulator package); bound lazily
+    def simulate(self, save_path: str):
+        from simumax_tpu.simulator.runner import run_simulation
+
+        return run_simulation(self, save_path)

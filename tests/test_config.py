@@ -1,0 +1,222 @@
+"""L0 unit tests: configs + TPU cost primitives (hand-computed cases)."""
+
+import math
+
+import pytest
+
+from simumax_tpu.core.config import (
+    ModelConfig,
+    StrategyConfig,
+    SystemConfig,
+    get_model_config,
+    get_system_config,
+    get_strategy_config,
+    list_configs,
+)
+
+
+def make_system(axes=(16, 16), link=45.0, wrap=None):
+    return SystemConfig.init_from_dict(
+        {
+            "sys_name": "test",
+            "accelerator": {
+                "backend": "tpu",
+                "mem_gbs": 16,
+                "op": {"default": {"tflops": 100, "efficient_factor": 0.5}},
+                "bandwidth": {
+                    "default": {"gbps": 800, "efficient_factor": 1.0, "latency_us": 0.0}
+                },
+            },
+            "ici": {
+                "axes": list(axes),
+                "wraparound": wrap if wrap is not None else [True] * len(axes),
+                "link_gbps": link,
+                "latency_us": 0.0,
+                "op": {"default": {"efficient_factor": 1.0}},
+            },
+            "dcn": {"gbps_per_chip": 5.0, "latency_us": 0.0,
+                    "op": {"default": {"efficient_factor": 1.0}}},
+        }
+    )
+
+
+class TestComputePrimitives:
+    def test_compute_time_default_eff(self):
+        sysc = make_system()
+        # 1e12 flops at 100 TFLOPs * 0.5 eff = 0.02 s
+        assert sysc.compute_op_accuracy_time("default", 1e12) == pytest.approx(0.02)
+
+    def test_accurate_factor_hit_and_miss(self):
+        sysc = make_system()
+        sysc.accelerator.op["matmul"] = type(sysc.accelerator.op["default"])(
+            tflops=100, efficient_factor=0.5,
+            accurate_efficient_factor={"k1": 1.0},
+        )
+        t_hit = sysc.compute_op_accuracy_time("matmul", 1e12, "k1")
+        t_miss = sysc.compute_op_accuracy_time("matmul", 1e12, "k2")
+        assert t_hit == pytest.approx(0.01)
+        assert t_miss == pytest.approx(0.02)
+        assert "k1" in sysc.hit_efficiency["matmul"]
+        assert "k2" in sysc.miss_efficiency["matmul"]
+
+    def test_mem_access_time(self):
+        sysc = make_system()
+        # 800 GB at 800 GB/s = 1 s
+        assert sysc.compute_mem_access_time(800e9) == pytest.approx(1.0)
+
+    def test_roofline(self):
+        sysc = make_system()
+        assert sysc.compute_end2end_time(2.0, 1.0) == 2.0
+        assert sysc.compute_end2end_time(1.0, 3.0) == 3.0
+
+
+class TestIciPlacement:
+    def test_tp_innermost_full_axis(self):
+        sysc = make_system(axes=(4, 2))
+        p = sysc.place_group("tp", 1, 4)
+        assert len(p.spans) == 1
+        s = p.spans[0]
+        assert s.extent == 4 and s.wrap and s.kind == "ici"
+        # wrapped full axis: 2 * 45 GB/s
+        assert s.gbps == pytest.approx(90.0)
+
+    def test_partial_axis_no_wrap(self):
+        sysc = make_system(axes=(16, 16))
+        p = sysc.place_group("tp", 1, 4)
+        s = p.spans[0]
+        assert s.extent == 4 and not s.wrap
+        assert s.gbps == pytest.approx(45.0)
+
+    def test_strided_group_shares_links(self):
+        sysc = make_system(axes=(16, 16))
+        p = sysc.place_group("dp", 4, 4)  # strides over tp=4 within axis 0
+        s = p.spans[0]
+        assert s.extent == 4 and s.wrap  # covers the rest of the axis
+        assert s.gbps == pytest.approx(2 * 45.0 / 4)
+
+    def test_multi_axis_span(self):
+        sysc = make_system(axes=(16, 16))
+        p = sysc.place_group("dp", 16, 16)  # axis0 consumed -> full axis1
+        assert len(p.spans) == 1
+        s = p.spans[0]
+        assert s.extent == 16 and s.wrap
+
+    def test_group_spanning_two_axes(self):
+        sysc = make_system(axes=(4, 4))
+        p = sysc.place_group("dp", 1, 16)
+        assert [s.extent for s in p.spans] == [4, 4]
+        assert all(s.wrap for s in p.spans)
+
+    def test_dcn_overflow(self):
+        sysc = make_system(axes=(4, 4))
+        p = sysc.place_group("dp", 4, 16)  # 4 fits, 4 overflows to DCN
+        assert p.spans[-1].kind == "dcn"
+        assert p.spans[-1].extent == 4
+
+
+class TestCollectiveCost:
+    def test_all_gather_full_ring(self):
+        sysc = make_system(axes=(8,), link=50.0)
+        p = sysc.place_group("tp", 1, 8)
+        v = 100e9  # bytes
+        t = sysc.compute_net_op_time("all_gather", v, p)
+        # ring: V*(n-1)/n / (2*link)
+        expect = v * 7 / 8 / (2 * 50e9)
+        assert t == pytest.approx(expect, rel=1e-6)
+
+    def test_all_reduce_is_twice_all_gather(self):
+        sysc = make_system(axes=(8,))
+        p = sysc.place_group("tp", 1, 8)
+        ag = sysc.compute_net_op_time("all_gather", 1e9, p)
+        ar = sysc.compute_net_op_time("all_reduce", 1e9, p)
+        assert ar == pytest.approx(2 * ag, rel=1e-6)
+
+    def test_hierarchical_equals_flat_ring(self):
+        # equal-bandwidth 2D decomposition must match the 1D ring bound
+        sysc1 = make_system(axes=(16,))
+        sysc2 = make_system(axes=(4, 4))
+        p1 = sysc1.place_group("g", 1, 16)
+        p2 = sysc2.place_group("g", 1, 16)
+        t1 = sysc1.compute_net_op_time("all_gather", 1e9, p1)
+        t2 = sysc2.compute_net_op_time("all_gather", 1e9, p2)
+        assert t1 == pytest.approx(t2, rel=1e-6)
+
+    def test_all2all_2d_cheaper_than_1d(self):
+        sysc1 = make_system(axes=(16,))
+        sysc2 = make_system(axes=(4, 4))
+        t1 = sysc1.compute_net_op_time(
+            "all2all", 1e9, sysc1.place_group("g", 1, 16)
+        )
+        t2 = sysc2.compute_net_op_time(
+            "all2all", 1e9, sysc2.place_group("g", 1, 16)
+        )
+        assert t2 < t1  # bisection advantage of the 2D torus
+
+    def test_p2p_single_link(self):
+        sysc = make_system(axes=(8,), link=50.0)
+        p = sysc.place_group("pp", 1, 8)
+        t = sysc.compute_net_op_time("p2p", 1e9, p)
+        assert t == pytest.approx(1e9 / 50e9, rel=1e-6)
+
+    def test_dcn_slower_than_ici(self):
+        sysc = make_system(axes=(4,))
+        ici = sysc.compute_net_op_time("all_gather", 1e9, sysc.place_group("a", 1, 4))
+        mixed = sysc.compute_net_op_time(
+            "all_gather", 1e9, sysc.place_group("b", 1, 16)
+        )
+        assert mixed > ici
+
+
+class TestModelConfig:
+    def test_llama3_8b_param_count(self):
+        m = get_model_config("llama3-8b")
+        m.maybe_pad_vocab_size(1)
+        n = m.param_numel()
+        # ~8B params (untied embeddings push it slightly above)
+        assert 7.5e9 < n < 8.6e9
+
+    def test_llama3_70b_param_count(self):
+        m = get_model_config("llama3-70b")
+        m.maybe_pad_vocab_size(1)
+        assert 69e9 < m.param_numel() < 72e9
+
+    def test_deepseekv2_param_count(self):
+        m = get_model_config("deepseekv2")
+        m.maybe_pad_vocab_size(1)
+        n = m.param_numel()
+        assert 220e9 < n < 250e9  # DeepSeek-V2 is ~236B
+
+    def test_vocab_padding(self):
+        m = ModelConfig(hidden_size=128, head_num=4, layer_num=1, vocab_size=1000)
+        assert m.maybe_pad_vocab_size(8) == 1024
+
+    def test_flops_per_token_8b(self):
+        m = get_model_config("llama3-8b")
+        m.maybe_pad_vocab_size(1)
+        f = m.flops_per_token(seq_len=4096)
+        # 2*active_params + attention term; ~2.2e10 for 8B @ 4k
+        assert 1.5e10 < f < 3.5e10
+
+
+class TestStrategyConfig:
+    def test_derived_sizes(self):
+        st = StrategyConfig(world_size=64, tp_size=4, pp_size=2, cp_size=2)
+        assert st.dp_size == 4
+        assert st.global_batch_size == 4 * st.micro_batch_size * st.micro_batch_num
+
+    def test_format_string(self):
+        st = StrategyConfig.init_from_format_strings("tp2_pp2_dp2_mbs1_mbc8")
+        assert st.tp_size == 2 and st.pp_size == 2 and st.world_size == 8
+        assert st.micro_batch_num == 8
+
+    def test_sanity(self):
+        st = StrategyConfig(world_size=7, tp_size=2)
+        with pytest.raises(AssertionError):
+            st.sanity_check()
+
+    def test_registry(self):
+        cfgs = list_configs()
+        assert "llama3-8b" in cfgs["models"]
+        assert "tpu_v5e_256" in cfgs["system"]
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        assert st.pp_size == 2

@@ -1,0 +1,176 @@
+"""E2E analytical-path tests for dense models (reference test strategy §4:
+invariants + closed-form cross-checks instead of golden GPU numbers)."""
+
+import pytest
+
+from simumax_tpu import PerfLLM, StrategyConfig
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+
+def run(strategy, model="llama3-8b", system="tpu_v5e_256", **overrides):
+    p = PerfLLM()
+    if isinstance(strategy, str):
+        st = get_strategy_config(strategy)
+    else:
+        st = strategy
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    p.configure(st, model, system)
+    p.run_estimate()
+    return p
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize(
+        "name",
+        [
+            "tp1_pp1_dp8_mbs1",
+            "tp1_pp2_dp4_mbs1",
+            "tp2_pp1_dp4_mbs1",
+            "tp4_pp1_dp2_mbs1",
+            "tp8_pp1_dp1_mbs1",
+            "tp2_pp1_dp4_mbs1_full_recompute",
+            "tp2_pp1_dp4_mbs1_selective_recompute",
+        ],
+    )
+    def test_runs_and_sane(self, name):
+        p = run(name)
+        cost = p.analysis_cost()
+        mem = p.analysis_mem()
+        assert 0.0 < cost["mfu"] < 1.0
+        assert cost["iter_time"] > 0
+        assert mem["max_peak_bytes"] > 0
+        for s in mem["stages"]:
+            assert s["model_bytes"] > 0
+
+    def test_tp_shards_weights_and_cache(self):
+        p1 = run("tp1_pp1_dp8_mbs1")
+        p4 = run("tp4_pp1_dp2_mbs1")
+        m1 = p1.analysis_mem()["stages"][0]
+        m4 = p4.analysis_mem()["stages"][0]
+        assert m4["model_bytes"] < 0.5 * m1["model_bytes"]
+        # SP shards activations by tp too
+        assert (
+            m4["act_cache_per_microbatch_bytes"]
+            < 0.5 * m1["act_cache_per_microbatch_bytes"]
+        )
+
+    def test_full_recompute_cuts_cache_costs_time(self):
+        base = run("tp2_pp1_dp4_mbs1")
+        rc = run("tp2_pp1_dp4_mbs1_full_recompute")
+        mb, mr = base.analysis_mem(), rc.analysis_mem()
+        assert (
+            mr["stages"][0]["act_cache_per_microbatch_bytes"]
+            < 0.2 * mb["stages"][0]["act_cache_per_microbatch_bytes"]
+        )
+        assert rc.analysis_cost()["iter_time"] > base.analysis_cost()["iter_time"]
+
+    def test_selective_between_none_and_full(self):
+        none = run("tp2_pp1_dp4_mbs1")
+        sel = run("tp2_pp1_dp4_mbs1_selective_recompute")
+        full = run("tp2_pp1_dp4_mbs1_full_recompute")
+        c = lambda p: p.analysis_mem()["stages"][0][
+            "act_cache_per_microbatch_bytes"
+        ]
+        assert c(full) < c(sel) < c(none)
+
+    def test_zero1_shards_optimizer_state(self):
+        z0 = run("tp1_pp1_dp8_mbs1", zero_state=0)
+        z1 = run("tp1_pp1_dp8_mbs1", zero_state=1)
+        s0 = z0.analysis_mem()["stages"][0]["model_bytes"]
+        s1 = z1.analysis_mem()["stages"][0]["model_bytes"]
+        assert s1 < s0
+
+
+class TestClosedFormCrossChecks:
+    def test_activation_cache_matches_analytic_formula(self):
+        """Per-layer bf16 activation bytes for flash + swiglu + no dropout,
+        tp=1: ln(2sbh)+qkv(2sbh)+q,k,v,o(2sbh(2+2r))+lse(4sbA? fp32)
+        +out(2sbh)+ln(2sbh)+up(2sbh)+swiglu(4sbf)+down(2sbf)."""
+        m = get_model_config("llama3-8b")
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        p = run(st)
+        chunk = p.chunks[(0, 0)]
+        blk = chunk.blocks[0]
+        s, b, h = st.seq_len, st.micro_batch_size, m.hidden_size
+        f = m.intermediate_size
+        r = m.kv_head_num / m.head_num
+        expect = (
+            2 * s * b * h  # ln1 input
+            + s * b * 4  # rstd
+            + 2 * s * b * h  # qkv input
+            + 2 * s * b * h * (2 + 2 * r)  # q,k,v,o flash cache
+            + 4 * s * b * m.head_num  # lse fp32
+            + 2 * s * b * h  # out-proj input
+            + 2 * s * b * h + s * b * 4  # ln2
+            + 2 * s * b * h  # up input
+            + 4 * s * b * f  # swiglu input (2f)
+            + 2 * s * b * f  # down input
+        )
+        assert blk.act_info.cache_bytes == pytest.approx(expect, rel=0.01)
+
+    def test_linear_flops(self):
+        """qkv projection FLOPs = 2 * s*b * h * (q+2kv head dims)."""
+        m = get_model_config("llama3-8b")
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        p = run(st)
+        qkv = p.chunks[(0, 0)].blocks[0].attention.qkv_proj
+        s, b, h = st.seq_len, st.micro_batch_size, m.hidden_size
+        nout = (m.head_num + 2 * m.kv_head_num) * m.head_size
+        assert qkv.compute_info.fwd_flops == pytest.approx(2 * s * b * h * nout)
+
+    @pytest.mark.parametrize("pp,mbc", [(2, 8), (4, 8), (4, 16), (8, 8)])
+    def test_1f1b_closed_form(self, pp, mbc):
+        """Uniform stages, zero p2p: T = (pp-1+mbc)*(tf+tb) exactly."""
+        p = run("tp1_pp2_dp4_mbs1")
+        p.strategy.pp_size = pp
+        p.strategy.micro_batch_num = mbc
+        tf, tb = 1.0, 2.0
+        phases = [{"fwd": tf, "bwd": tb, "p2p": 0.0} for _ in range(pp)]
+        res = p.calculate_1f1b_bubble(phases)
+        assert res["total"] == pytest.approx((pp - 1 + mbc) * (tf + tb))
+        assert res["bubble"] == pytest.approx((pp - 1) * (tf + tb))
+
+    def test_1f1b_with_p2p_adds_latency(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        p.strategy.pp_size = 4
+        phases = [{"fwd": 1.0, "bwd": 2.0, "p2p": 0.1} for _ in range(4)]
+        res = p.calculate_1f1b_bubble(phases)
+        assert res["total"] > (4 - 1 + 8) * 3.0
+
+    def test_param_accounting_matches_model_config(self):
+        """Sum of per-leaf dense numel across stages ~= param_numel."""
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        p = run(st)
+        total = sum(
+            c.param_info.dense_numel + c.param_info.moe_numel
+            for c in p.chunks.values()
+        )
+        assert total == pytest.approx(p.model_config.param_numel(), rel=1e-6)
+
+    def test_mfu_definition(self):
+        p = run("tp1_pp1_dp8_mbs1")
+        cost = p.analysis_cost()
+        st, m = p.strategy, p.model_config
+        flops = m.train_flops_per_token(st.seq_len) * st.tokens_per_iter
+        peak = p.system.accelerator.op["default"].tflops * 1e12
+        expect = flops / st.world_size / cost["iter_time"] / peak
+        assert cost["mfu"] == pytest.approx(expect)
+
+
+class TestMemoryModel:
+    def test_pp_stage0_holds_more_microbatches(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        mem = p.analysis_mem()
+        assert mem["stages"][0]["live_microbatches"] == 2
+        assert mem["stages"][1]["live_microbatches"] == 1
+
+    def test_model_mem_breakdown_8b(self):
+        """tp1 pp1 dp8 zero1: weights 2B/el + fp32 grads 4B/el +
+        state 12B/el / 8."""
+        p = run("tp1_pp1_dp8_mbs1")
+        n = p.model_config.param_numel()
+        expect = n * (2 + 4 + 12 / 8)
+        got = p.analysis_mem()["stages"][0]["model_bytes"]
+        assert got == pytest.approx(expect, rel=1e-6)
