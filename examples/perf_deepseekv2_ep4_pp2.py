@@ -1,0 +1,29 @@
+"""DeepSeek-V2 (layer-truncated l4, as in the reference's B200 release
+table) with EP4 + PP2: MoE EP all-to-all + MLA over ICI
+(north-star config 3)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config
+
+
+def main(layer_num: int = 4):
+    model = get_model_config("deepseekv2")
+    model.layer_num = layer_num
+    model.dense_layers = 1
+    perf = PerfLLM()
+    perf.configure(
+        strategy="ep4_pp2_dp4_mbs1",
+        model=model,
+        system="tpu_v5p_256",
+    )
+    perf.run_estimate()
+    return perf.analysis()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 4)

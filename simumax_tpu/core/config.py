@@ -318,9 +318,7 @@ class RecomputeConfig:
             granularity=gran,
             recompute_layer_num=d.get("recompute_layer_num", -1),
             attn_recompute=d.get("attn_recompute", False),
-            attn_norm_recompute=d.get(
-                "attn_norm_recompute", d.get("mla_rms_recompute", False)
-            ),
+            attn_norm_recompute=d.get("attn_norm_recompute", False),
             mlp_recompute=d.get("mlp_recompute", False),
             mlp_norm_recompute=d.get("mlp_rms_recompute", False),
             sdp_recompute=d.get("sdp_recompute", False),
@@ -383,6 +381,7 @@ class StrategyConfig(ConfigBase):
     etp_size: int = 1
 
     moe_dispatcher_policy: str = "all2all"
+    moe_capacity_factor: float = 0.0  # 0 => dropless (balanced assumption)
     enable_sequence_parallel: bool = True
     cp_comm_type: str = "a2a"  # a2a (Ulysses) | all_gather (ring/KV-gather)
     cp_a2a_mode: str = "sync_cp"  # sync_cp | async_cp
@@ -426,8 +425,9 @@ class StrategyConfig(ConfigBase):
                 "recompute_granularity": self.recompute_granularity,
                 "recompute_layer_num": self.recompute_layer_num,
                 "attn_recompute": self.attn_recompute,
-                "attn_norm_recompute": self.attn_norm_recompute,
-                "mla_rms_recompute": self.mla_rms_recompute,
+                "attn_norm_recompute": (
+                    self.attn_norm_recompute or self.mla_rms_recompute
+                ),
                 "mlp_recompute": self.mlp_recompute,
                 "mlp_rms_recompute": self.mlp_rms_recompute,
                 "sdp_recompute": self.sdp_recompute,

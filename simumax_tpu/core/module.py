@@ -271,9 +271,11 @@ class MetaModule:
 
     # -- recompute marking (reference ``base_struct.py:499-529``) ----------
     def mark_recompute(self):
-        """Mark this subtree as one checkpointed segment."""
+        """Mark this subtree as one checkpointed segment. Leaves already
+        claimed by another segment (e.g. sdp-only inside a checkpointed
+        attention) keep their original segment."""
         self.recompute = True
-        leaves = list(self.leaves())
+        leaves = [l for l in self.leaves() if not l.in_recompute]
         for i, leaf in enumerate(leaves):
             leaf.in_recompute = True
             leaf.recompute_segment = self

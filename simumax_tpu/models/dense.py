@@ -177,14 +177,17 @@ class LinearCol(GemmBase):
     """
 
     def __init__(self, ctx, in_features, out_features, name="linear_col",
-                 quantized=False, skip_comm=False):
+                 quantized=False, skip_comm=False, replicated=False):
         super().__init__(ctx, name, quantized=quantized)
         st = _st(ctx)
         self.in_features = in_features
         self.out_features = out_features
-        self.out_local = out_features // st.tp_size
+        # replicated: weight duplicated on every TP rank, rows stay
+        # seq-sharded, no collectives (MLA down-projections)
+        self.replicated = replicated
+        self.out_local = out_features // (1 if replicated else st.tp_size)
         self.numel = in_features * self.out_local
-        self.skip_comm = skip_comm  # e.g. duplicated (non-TP) linear
+        self.skip_comm = skip_comm or replicated
 
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         st = _st(self.ctx)
@@ -448,36 +451,55 @@ class ContextParallelA2A(LeafModule):
         return ActivationInfo(fwd_temp_bytes=self.inputs[0].bytes)
 
 
-class KVAllGather(LeafModule):
-    """CP ``all_gather`` (ring-attention family) KV gather: fwd all-gather
-    of k or v over cp, bwd reduce-scatter of its grad. The reference only
-    costs the net time and raises on flops (``dense_module.py:1521-1524``);
-    here it is a complete op."""
-
-    def forward_spec(self, x: TensorSpec) -> TensorSpec:
-        cp = _st(self.ctx).cp_size
-        b, s, hl, d = x.shape
-        return x.with_shape(b, s * cp, hl, d)
-
-    def collectives(self) -> List[CollectiveCall]:
-        st = _st(self.ctx)
-        if st.cp_size == 1:
-            return []
-        full = self.outputs[0].bytes
-        return [
-            CollectiveCall("fwd", "all_gather", "cp", full, "pre"),
-            CollectiveCall("bwd_act", "reduce_scatter", "cp", full, "post"),
-        ]
-
-    def activation_info(self) -> ActivationInfo:
-        # gathered KV live through attention fwd (and re-gathered in bwd)
-        full = self.outputs[0].bytes
-        return ActivationInfo(fwd_temp_bytes=full, bwd_temp_bytes=full)
 
 
 # --------------------------------------------------------------------------
 # Activations / losses
 # --------------------------------------------------------------------------
+
+
+class SeqAllGather(LeafModule):
+    """Gather a seq-sharded tensor over a parallel dim (fwd all-gather,
+    bwd-act reduce-scatter) — used for e.g. the MLA RoPE branch whose
+    producer is a replicated linear outside the column-parallel gather."""
+
+    def __init__(self, ctx, dim="tp", name="seq_allgather"):
+        super().__init__(ctx, name)
+        self.dim = dim
+
+    def _group(self) -> int:
+        return getattr(_st(self.ctx), f"{self.dim}_size")
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        g = self._group()
+        return x.with_shape(x.shape[0], x.shape[1] * g, *x.shape[2:])
+
+    def collectives(self) -> List[CollectiveCall]:
+        if self._group() == 1:
+            return []
+        full = self.outputs[0].bytes
+        return [
+            CollectiveCall("fwd", "all_gather", self.dim, full, "pre"),
+            CollectiveCall("bwd_act", "reduce_scatter", self.dim, full, "post"),
+        ]
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(fwd_temp_bytes=self.outputs[0].bytes)
+
+
+class KVAllGather(SeqAllGather):
+    """CP ``all_gather`` (ring-attention family) KV gather: fwd all-gather
+    of k or v over cp, bwd reduce-scatter of its grad. The reference only
+    costs the net time and raises on flops (``dense_module.py:1521-1524``);
+    here it is a complete op. The gathered copy also stays live through
+    the attention backward (re-gathered), unlike the plain SeqAllGather."""
+
+    def __init__(self, ctx, name="kv_allgather"):
+        super().__init__(ctx, dim="cp", name=name)
+
+    def activation_info(self) -> ActivationInfo:
+        full = self.outputs[0].bytes
+        return ActivationInfo(fwd_temp_bytes=full, bwd_temp_bytes=full)
 
 
 class Swiglu(LeafModule):

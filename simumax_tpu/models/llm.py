@@ -84,6 +84,9 @@ class LLMBlock(MetaModule):
             self.attention.mark_recompute()
         if rc.attn_norm_recompute:
             self.input_norm.mark_recompute()
+            # MLA internal rms norms (reference mla_rms_recompute)
+            for norm in getattr(self.attention, "norms", []):
+                norm.mark_recompute()
         if rc.mlp_recompute:
             self.mlp.mark_recompute()
         if rc.mlp_norm_recompute:
@@ -197,14 +200,18 @@ class LLMModel(MetaModule):
             live += leaf.act_info.cache_bytes
             bump(leaf.path_name(), "fwd", live + leaf.raw_act_info.fwd_temp_bytes)
 
-        # ---- backward walk with recompute replay
-        replayed = set()
+        # ---- backward walk with recompute replay. Segments need not be
+        # contiguous in the call order (e.g. sdp-only inside a
+        # checkpointed attention), so consumed leaves are tracked in a set.
+        done = set()
         i = len(leaves) - 1
         while i >= 0:
             leaf = leaves[i]
+            if id(leaf) in done:
+                i -= 1
+                continue
             seg = getattr(leaf, "recompute_segment", None)
-            if leaf.in_recompute and seg is not None and id(seg) not in replayed:
-                replayed.add(id(seg))
+            if leaf.in_recompute and seg is not None:
                 seg_leaves = [
                     l
                     for l in leaves
@@ -223,10 +230,12 @@ class LLMModel(MetaModule):
                 for sl in reversed(seg_leaves):
                     bump(sl.path_name(), "bwd", live + sl.raw_act_info.bwd_temp_bytes)
                     live -= sl.raw_act_info.cache_bytes
-                i -= len(seg_leaves)
+                    done.add(id(sl))
+                i -= 1
                 continue
             bump(leaf.path_name(), "bwd", live + leaf.raw_act_info.bwd_temp_bytes)
             live -= leaf.act_info.cache_bytes
+            done.add(id(leaf))
             i -= 1
 
         assert abs(live) < 1024, (

@@ -1,0 +1,212 @@
+"""MoE/EP, MLA and context-parallel path tests."""
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+
+def run(strategy, model, system="tpu_v5p_256", model_tweak=None, **overrides):
+    p = PerfLLM()
+    st = get_strategy_config(strategy) if isinstance(strategy, str) else strategy
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    m = get_model_config(model) if isinstance(model, str) else model
+    if model_tweak:
+        model_tweak(m)
+    p.configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+class TestMoE:
+    @pytest.mark.parametrize(
+        "strat,model",
+        [
+            ("ep8_pp1_dp8_mbs1", "mixtral-8x7b"),
+            ("ep4_pp2_dp4_mbs1", "deepseekv2"),
+            ("ep4_pp2_dp4_mbs1_full_recompute", "deepseekv2"),
+            ("ep4_pp2_dp4_mbs1_selective_recompute", "deepseekv2"),
+            ("tp2_pp1_dp4_mbs1", "deepseekv2-lite"),
+            ("ep8_pp1_dp8_mbs1", "deepseekv3"),
+        ],
+    )
+    def test_runs(self, strat, model):
+        p = run(strat, model)
+        c, m = p.analysis_cost(), p.analysis_mem()
+        assert 0 < c["mfu"] < 1
+        assert m["max_peak_bytes"] > 0
+
+    def test_ep_shards_expert_weights(self):
+        p1 = run("tp1_pp1_dp8_mbs1", "mixtral-8x7b", ep_size=1)
+        p8 = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b")
+        moe1 = sum(c.param_info.moe_weight_bytes for c in p1.chunks.values())
+        moe8 = sum(c.param_info.moe_weight_bytes for c in p8.chunks.values())
+        assert moe8 == pytest.approx(moe1 / 8, rel=1e-6)
+
+    def test_ep_a2a_collectives_present(self):
+        p = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b")
+        chunk = p.chunks[(0, 0)]
+        a2a = [
+            c
+            for c in chunk.collective_calls
+            if c.op == "all2all" and c.dim == "ep"
+        ]
+        # dispatch + combine, fwd + bwd each, per moe layer (32 layers)
+        assert len(a2a) == 4 * 32
+
+    def test_moe_param_count_deepseekv2(self):
+        """Per-chunk accounting reconstructs the global count: dense
+        params are replicated over ep (tp=1 here), MoE params sharded."""
+        p = run("ep8_pp1_dp8_mbs1", "deepseekv2")
+        dense = sum(c.param_info.dense_numel for c in p.chunks.values())
+        moe = sum(c.param_info.moe_numel for c in p.chunks.values())
+        total = dense + moe * p.strategy.ep_size
+        assert total == pytest.approx(p.model_config.param_numel(), rel=1e-6)
+
+    def test_grouped_gemm_flops_match_tokens(self):
+        p = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b")
+        up = p.chunks[(0, 0)].blocks[0].mlp.experts_up
+        st, m = p.strategy, p.model_config
+        t0 = st.micro_batch_size * st.seq_len  # sp off? sp on -> /tp=1
+        tokens = t0 * m.topk
+        fan = 2 * m.moe_ffn_hidden_size
+        assert up.compute_info.fwd_flops == pytest.approx(
+            2 * tokens * m.hidden_size * fan
+        )
+
+    def test_etp_sharding(self):
+        p = run(
+            "tp2_pp1_dp4_mbs1", "deepseekv2-lite", ep_size=2, etp_size=2
+        )
+        up = p.chunks[(0, 0)].blocks[1].mlp.experts_up
+        m = p.model_config
+        assert up.out_features == 2 * m.moe_ffn_hidden_size // 2
+
+
+class TestMLA:
+    def test_mla_runs_and_has_lora_projections(self):
+        p = run("ep4_pp2_dp4_mbs1", "deepseekv2")
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        assert hasattr(attn, "q_down") and hasattr(attn, "kv_up")
+        m = p.model_config
+        assert attn.q_down.numel == m.hidden_size * m.q_lora_rank
+
+    def test_mla_lite_has_no_q_lora(self):
+        p = run("tp2_pp1_dp4_mbs1", "deepseekv2-lite")
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        assert hasattr(attn, "q_proj") and not hasattr(attn, "q_down")
+
+    def test_mla_core_dims(self):
+        p = run("ep4_pp2_dp4_mbs1", "deepseekv2")
+        core = p.chunks[(0, 0)].blocks[0].attention.core
+        m = p.model_config
+        q = core.inputs[0]
+        v = core.inputs[2]
+        assert q.shape[-1] == m.qk_head_dim + m.qk_pos_emb_head_dim
+        assert v.shape[-1] == m.v_head_dim
+
+    def test_mla_rms_recompute_marks_internal_norms(self):
+        p = run("ep4_pp2_dp4_mbs1_selective_recompute", "deepseekv2")
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        assert attn.kv_norm.in_recompute
+
+    def test_mla_rms_recompute_alone(self):
+        """mla_rms_recompute without attn_recompute must still mark the
+        MLA-internal norms (regression: flag was silently dropped)."""
+        st = get_strategy_config("ep4_pp2_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective_recompute"
+        st.mla_rms_recompute = True
+        p = run(st, "deepseekv2")
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        assert attn.kv_norm.in_recompute and attn.q_norm.in_recompute
+        assert not attn.q_up.in_recompute  # only the norms
+
+    def test_attn_only_recompute_mla_conserves(self):
+        """attn_only + MLA: overlapping norm/attention segments must not
+        break the activation conservation replay (regression test)."""
+        st = get_strategy_config("ep4_pp2_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "attn_only"
+        p = run(st, "deepseekv2")  # run_estimate asserts conservation
+        assert p.analysis_mem()["max_peak_bytes"] > 0
+
+    def test_sdp_inside_full_block(self):
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective_recompute"
+        st.sdp_recompute = True
+        st.attn_recompute = True
+        p = run(st, "llama3-8b")
+        core = p.chunks[(0, 0)].blocks[0].attention.core
+        qkv = p.chunks[(0, 0)].blocks[0].attention.qkv_proj
+        assert core.recompute_segment is not qkv.recompute_segment
+        assert p.analysis_mem()["max_peak_bytes"] > 0
+
+
+class TestContextParallel:
+    def _cp_strategy(self, cp, comm_type="a2a", seq=32768, mode="sync_cp"):
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st.cp_size = cp
+        st.seq_len = seq
+        st.micro_batch_num = 4
+        st.cp_comm_type = comm_type
+        st.cp_a2a_mode = mode
+        st.__post_init__()
+        return st
+
+    def test_cp_a2a_runs(self):
+        m = get_model_config("llama3-70b")
+        m.layer_num = 12
+        p = PerfLLM().configure(self._cp_strategy(8), m, "tpu_v5p_256")
+        p.run_estimate()
+        assert p.analysis_cost()["mfu"] > 0
+
+    def test_cp_a2a_full_seq_attention_on_head_shard(self):
+        m = get_model_config("llama3-70b")
+        m.layer_num = 2
+        p = PerfLLM().configure(self._cp_strategy(8), m, "tpu_v5p_256")
+        p.run_estimate()
+        core = p.chunks[(0, 0)].blocks[0].attention.core
+        q = core.inputs[0]
+        assert q.shape[1] == 32768  # full sequence
+        assert q.shape[2] == m.head_num // 8  # heads sharded by cp
+
+    def test_cp_ring_variant_complete(self):
+        """all_gather (ring-family) CP: net + flops + memory all modeled
+        (reference raises NotImplementedError on this path)."""
+        m = get_model_config("llama3-70b")
+        m.layer_num = 2
+        p = PerfLLM().configure(
+            self._cp_strategy(8, comm_type="all_gather"), m, "tpu_v5p_256"
+        )
+        p.run_estimate()
+        core = p.chunks[(0, 0)].blocks[0].attention.core
+        q, k, _ = core.inputs
+        assert q.shape[1] == 32768 // 8  # local queries
+        assert k.shape[1] == 32768  # gathered keys
+        assert p.analysis_cost()["iter_time"] > 0
+
+    def test_cp_reduces_activation_per_chip(self):
+        m = get_model_config("llama3-70b")
+        m.layer_num = 4
+        p1 = PerfLLM().configure(self._cp_strategy(1), m, "tpu_v5p_256")
+        p8 = PerfLLM().configure(self._cp_strategy(8), m, "tpu_v5p_256")
+        p1.run_estimate()
+        p8.run_estimate()
+        c1 = p1.analysis_mem()["stages"][0]["act_cache_per_microbatch_bytes"]
+        c8 = p8.analysis_mem()["stages"][0]["act_cache_per_microbatch_bytes"]
+        assert c8 < c1 / 6  # ~1/8 with some fixed overhead
+
+    def test_async_cp_hides_a2a(self):
+        m = get_model_config("llama3-70b")
+        m.layer_num = 4
+        ps = PerfLLM().configure(self._cp_strategy(8, mode="sync_cp"), m, "tpu_v5p_256")
+        pa = PerfLLM().configure(self._cp_strategy(8, mode="async_cp"), m, "tpu_v5p_256")
+        ps.run_estimate()
+        pa.run_estimate()
+        ts = ps.analysis_cost()["iter_time"]
+        ta = pa.analysis_cost()["iter_time"]
+        assert ta < ts
