@@ -1,0 +1,34 @@
+"""Run the discrete-event simulator on a tiny llama and export a Chrome
+trace + memory snapshot (load trace.json in Perfetto / chrome://tracing).
+
+Mirrors the reference's ``examples/simulator_trace_snapshot.py``.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+
+
+def main(save_path="tmp/simu_artifacts"):
+    perf = PerfLLM()
+    perf.configure(
+        strategy="tp1_pp2_dp4_mbs1",
+        model="llama2-tiny",
+        system="tpu_v5e_256",
+    )
+    perf.run_estimate()
+    result = perf.simulate(save_path)
+    print(f"simulated iteration: {result['end_time_ms']:.2f} ms "
+          f"({result['num_events']} events)")
+    for m in result["memory"]:
+        print(f"  stage {m['rank']}: peak {m['peak_gib']:.2f} GiB "
+              f"at {m['peak_time_ms']:.1f} ms")
+    print(f"trace: {result['trace_path']}")
+    return result
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "tmp/simu_artifacts")

@@ -298,20 +298,13 @@ class PerfLLM(PerfBase):
             total = mbc * (ph["fwd"] + ph["bwd"])
             return {"total": total, "bubble": 0.0, "per_stage_end": [total]}
 
-        # build the standard Megatron 1F1B op order per stage
-        orders: List[List[tuple]] = []
-        for s in range(pp):
-            w = min(mbc, pp - s - 1)
-            ops = [("F", i) for i in range(w)]
-            f, b = w, 0
-            while f < mbc or b < mbc:
-                if f < mbc:
-                    ops.append(("F", f))
-                    f += 1
-                if b < mbc:
-                    ops.append(("B", b))
-                    b += 1
-            orders.append(ops)
+        # standard Megatron 1F1B op order per stage (shared with the
+        # event simulator so the cross-check cannot desynchronize)
+        from simumax_tpu.parallel.pipeline import one_f_one_b_order
+
+        orders: List[List[tuple]] = [
+            one_f_one_b_order(pp, s, mbc) for s in range(pp)
+        ]
 
         F_end = [[0.0] * mbc for _ in range(pp)]
         B_end = [[0.0] * mbc for _ in range(pp)]
@@ -524,7 +517,7 @@ class PerfLLM(PerfBase):
                   f"(run simumax_tpu.calibration to refine)")
 
     # simulate() is provided by L5 (simulator package); bound lazily
-    def simulate(self, save_path: str):
+    def simulate(self, save_path: Optional[str] = None, **kwargs):
         from simumax_tpu.simulator.runner import run_simulation
 
-        return run_simulation(self, save_path)
+        return run_simulation(self, save_path, **kwargs)

@@ -1,0 +1,1 @@
+from simumax_tpu.simulator.runner import run_simulation  # noqa: F401

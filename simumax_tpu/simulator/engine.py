@@ -1,0 +1,211 @@
+"""Discrete-event virtual-time engine (L5).
+
+Reference: ``simumax/core/base_struct.py:1225-2004`` (``BarrierBackend``,
+``P2PBackend``, ``SimuThread`` lanes, ``SimuSystem.simu`` heap loop,
+``SimuContext`` comm state).
+
+Redesign: the reference drives real OS threads with rendezvous locks;
+here each simulated rank is a *generator coroutine* yielding typed
+requests to a deterministic scheduler — no real concurrency, perfectly
+reproducible, and the engine's invariants (queue ordering, deadlock
+detection with a full state dump) are kept as hard errors.
+
+Request vocabulary (yielded by rank coroutines):
+
+* ``("compute", duration, name, lane)`` — advance this rank's lane clock
+* ``("collective", key, duration, name, peers)`` — rendezvous of
+  ``peers``; completes at ``max(arrival) + duration`` for everyone
+* ``("send", dst, tag, duration, name, lane)`` — non-blocking post
+  (async isend semantics: sender's clock does not advance)
+* ``("recv", src, tag, name, lane)`` — blocks until the matching send's
+  data has arrived (``send_post_time + duration``)
+* ``("advance", t)`` — jump lane clock to at least t
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Generator, List, Optional, Tuple
+
+
+@dataclass
+class TraceEvent:
+    rank: int
+    lane: str
+    name: str
+    start: float
+    end: float
+    kind: str = "compute"  # compute | comm | p2p | wait | marker
+    flow_id: Optional[int] = None  # links send->recv arrows
+
+
+@dataclass
+class _Rendezvous:
+    peers: frozenset
+    arrivals: Dict[int, float] = field(default_factory=dict)
+    duration: float = 0.0
+
+    @property
+    def complete(self) -> bool:
+        return set(self.arrivals) == set(self.peers)
+
+    @property
+    def end_time(self) -> float:
+        return max(self.arrivals.values()) + self.duration
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+class SimuEngine:
+    """Deterministic multi-rank virtual-time executor."""
+
+    def __init__(self, num_ranks: int):
+        self.num_ranks = num_ranks
+        self.clock = [0.0] * num_ranks  # per-rank main lane clock
+        self.events: List[TraceEvent] = []
+        self._procs: List[Optional[Generator]] = [None] * num_ranks
+        self._pending: List[Optional[tuple]] = [None] * num_ranks
+        self._done = [False] * num_ranks
+        self._collectives: Dict[tuple, _Rendezvous] = {}
+        self._coll_seq: Dict[tuple, int] = {}
+        self._sends: Dict[tuple, Tuple[float, float]] = {}  # (src,dst,tag) -> (post, dur)
+        self._send_seq: Dict[tuple, int] = {}
+        self._recv_seq: Dict[tuple, int] = {}
+        self._flow_ids: Dict[tuple, int] = {}
+        self._next_flow = 0
+        self.mem_hooks: List[Callable[[int, str, float], None]] = []
+
+    def add_rank(self, rank: int, proc: Generator):
+        self._procs[rank] = proc
+
+    # -- engine loop -------------------------------------------------------
+    def run(self) -> float:
+        # prime every coroutine to its first request
+        for r in range(self.num_ranks):
+            self._advance_rank(r, None)
+        while not all(self._done):
+            progressed = False
+            # serve ranks in clock order for determinism
+            order = sorted(range(self.num_ranks), key=lambda r: self.clock[r])
+            for r in order:
+                if self._done[r] or self._pending[r] is None:
+                    continue
+                if self._try_serve(r):
+                    progressed = True
+            if not progressed:
+                self._deadlock_dump()
+        return max(self.clock)
+
+    def _advance_rank(self, rank: int, value):
+        proc = self._procs[rank]
+        try:
+            req = proc.send(value)
+        except StopIteration:
+            self._done[rank] = True
+            self._pending[rank] = None
+            return
+        self._pending[rank] = req
+
+    def _try_serve(self, rank: int) -> bool:
+        req = self._pending[rank]
+        kind = req[0]
+        if kind == "compute":
+            _, duration, name, lane = req
+            start = self.clock[rank]
+            self.clock[rank] = start + duration
+            if duration > 0:
+                self.events.append(
+                    TraceEvent(rank, lane, name, start, self.clock[rank])
+                )
+            self._advance_rank(rank, self.clock[rank])
+            return True
+        if kind == "advance":
+            _, t = req
+            self.clock[rank] = max(self.clock[rank], t)
+            self._advance_rank(rank, self.clock[rank])
+            return True
+        if kind == "collective":
+            _, key, duration, name, peers = req
+            seq = self._coll_seq.get((key, rank), 0)
+            ckey = (key, frozenset(peers), seq)
+            rv = self._collectives.get(ckey)
+            if rv is None:
+                rv = self._collectives[ckey] = _Rendezvous(
+                    peers=frozenset(peers), duration=duration
+                )
+            if rank not in rv.arrivals:
+                rv.arrivals[rank] = self.clock[rank]
+                if rv.duration != duration:
+                    raise RuntimeError(
+                        f"collective {key}#{seq}: mismatched durations "
+                        f"{rv.duration} vs {duration} from rank {rank}"
+                    )
+            if not rv.complete:
+                return False  # stay blocked
+            start = self.clock[rank]
+            end = rv.end_time
+            self.events.append(
+                TraceEvent(rank, "comm", name, start, end, kind="comm")
+            )
+            self.clock[rank] = end
+            self._coll_seq[(key, rank)] = seq + 1
+            self._advance_rank(rank, end)
+            return True
+        if kind == "send":
+            _, dst, tag, duration, name, *rest = req
+            lane = rest[0] if rest else "pp_fwd"
+            seq = self._send_seq.get((rank, dst, tag), 0)
+            self._send_seq[(rank, dst, tag)] = seq + 1
+            skey = (rank, dst, tag, seq)
+            if skey in self._sends:
+                raise RuntimeError(f"duplicate send {skey}")
+            post = self.clock[rank]
+            self._sends[skey] = (post, duration)
+            fid = self._next_flow
+            self._next_flow += 1
+            self._flow_ids[skey] = fid
+            self.events.append(
+                TraceEvent(rank, lane, name, post, post + duration,
+                           kind="p2p", flow_id=fid)
+            )
+            self._advance_rank(rank, post)
+            return True
+        if kind == "recv":
+            _, src, tag, name, *rest = req
+            lane = rest[0] if rest else "pp_fwd"
+            seq = self._recv_seq.get((rank, src, tag), 0)
+            skey = (src, rank, tag, seq)
+            if skey not in self._sends:
+                return False  # sender hasn't posted yet
+            post, duration = self._sends.pop(skey)
+            self._recv_seq[(rank, src, tag)] = seq + 1
+            arrive = max(self.clock[rank], post + duration)
+            if arrive > self.clock[rank]:
+                self.events.append(
+                    TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
+                               arrive, kind="wait",
+                               flow_id=self._flow_ids.get(skey))
+                )
+            self.clock[rank] = arrive
+            self._advance_rank(rank, arrive)
+            return True
+        raise RuntimeError(f"unknown request {req!r}")
+
+    # -- diagnostics (reference ``base_struct.py:1415-1474``) --------------
+    def _deadlock_dump(self):
+        lines = ["simulator deadlock — per-rank state:"]
+        for r in range(self.num_ranks):
+            state = "done" if self._done[r] else f"blocked on {self._pending[r]!r}"
+            lines.append(f"  rank {r} t={self.clock[r]*1e3:.3f}ms: {state}")
+        incomplete = {
+            k: dict(v.arrivals)
+            for k, v in self._collectives.items()
+            if not v.complete
+        }
+        if incomplete:
+            lines.append(f"  incomplete collectives: {incomplete}")
+        if self._sends:
+            lines.append(f"  unmatched sends: {list(self._sends)}")
+        raise DeadlockError("\n".join(lines))

@@ -1,0 +1,98 @@
+"""Rank-local allocated-memory timeline for the event simulator.
+
+Reference: ``simumax/core/simu_memory.py`` (``SimuMemoryTracker``: token
+lifetimes with strict size checking, Chrome counter events, snapshot
+records). The torch ``memory_viz`` pickle export is GPU-tooling-specific
+and is replaced by a plain JSON snapshot (schema
+``simumax_tpu_memory_snapshot_v1``) consumable by any plotting tool.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class MemSample:
+    t: float
+    bytes: float
+    tag: str = ""
+
+
+class SimuMemoryTracker:
+    """Strict token-based alloc/free tracking (reference
+    ``simu_memory.py:65-127``): every cache allocation is a token that
+    must be freed exactly once with the same size."""
+
+    def __init__(self, rank: int, static_bytes: float = 0.0):
+        self.rank = rank
+        self.static_bytes = static_bytes
+        self.cur = static_bytes
+        self.peak = static_bytes
+        self.peak_time = 0.0
+        self.timeline: List[MemSample] = [MemSample(0.0, static_bytes, "static")]
+        self._tokens: Dict[str, List[float]] = {}
+
+    def alloc(self, t: float, nbytes: float, token: Optional[str] = None,
+              tag: str = ""):
+        if nbytes == 0:
+            return
+        assert nbytes > 0, f"negative alloc {nbytes}"
+        if token is not None:
+            self._tokens.setdefault(token, []).append(nbytes)
+        self.cur += nbytes
+        if self.cur > self.peak:
+            self.peak = self.cur
+            self.peak_time = t
+        self.timeline.append(MemSample(t, self.cur, tag))
+
+    def free(self, t: float, nbytes: float = 0.0,
+             token: Optional[str] = None, tag: str = ""):
+        if token is not None:
+            fifo = self._tokens.get(token)
+            if not fifo:
+                raise RuntimeError(
+                    f"rank {self.rank}: free of unknown token {token!r}"
+                )
+            expect = fifo.pop(0)
+            if nbytes and abs(expect - nbytes) > 1:
+                raise RuntimeError(
+                    f"rank {self.rank}: token {token!r} size mismatch: "
+                    f"allocated {expect}, freeing {nbytes}"
+                )
+            nbytes = expect
+        if nbytes == 0:
+            return
+        self.cur -= nbytes
+        if self.cur < self.static_bytes - 1:
+            raise RuntimeError(
+                f"rank {self.rank}: memory underflow at t={t}: "
+                f"{self.cur} < static {self.static_bytes}"
+            )
+        self.timeline.append(MemSample(t, self.cur, tag))
+
+    def outstanding_tokens(self) -> Dict[str, int]:
+        return {k: len(v) for k, v in self._tokens.items() if v}
+
+    def summary(self) -> dict:
+        return {
+            "rank": self.rank,
+            "static_bytes": self.static_bytes,
+            "peak_bytes": self.peak,
+            "peak_gib": self.peak / 2**30,
+            "peak_time_ms": self.peak_time * 1e3,
+            "end_bytes": self.cur,
+            "samples": len(self.timeline),
+        }
+
+    def snapshot(self) -> dict:
+        return {
+            "schema": "simumax_tpu_memory_snapshot_v1",
+            "rank": self.rank,
+            "static_bytes": self.static_bytes,
+            "timeline": [
+                {"t_ms": s.t * 1e3, "bytes": s.bytes, "tag": s.tag}
+                for s in self.timeline
+            ],
+        }

@@ -1,0 +1,80 @@
+"""simulate() entry point (L5 top).
+
+Reference: ``simumax/core/simu_runner.py:22-94`` (``run_simulation``:
+one simulated rank per PP stage, memory tracker wiring, trace +
+memory-artifact export).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from simumax_tpu.simulator.engine import SimuEngine
+from simumax_tpu.simulator.memory import SimuMemoryTracker
+from simumax_tpu.simulator.schedule import StageProcess
+from simumax_tpu.simulator.trace import write_chrome_trace
+
+
+def run_simulation(
+    perf,
+    save_path: Optional[str] = None,
+    granularity: str = "leaf",
+    track_memory: bool = True,
+) -> dict:
+    """Discrete-event replay of one training iteration. ``perf`` must
+    have completed ``run_estimate()``."""
+    assert perf.chunks, "call run_estimate() before simulate()"
+    st = perf.strategy
+    if st.vp_size > 1:
+        raise NotImplementedError(
+            "interleaved (VPP) schedules are not yet supported by the "
+            "event simulator; use the analytical path"
+        )
+    pp = st.pp_size
+    engine = SimuEngine(pp)
+    trackers = []
+    for s in range(pp):
+        static = sum(c.param_info.total_bytes for c in perf.stage_chunks(s))
+        tracker = (
+            SimuMemoryTracker(s, static_bytes=static) if track_memory else None
+        )
+        trackers.append(tracker)
+        proc = StageProcess(perf, s, tracker=tracker, granularity=granularity)
+        engine.add_rank(s, proc.process())
+    end_time = engine.run()
+    # machine-variance inflation, same as the analytical path
+    # (perf-vs-simulator agreement must survive the straggler model)
+    ratio = perf.straggler_ratio()
+    end_time *= ratio
+
+    result = {
+        "end_time": end_time,
+        "end_time_ms": end_time * 1e3,
+        "straggle_ratio": ratio,
+        "per_rank_end_ms": [t * 1e3 for t in engine.clock],
+        "num_events": len(engine.events),
+    }
+    if track_memory:
+        result["memory"] = [t.summary() for t in trackers]
+        for t in trackers:
+            leftover = t.outstanding_tokens()
+            assert not leftover, (
+                f"stage {t.rank}: unfreed activation tokens {leftover}"
+            )
+    if save_path:
+        os.makedirs(save_path, exist_ok=True)
+        trace_path = os.path.join(save_path, "trace.json")
+        write_chrome_trace(
+            trace_path, engine.events, trackers if track_memory else None
+        )
+        result["trace_path"] = trace_path
+        with open(os.path.join(save_path, "simu_result.json"), "w") as f:
+            json.dump(result, f, indent=2)
+        if track_memory:
+            with open(
+                os.path.join(save_path, "simu_memory_snapshot.json"), "w"
+            ) as f:
+                json.dump([t.snapshot() for t in trackers], f)
+    return result

@@ -1,0 +1,236 @@
+"""Per-stage job generators for the event simulator (L5).
+
+Reference: ``simumax/core/transformer/pipeline_schedule.py``
+(``PpSchedule.prefill_batch:717-959`` non-interleaved 1F1B,
+``OptimizerSimulator:30-87``) + the per-leaf job factories scattered
+through the reference's leaf modules (``prefill_fwd/prefill_bwd``).
+
+Redesign: leaves carry no job-construction code — the generator walks
+each chunk's called leaves and replays their recorded cost/activation
+info as engine requests, with the memory tracker driven inline. One
+simulated rank per PP stage (the reference's ``merge_lanes`` mode):
+intra-stage collectives (tp/cp/ep/etp) are charged as local comm-lane
+time; PP p2p and the optimizer barrier are true cross-rank rendezvous.
+"""
+
+from __future__ import annotations
+
+from typing import Generator, List, Optional
+
+from simumax_tpu.parallel.pipeline import one_f_one_b_order
+from simumax_tpu.simulator.memory import SimuMemoryTracker
+
+
+def _leaf_events(leaf, phase: str):
+    """(pre_comm, compute, post_comm) exposed seconds for one leaf/phase."""
+    pre = post = 0.0
+    for c in leaf.collective_calls:
+        if c.phase != phase or not c.exposed:
+            continue
+        if c.point == "pre":
+            pre += c.time
+        else:
+            post += c.time
+    return pre, leaf.cost_info.compute.get(phase), post
+
+
+class StageProcess:
+    """Builds the generator coroutine for one PP stage."""
+
+    def __init__(
+        self,
+        perf,
+        stage: int,
+        tracker: Optional[SimuMemoryTracker] = None,
+        granularity: str = "leaf",
+    ):
+        self.perf = perf
+        self.stage = stage
+        self.st = perf.strategy
+        self.tracker = tracker
+        self.granularity = granularity
+        self.chunks = perf.stage_chunks(stage)
+        self.pp = self.st.pp_size
+        path = perf.ctx.path("pp")
+        self.p2p_time = (
+            perf.system.compute_net_op_time(
+                "p2p", self.chunks[0].boundary_bytes(), path
+            )
+            if self.pp > 1
+            else 0.0
+        )
+
+    # -- memory helpers ----------------------------------------------------
+    def _alloc(self, t, nbytes, token=None, tag=""):
+        if self.tracker is not None and nbytes:
+            self.tracker.alloc(t, nbytes, token, tag)
+
+    def _free(self, t, nbytes=0.0, token=None, tag=""):
+        if self.tracker is not None:
+            self.tracker.free(t, nbytes, token, tag)
+
+    # -- one microbatch forward / backward ---------------------------------
+    def _fwd(self, mb: int, clock: List[float]) -> Generator:
+        for chunk in self.chunks:
+            leaves = chunk.called_leaves()
+            if self.granularity == "chunk":
+                dur = chunk.cost_info.fwd_time
+                t = yield ("compute", dur, f"fwd_mb{mb}", "comp")
+                clock[0] = t
+                self._alloc(t, chunk.act_info.cache_bytes,
+                            f"mb{mb}:c{chunk.chunk_idx}", "act")
+                continue
+            for leaf in leaves:
+                pre, comp, post = _leaf_events(leaf, "fwd")
+                name = leaf.path_name().split(".", 1)[-1]
+                if pre:
+                    t = yield ("compute", pre, f"{name}.fwd_comm", "comm")
+                    clock[0] = t
+                self._alloc(clock[0], leaf.raw_act_info.fwd_temp_bytes,
+                            tag="temp")
+                if comp:
+                    t = yield ("compute", comp, f"{name}.fwd#mb{mb}", "comp")
+                    clock[0] = t
+                self._free(clock[0], leaf.raw_act_info.fwd_temp_bytes,
+                           tag="temp")
+                if leaf.act_info.cache_bytes:
+                    self._alloc(
+                        clock[0], leaf.act_info.cache_bytes,
+                        f"mb{mb}:{id(leaf)}", "act",
+                    )
+                if post:
+                    t = yield ("compute", post, f"{name}.fwd_comm", "comm")
+                    clock[0] = t
+
+    def _bwd(self, mb: int, clock: List[float]) -> Generator:
+        for chunk in reversed(self.chunks):
+            leaves = chunk.called_leaves()
+            if self.granularity == "chunk":
+                dur = chunk.cost_info.bwd_time
+                t = yield ("compute", dur, f"bwd_mb{mb}", "comp")
+                clock[0] = t
+                self._free(t, token=f"mb{mb}:c{chunk.chunk_idx}", tag="act")
+                continue
+            done = set()
+            i = len(leaves) - 1
+            while i >= 0:
+                leaf = leaves[i]
+                if id(leaf) in done:
+                    i -= 1
+                    continue
+                seg = getattr(leaf, "recompute_segment", None)
+                if leaf.in_recompute and seg is not None:
+                    seg_leaves = [
+                        l for l in leaves
+                        if getattr(l, "recompute_segment", None) is seg
+                    ]
+                    replay = sum(
+                        sl.cost_info.compute.fwd
+                        + sl.cost_info.net_exposed.fwd
+                        for sl in seg_leaves
+                    )
+                    name = seg.path_name().split(".", 1)[-1]
+                    saved = seg_leaves[0].act_info.cache_bytes
+                    t = yield ("compute", replay, f"{name}.recompute#mb{mb}",
+                               "comp")
+                    clock[0] = t
+                    for sl in seg_leaves:
+                        if sl.raw_act_info.cache_bytes:
+                            self._alloc(t, sl.raw_act_info.cache_bytes,
+                                        f"mb{mb}:r{id(sl)}", "recompute")
+                    if saved:
+                        self._free(t, token=f"mb{mb}:{id(seg_leaves[0])}",
+                                   tag="act")
+                    for sl in reversed(seg_leaves):
+                        dur = (
+                            sl.cost_info.phase_time("bwd_act")
+                            + sl.cost_info.phase_time("bwd_w")
+                        )
+                        lname = sl.path_name().split(".", 1)[-1]
+                        if dur:
+                            t = yield ("compute", dur, f"{lname}.bwd#mb{mb}",
+                                       "comp")
+                            clock[0] = t
+                        if sl.raw_act_info.cache_bytes:
+                            self._free(clock[0], token=f"mb{mb}:r{id(sl)}",
+                                       tag="recompute")
+                        done.add(id(sl))
+                    i -= 1
+                    continue
+                pre_a, comp_a, post_a = _leaf_events(leaf, "bwd_act")
+                pre_w, comp_w, post_w = _leaf_events(leaf, "bwd_w")
+                name = leaf.path_name().split(".", 1)[-1]
+                dur_comm = pre_a + post_a + pre_w + post_w
+                if dur_comm:
+                    t = yield ("compute", dur_comm, f"{name}.bwd_comm", "comm")
+                    clock[0] = t
+                self._alloc(clock[0], leaf.raw_act_info.bwd_temp_bytes,
+                            tag="temp")
+                if comp_a + comp_w:
+                    t = yield ("compute", comp_a + comp_w,
+                               f"{name}.bwd#mb{mb}", "comp")
+                    clock[0] = t
+                self._free(clock[0], leaf.raw_act_info.bwd_temp_bytes,
+                           tag="temp")
+                if leaf.act_info.cache_bytes:
+                    self._free(clock[0], token=f"mb{mb}:{id(leaf)}",
+                               tag="act")
+                done.add(id(leaf))
+                i -= 1
+
+    # -- optimizer tail (reference ``OptimizerSimulator``) -----------------
+    def _optimizer(self, clock: List[float]) -> Generator:
+        perf = self.perf
+        dp = perf._compute_dp_time()
+        # grad reduce-scatter (dense + moe)
+        rs = dp.get("dense_grad_rs_time", 0.0) + dp.get("moe_grad_rs_time", 0.0)
+        ag = dp.get("dense_param_ag_time", 0.0) + dp.get("moe_param_ag_time", 0.0)
+        if rs:
+            t = yield ("compute", rs, "grad_reduce_scatter", "comm")
+            clock[0] = t
+        # world barrier before the step (rerun_state_machine analog)
+        t = yield (
+            "collective",
+            "optimizer_barrier",
+            0.0,
+            "optimizer_barrier",
+            list(range(self.pp)),
+        )
+        clock[0] = t
+        t = yield ("compute", perf._compute_optim_time(), "adam_step", "comp")
+        clock[0] = t
+        if ag:
+            t = yield ("compute", ag, "param_all_gather", "comm")
+            clock[0] = t
+
+    # -- full schedule ------------------------------------------------------
+    def process(self) -> Generator:
+        st, stage, pp = self.st, self.stage, self.pp
+        mbc = st.micro_batch_num
+        clock = [0.0]
+        for kind, mb in one_f_one_b_order(pp, stage, mbc):
+            if kind == "F":
+                if stage > 0:
+                    t = yield ("recv", stage - 1, f"fwd{mb}",
+                               f"recv_fwd{mb}", "pp_fwd")
+                    clock[0] = t
+                yield from self._fwd(mb, clock)
+                if stage < pp - 1:
+                    t = yield (
+                        "send", stage + 1, f"fwd{mb}", self.p2p_time,
+                        f"send_fwd{mb}", "pp_fwd",
+                    )
+                    clock[0] = t
+            else:
+                if stage < pp - 1:
+                    t = yield ("recv", stage + 1, f"bwd{mb}",
+                               f"recv_bwd{mb}", "pp_bwd")
+                    clock[0] = t
+                yield from self._bwd(mb, clock)
+                if stage > 0:
+                    t = yield (
+                        "send", stage - 1, f"bwd{mb}", self.p2p_time,
+                        f"send_bwd{mb}", "pp_bwd",
+                    )
+                    clock[0] = t
+        yield from self._optimizer(clock)

@@ -1,0 +1,105 @@
+"""Chrome/Perfetto trace export (L6).
+
+Reference: ``simumax/core/generate_tracing.py`` + ``trace_export.py``.
+The reference writes text log lines and re-parses them by regex; here
+the engine produces structured :class:`TraceEvent` records directly, so
+export is a straight conversion — pid = simulated rank (PP stage),
+ordered tid lanes (comp / comm / pp_fwd / pp_bwd), flow arrows linking
+p2p send -> recv-wait pairs, and per-rank memory counter tracks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from simumax_tpu.simulator.engine import TraceEvent
+from simumax_tpu.simulator.memory import SimuMemoryTracker
+
+_LANE_ORDER = {"comp": 0, "comm": 1, "pp_fwd": 2, "pp_bwd": 3, "wait": 4}
+
+_COLORS = {
+    "compute": "good",
+    "comm": "thread_state_runnable",
+    "p2p": "thread_state_iowait",
+    "wait": "terrible",
+}
+
+
+def to_chrome_trace(
+    events: List[TraceEvent],
+    trackers: Optional[List[SimuMemoryTracker]] = None,
+    max_counter_samples: int = 4000,
+) -> dict:
+    out = []
+    for rank in sorted({e.rank for e in events}):
+        out.append(
+            {
+                "ph": "M", "pid": rank, "name": "process_name",
+                "args": {"name": f"stage{rank}"},
+            }
+        )
+        for lane, idx in _LANE_ORDER.items():
+            out.append(
+                {
+                    "ph": "M", "pid": rank, "tid": idx,
+                    "name": "thread_name", "args": {"name": lane},
+                }
+            )
+    for e in events:
+        lane = e.lane if e.kind != "wait" else "wait"
+        tid = _LANE_ORDER.get(lane, 5)
+        out.append(
+            {
+                "ph": "X",
+                "pid": e.rank,
+                "tid": tid,
+                "name": e.name,
+                "ts": e.start * 1e6,
+                "dur": max(e.end - e.start, 0.0) * 1e6,
+                "cname": _COLORS.get(e.kind),
+                "args": {"kind": e.kind},
+            }
+        )
+        if e.flow_id is not None and e.kind == "p2p":
+            out.append(
+                {
+                    "ph": "s", "pid": e.rank, "tid": tid, "id": e.flow_id,
+                    "name": "p2p", "ts": e.start * 1e6, "cat": "p2p",
+                }
+            )
+        if e.flow_id is not None and e.kind == "wait":
+            out.append(
+                {
+                    "ph": "f", "pid": e.rank, "tid": tid, "id": e.flow_id,
+                    "name": "p2p", "ts": e.end * 1e6, "cat": "p2p",
+                    "bp": "e",
+                }
+            )
+    for tr in trackers or []:
+        samples = tr.timeline
+        stride = max(1, len(samples) // max_counter_samples)
+        kept = list(samples[::stride])
+        # never drop the peak or the final sample when downsampling
+        peak_sample = max(samples, key=lambda s: s.bytes)
+        for extra in (peak_sample, samples[-1]):
+            if extra not in kept:
+                kept.append(extra)
+        kept.sort(key=lambda s: s.t)
+        for s in kept:
+            out.append(
+                {
+                    "ph": "C",
+                    "pid": tr.rank,
+                    "name": "hbm_bytes",
+                    "ts": s.t * 1e6,
+                    "args": {"allocated": s.bytes},
+                }
+            )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, events, trackers=None):
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(events, trackers), f)
+    return path

@@ -1,0 +1,192 @@
+"""Event-simulator tests: engine semantics, perf-vs-simulator
+cross-check (the reference's first-class internal test, SURVEY §4.3),
+memory conservation, trace artifact validity."""
+
+import json
+import os
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.simulator.engine import DeadlockError, SimuEngine
+
+
+def run(strategy, model="llama3-8b", system="tpu_v5e_256", **overrides):
+    p = PerfLLM()
+    st = get_strategy_config(strategy) if isinstance(strategy, str) else strategy
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    p.configure(st, model, system)
+    p.run_estimate()
+    return p
+
+
+class TestEngine:
+    def test_compute_advances_clock(self):
+        eng = SimuEngine(1)
+
+        def proc():
+            yield ("compute", 1.5, "a", "comp")
+            yield ("compute", 0.5, "b", "comp")
+
+        eng.add_rank(0, proc())
+        assert eng.run() == pytest.approx(2.0)
+        assert [e.name for e in eng.events] == ["a", "b"]
+
+    def test_collective_rendezvous_waits_for_slowest(self):
+        eng = SimuEngine(2)
+
+        def fast():
+            yield ("compute", 1.0, "w", "comp")
+            yield ("collective", "g", 0.5, "ar", [0, 1])
+
+        def slow():
+            yield ("compute", 3.0, "w", "comp")
+            yield ("collective", "g", 0.5, "ar", [0, 1])
+
+        eng.add_rank(0, fast())
+        eng.add_rank(1, slow())
+        assert eng.run() == pytest.approx(3.5)
+        assert eng.clock[0] == pytest.approx(3.5)  # fast rank stalled
+
+    def test_p2p_async_send_blocking_recv(self):
+        eng = SimuEngine(2)
+
+        def sender():
+            yield ("compute", 1.0, "work", "comp")
+            yield ("send", 1, "fwd0", 0.25, "s")
+            yield ("compute", 1.0, "more", "comp")  # overlaps transfer
+
+        def receiver():
+            yield ("recv", 0, "fwd0", "r")
+            yield ("compute", 0.5, "consume", "comp")
+
+        eng.add_rank(0, sender())
+        eng.add_rank(1, receiver())
+        eng.run()
+        assert eng.clock[1] == pytest.approx(1.0 + 0.25 + 0.5)
+        assert eng.clock[0] == pytest.approx(2.0)  # send did not block
+
+    def test_deadlock_detected_with_diagnostics(self):
+        eng = SimuEngine(2)
+
+        def a():
+            yield ("recv", 1, "x", "ra")
+
+        def b():
+            yield ("recv", 0, "y", "rb")
+
+        eng.add_rank(0, a())
+        eng.add_rank(1, b())
+        with pytest.raises(DeadlockError) as ei:
+            eng.run()
+        assert "rank 0" in str(ei.value) and "blocked" in str(ei.value)
+
+    def test_mismatched_collective_duration_raises(self):
+        eng = SimuEngine(2)
+
+        def a():
+            yield ("collective", "g", 0.5, "ar", [0, 1])
+
+        def b():
+            yield ("collective", "g", 0.7, "ar", [0, 1])
+
+        eng.add_rank(0, a())
+        eng.add_rank(1, b())
+        with pytest.raises(RuntimeError, match="mismatched"):
+            eng.run()
+
+
+class TestPerfVsSimulator:
+    """The two independent implementations of iteration time must agree
+    (reference keeps them within ~0.3%, docs/release_v1.2.md:33-36)."""
+
+    @pytest.mark.parametrize(
+        "strat,model",
+        [
+            ("tp1_pp2_dp4_mbs1", "llama3-8b"),
+            ("tp2_pp1_dp4_mbs1", "llama3-8b"),
+            ("tp2_pp1_dp4_mbs1_full_recompute", "llama3-8b"),
+            ("ep4_pp2_dp4_mbs1", "mixtral-8x7b"),
+        ],
+    )
+    def test_iter_time_matches(self, strat, model):
+        p = run(strat, model)
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.01)
+
+    def test_memory_peak_close_to_analytical(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        sim = p.simulate(None)
+        mem = p.analysis_mem()
+        for s, m in zip(mem["stages"], sim["memory"]):
+            assert m["peak_bytes"] == pytest.approx(
+                s["peak_bytes"], rel=0.08
+            )
+
+    def test_pp4_runs(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 4
+        st.world_size = 8
+        p = run(st)
+        sim = p.simulate(None)
+        analytical = p.analysis_cost()["iter_time"]
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.01)
+
+    def test_chunk_granularity_matches_leaf(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        leaf = p.simulate(None, granularity="leaf")
+        chunk = p.simulate(None, granularity="chunk", track_memory=False)
+        assert chunk["end_time"] == pytest.approx(leaf["end_time"], rel=0.01)
+        assert chunk["num_events"] < leaf["num_events"] / 10
+
+
+class TestGuards:
+    def test_vpp_not_yet_simulated(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        p = run(st)
+        with pytest.raises(NotImplementedError, match="VPP"):
+            p.simulate(None)
+
+    def test_disjoint_collective_groups_with_same_key(self):
+        eng = SimuEngine(4)
+
+        def mk(peers, dur):
+            def proc():
+                yield ("collective", "g", dur, "ar", peers)
+
+            return proc()
+
+        eng.add_rank(0, mk([0, 1], 0.5))
+        eng.add_rank(1, mk([0, 1], 0.5))
+        eng.add_rank(2, mk([2, 3], 0.7))
+        eng.add_rank(3, mk([2, 3], 0.7))
+        assert eng.run() == pytest.approx(0.7)
+
+
+class TestArtifacts:
+    def test_trace_and_memory_artifacts(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1")
+        r = p.simulate(str(tmp_path))
+        trace = json.load(open(os.path.join(tmp_path, "trace.json")))
+        events = trace["traceEvents"]
+        assert any(e.get("ph") == "X" for e in events)
+        assert any(e.get("ph") == "C" for e in events)  # memory counters
+        assert any(e.get("ph") == "s" for e in events)  # p2p flow arrows
+        pids = {e["pid"] for e in events if e.get("ph") == "X"}
+        assert pids == {0, 1}
+        snap = json.load(
+            open(os.path.join(tmp_path, "simu_memory_snapshot.json"))
+        )
+        assert snap[0]["schema"] == "simumax_tpu_memory_snapshot_v1"
+        assert len(snap[0]["timeline"]) > 100
+
+    def test_recompute_visible_in_trace(self, tmp_path):
+        p = run("tp2_pp1_dp4_mbs1_full_recompute")
+        p.simulate(str(tmp_path))
+        trace = json.load(open(os.path.join(tmp_path, "trace.json")))
+        names = {e.get("name", "") for e in trace["traceEvents"]}
+        assert any("recompute" in n for n in names)
