@@ -1,0 +1,204 @@
+"""Headline benchmark: prediction accuracy of the analytical simulator
+against a real measured JAX Llama training step on the local TPU chip.
+
+Workflow (the north-star self-calibration loop):
+1. measure a real fwd+bwd+Adam step of the JAX reference Llama;
+2. run the analytical estimate, collect its efficiency-table misses,
+   calibrate exactly those GEMM/attention shapes on the same chip;
+3. re-estimate and report |predicted - measured| step-time error.
+
+Prints exactly ONE JSON line:
+{"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline is error/10%, the BASELINE.md accuracy gate (<1.0 beats it).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import warnings
+
+warnings.filterwarnings("ignore")
+import logging
+
+logging.disable(logging.WARNING)
+
+
+def detect_system():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5p" in kind or kind == "tpu v5":
+        return "tpu_v5p_256", kind
+    return "tpu_v5e_256", kind  # v5e default (also the fallback)
+
+
+def build_bench_model():
+    """Small-but-real llama: big enough to exercise the MXU, small
+    enough to fit 16 GiB with fp32 Adam state."""
+    from simumax_tpu.core.config import ModelConfig
+
+    return ModelConfig(
+        model_name="bench_llama_0p5b",
+        hidden_size=2048,
+        head_num=16,
+        kv_head_num=8,
+        head_size=128,
+        intermediate_size=5504,
+        layer_num=6,
+        vocab_size=32000,
+        use_swiglu=True,
+    )
+
+
+def measure_step(mc, batch_size=1, seq_len=2048, iters=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simumax_tpu.calibration.timing import time_stateful
+    from simumax_tpu.jaxref.model import (
+        LlamaConfig,
+        init_params,
+        make_train_step,
+    )
+
+    cfg = LlamaConfig.from_model_config(mc)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, train_step = make_train_step(cfg, shard=False)
+    opt = init_opt(params)
+    rs = np.random.RandomState(0)
+    ids = jnp.array(
+        rs.randint(0, cfg.vocab_size, (batch_size, seq_len), np.int32)
+    )
+    batch = (ids, ids)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    state = [params, opt]
+
+    def run():
+        p, o, loss = step(state[0], state[1], batch)
+        state[0], state[1] = p, o
+        return loss
+
+    t = time_stateful(run, warmup=2, iters=iters)
+    stats = {}
+    try:
+        ms = jax.devices()[0].memory_stats()
+        if ms:
+            stats["measured_peak_bytes"] = ms.get("peak_bytes_in_use", 0)
+    except Exception:
+        pass
+    return t, stats
+
+
+def predict_step(mc, system_name, batch_size=1, seq_len=2048):
+    from simumax_tpu.core.config import StrategyConfig
+    from simumax_tpu.perf import PerfLLM
+
+    st = StrategyConfig(
+        world_size=1,
+        tp_size=1,
+        pp_size=1,
+        seq_len=seq_len,
+        micro_batch_size=batch_size,
+        micro_batch_num=1,
+        zero_state=0,
+        use_flash_sdp=True,
+        use_fp32_accum_grad=True,
+        optimizer_style="functional",  # matches the fused JAX adam step
+    )
+    perf = PerfLLM().configure(st, mc, system_name)
+    perf.run_estimate()
+    return perf
+
+
+def main():
+    system_name, kind = detect_system()
+    mc = build_bench_model()
+    mc.maybe_pad_vocab_size(1)
+
+    measured_s, mem_stats = measure_step(mc)
+
+    perf = predict_step(mc, system_name)
+    pred_uncal = perf.analysis_cost()["iter_time"]
+
+    # self-calibration: measure exactly the shapes the estimate missed
+    from simumax_tpu.calibration import calibrate_for_perf
+
+    calibrated = calibrate_for_perf(perf, max_keys=24)
+    perf.run_estimate()
+    perf._cost_result = None
+    pred_cal = perf.analysis_cost()["iter_time"]
+
+    err_pct = abs(pred_cal - measured_s) / measured_s * 100.0
+    err_uncal_pct = abs(pred_uncal - measured_s) / measured_s * 100.0
+    mem = perf.analysis_mem()
+
+    result = {
+        "metric": "calibrated step-time prediction error (llama-0.5B, 1 chip)",
+        "value": round(err_pct, 2),
+        "unit": "%",
+        "vs_baseline": round(err_pct / 10.0, 3),
+        "measured_ms": round(measured_s * 1e3, 2),
+        "predicted_ms": round(pred_cal * 1e3, 2),
+        "predicted_uncalibrated_ms": round(pred_uncal * 1e3, 2),
+        "uncalibrated_error_pct": round(err_uncal_pct, 2),
+        "calibrated_keys": sum(len(v) for v in calibrated.values()),
+        "predicted_peak_gib": round(mem["max_peak_gib"], 2),
+        "device_kind": kind,
+        "system_config": system_name,
+    }
+    if "measured_peak_bytes" in mem_stats:
+        result["measured_peak_gib"] = round(
+            mem_stats["measured_peak_bytes"] / 2**30, 2
+        )
+    print(json.dumps(result))
+
+
+def supervised_main(attempts=2, timeout_s=480):
+    """The TPU tunnel can hang indefinitely at backend init; run the
+    real bench in a child process with a timeout and retry so the
+    driver always gets its one JSON line."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["SIMU_BENCH_CHILD"] = "1"
+    last_err = "unknown"
+    for _ in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"timeout after {timeout_s}s (TPU tunnel hung?)"
+            continue
+        lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+        if proc.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        last_err = (proc.stderr or proc.stdout or "").strip()[-300:]
+    print(
+        json.dumps(
+            {
+                "metric": "calibrated step-time prediction error (llama-0.5B, 1 chip)",
+                "value": None,
+                "unit": "%",
+                "vs_baseline": None,
+                "error": last_err,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    if os.environ.get("SIMU_BENCH_CHILD"):
+        main()
+    else:
+        supervised_main()

@@ -1,0 +1,17 @@
+"""JAX self-calibration tools (L9).
+
+Reference: ``simu_tools/efficency_test`` (GEMM/grouped-GEMM/attention
+efficiency sweeps -> ``accurate_efficient_factor`` tables;
+nccl-tests linear fits -> network classes), re-built on JAX so a live
+TPU slice calibrates its own ``configs/system/*.json``:
+
+* :func:`calibrate_for_perf` — measure exactly the shape keys a
+  ``PerfLLM`` run reported as efficiency-table misses and write them
+  back into the system config (the miss-driven loop the reference
+  documents in ``docs/system.md:48-57``);
+* ``gemm_bench`` / ``attention_bench`` — per-shape MXU efficiency;
+* ``collective_bench`` — ICI/DCN alpha-beta fits from psum/all_gather/
+  ppermute/all_to_all sweeps over a real mesh.
+"""
+
+from simumax_tpu.calibration.autocal import calibrate_for_perf, calibrate_system  # noqa: F401

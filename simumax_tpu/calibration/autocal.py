@@ -1,0 +1,239 @@
+"""Miss-driven self-calibration.
+
+Reference workflow: ``simu_tools/efficency_test`` sweeps a fixed shape
+grid on a live GPU and merges the results into ``<SYS_NAME>.json``
+(``combine_efficiency.py``); users are told to watch ``miss_efficiency``
+and re-calibrate (``docs/system.md:48-57``).
+
+TPU redesign: instead of a fixed grid, :func:`calibrate_for_perf` reads
+the exact shape keys a ``PerfLLM`` estimate *missed* in the efficiency
+tables, measures precisely those GEMM / grouped-GEMM / attention shapes
+with JAX on the local accelerator, and writes the measured efficiency
+factors back — so one command closes the loop for any model/strategy.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from simumax_tpu.calibration.timing import time_fn
+
+_DTYPES = {
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "fp32": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+def _parse_key(key: str) -> Dict[str, str]:
+    out = {}
+    for part in key.split(","):
+        part = part.strip()
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _peak_tflops(system, op_key: str) -> float:
+    spec = system.accelerator.op.get(op_key) or system.accelerator.op["default"]
+    return spec.tflops
+
+
+# -- GEMM ---------------------------------------------------------------------
+
+
+_SCAN_K = 16
+
+
+def _chain_scan(op, seed_carry=0.0, length=_SCAN_K):
+    """Run ``op(carry) -> new_carry`` ``length`` times inside one jitted
+    lax.scan — per-dispatch overhead (large through tunnel backends) is
+    paid once for K data-dependent executions, so the measured time is
+    device time. The carry is a tiny float threaded into the inputs to
+    defeat loop-invariant hoisting."""
+
+    def fn():
+        def body(carry, _):
+            return op(carry), None
+
+        carry, _ = jax.lax.scan(
+            body, jnp.float32(seed_carry), None, length=length
+        )
+        return carry
+
+    return jax.jit(fn)
+
+
+def measure_gemm_efficiency(
+    m: int, k: int, n: int, dtype: str, out_dtype: str, peak_tflops: float,
+    batch: int = 1, groups: int = 1, layout: str = "NN",
+) -> float:
+    """Measured MXU efficiency of a ``[m,k] x [k,n]`` matmul in the
+    given operand layout (NN fwd, NT dgrad, TN wgrad — the same operand
+    transposition structure XLA sees in each backprop stage), per group
+    when ``groups > 1`` (balanced grouped GEMM)."""
+    dt = _DTYPES.get(dtype, jnp.bfloat16)
+    odt = _DTYPES.get(out_dtype, dt)
+    if groups > 1:
+        a = jnp.ones((groups, max(m // groups, 1), k), dt)
+        b = jnp.ones((groups, k, n), dt)
+
+        def op(carry):
+            y = jax.lax.batch_matmul(
+                a + carry.astype(dt), b, preferred_element_type=odt
+            )
+            return jnp.ravel(y)[0].astype(jnp.float32) * 1e-30
+
+        flops = 2.0 * groups * max(m // groups, 1) * k * n
+    else:
+        # operand shapes + contraction dims per layout
+        if layout == "NT":
+            a_shape, b_shape, dims = (m, k), (n, k), (((1,), (1,)), ((), ()))
+        elif layout == "TN":
+            a_shape, b_shape, dims = (k, m), (k, n), (((0,), (0,)), ((), ()))
+        else:  # NN
+            a_shape, b_shape, dims = (m, k), (k, n), (((1,), (0,)), ((), ()))
+        if batch > 1:
+            a_shape = (batch,) + a_shape
+            dims = ((tuple(d + 1 for d in dims[0][0]), dims[0][1]), ((), ()))
+        a = jnp.ones(a_shape, dt)
+        b = jnp.ones(b_shape, dt)
+
+        def op(carry):
+            y = jax.lax.dot_general(
+                a + carry.astype(dt), b, dims, preferred_element_type=odt
+            )
+            return jnp.ravel(y)[0].astype(jnp.float32) * 1e-30
+
+        flops = 2.0 * batch * m * k * n
+    t = time_fn(_chain_scan(op), amortize=1) / _SCAN_K
+    eff = flops / t / (peak_tflops * 1e12)
+    return min(eff, 1.0)
+
+
+# -- attention ----------------------------------------------------------------
+
+
+def measure_sdp_efficiency(
+    b: int, sq: int, skv: int, hn: int, kv_hn: int, hd: int, hd_v: int,
+    causal: bool, dtype: str, peak_tflops: float, backward: bool = False,
+    sparse_ratio: float = 0.5,
+) -> float:
+    dt = _DTYPES.get(dtype, jnp.bfloat16)
+    q = jnp.ones((b, sq, hn, hd), dt)
+    k = jnp.ones((b, skv, kv_hn, hd), dt)
+    v = jnp.ones((b, skv, kv_hn, hd_v), dt)
+
+    def fwd_op(carry):
+        o = jax.nn.dot_product_attention(
+            q + carry.astype(dt), k, v, is_causal=causal
+        )
+        return jnp.ravel(o)[0].astype(jnp.float32) * 1e-30
+
+    t_f = time_fn(_chain_scan(fwd_op), amortize=1) / _SCAN_K
+    if backward:
+        def loss(q):
+            o = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+            return jnp.sum(o.astype(jnp.float32))
+
+        def bwd_op(carry):
+            g = jax.grad(loss)(q + carry.astype(dt))
+            return jnp.ravel(g)[0].astype(jnp.float32) * 1e-30
+
+        t = time_fn(_chain_scan(bwd_op), amortize=1) / _SCAN_K
+        # grad timing includes the forward pass; subtract it
+        t = max(t - t_f, t_f * 0.5)
+        mult = 2.5
+    else:
+        t = t_f
+        mult = 1.0
+    flops = 2.0 * b * hn * sq * skv * (hd + hd_v) * mult
+    if causal:
+        flops *= 1.0 - sparse_ratio
+    eff = flops / t / (peak_tflops * 1e12)
+    return min(eff, 1.0)
+
+
+# -- miss-driven loop ---------------------------------------------------------
+
+
+def calibrate_key(op_key: str, shape_key: str, system,
+                  sparse_ratio: float = 0.5) -> Optional[float]:
+    """Measure one (op table, shape key) pair; None if unsupported."""
+    kv = _parse_key(shape_key)
+    peak = _peak_tflops(system, op_key)
+    try:
+        if op_key.endswith("group_matmul"):
+            return measure_gemm_efficiency(
+                m=int(kv["M"]), k=int(kv["K"]), n=int(kv["N"]),
+                dtype=kv.get("dtype", "bf16"),
+                out_dtype="fp32" if kv.get("accumulate") == "True" else kv.get("dtype", "bf16"),
+                peak_tflops=peak, groups=int(kv["ng"]),
+            )
+        if op_key.endswith("matmul"):
+            return measure_gemm_efficiency(
+                m=int(kv["m"]), k=int(kv["k"]), n=int(kv["n"]),
+                dtype="int8" if op_key.startswith("int8") else "bf16",
+                out_dtype=kv.get("out_dtype", "bf16"),
+                peak_tflops=peak, batch=int(kv.get("b", 1)),
+                layout=kv.get("layout", "NN"),
+            )
+        if op_key in ("sdp_fwd", "sdp_bwd"):
+            return measure_sdp_efficiency(
+                b=int(kv["b"]), sq=int(kv["sq"]), skv=int(kv["skv"]),
+                hn=int(kv["hn"]), kv_hn=int(kv["kv_hn"]), hd=int(kv["hd"]),
+                hd_v=int(kv.get("hd_v", kv["hd"])),
+                causal=kv.get("causal") == "True",
+                dtype=kv.get("dtype", "bf16"), peak_tflops=peak,
+                backward=op_key == "sdp_bwd", sparse_ratio=sparse_ratio,
+            )
+    except (KeyError, ValueError):
+        return None
+    return None
+
+
+def calibrate_for_perf(perf, max_keys: Optional[int] = None,
+                       verbose: bool = False) -> Dict[str, Dict[str, float]]:
+    """Measure every efficiency-table miss recorded by the last
+    ``run_estimate()`` and write the results into the live SystemConfig.
+    Returns {op_key: {shape_key: efficiency}}."""
+    system = perf.system
+    sparse = perf.strategy.attention_sparse_ratio
+    measured: Dict[str, Dict[str, float]] = {}
+    count = 0
+    for op_key, keys in list(system.miss_efficiency.items()):
+        spec = system.accelerator.op.get(op_key)
+        if spec is None:
+            continue
+        for shape_key in keys:
+            if max_keys is not None and count >= max_keys:
+                break
+            eff = calibrate_key(op_key, shape_key, system, sparse)
+            if eff is None:
+                continue
+            spec.accurate_efficient_factor[shape_key] = eff
+            measured.setdefault(op_key, {})[shape_key] = eff
+            count += 1
+            if verbose:
+                print(f"[cal] {op_key}: {shape_key} -> {eff:.3f}")
+    return measured
+
+
+def calibrate_system(perf, save_path: Optional[str] = None, **kwargs):
+    """calibrate_for_perf + re-estimate + optional write-back of the
+    updated system config JSON (reference ``combine_efficiency.py`` +
+    ``apply_ws_comm_model.py`` write-back)."""
+    measured = calibrate_for_perf(perf, **kwargs)
+    perf.run_estimate()  # re-run with calibrated tables
+    if save_path:
+        cfg = perf.system.to_dict()
+        with open(save_path, "w") as f:
+            json.dump(cfg, f, indent=2, default=lambda o: vars(o))
+    return measured

@@ -1,0 +1,126 @@
+"""ICI/DCN collective microbenchmarks + alpha-beta fits.
+
+Reference: ``simu_tools/efficency_test/nccl_fit.py`` (time = a*bytes + b
+linear fit over nccl-tests output) and ``one_click_common.fit_bw_latency``
+— re-built as JAX collectives over a real device mesh: psum (all_reduce),
+all_gather, psum_scatter (reduce_scatter), all_to_all and ppermute
+sweeps per mesh axis, fitted to the same linear model and written back
+as per-op ``efficient_factor`` / ``latency_us`` against the system
+config's theoretical span bandwidth.
+
+Runs on any mesh (virtual CPU devices work for plumbing tests; real
+numbers need a TPU slice).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from simumax_tpu.calibration.timing import time_fn
+
+_OPS = ("all_reduce", "all_gather", "reduce_scatter", "all2all", "p2p")
+
+
+def _collective_fn(op: str, axis: str):
+    if op == "all_reduce":
+        return lambda x: jax.lax.psum(x, axis)
+    if op == "all_gather":
+        return lambda x: jax.lax.all_gather(x, axis)
+    if op == "reduce_scatter":
+        return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+    if op == "all2all":
+        return lambda x: jax.lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True
+        )
+    if op == "p2p":
+        def permute(x):
+            n = jax.lax.axis_size(axis)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return jax.lax.ppermute(x, axis, perm)
+
+        return permute
+    raise ValueError(op)
+
+
+def measure_collective(
+    mesh: Mesh, axis: str, op: str, nbytes: float, dtype=jnp.bfloat16
+) -> float:
+    """Wall time of one collective of ``nbytes`` *full logical tensor*
+    bytes over a mesh axis (matches ``compute_net_op_time`` semantics)."""
+    n = mesh.shape[axis]
+    # local shards must themselves split by n for tiled rs/a2a
+    elems = max(int(nbytes / jnp.dtype(dtype).itemsize), n * n)
+    elems -= elems % (n * n)
+    x = jnp.ones((elems,), dtype)
+    spec = P(axis)  # shard the vector over the measured axis
+    out_spec = P(None) if op == "all_gather" else spec
+
+    @functools.partial(jax.jit, in_shardings=NamedSharding(mesh, spec))
+    def run(x):
+        return jax.shard_map(
+            _collective_fn(op, axis),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=out_spec,
+            check_vma=False,
+        )(x)
+
+    with mesh:
+        return time_fn(run, x)
+
+
+def fit_alpha_beta(sizes: List[float], times: List[float]) -> Tuple[float, float]:
+    """Least-squares fit time = bytes/bw + alpha -> (bw_bytes_per_s,
+    alpha_seconds). Reference ``nccl_fit.py:27-60``."""
+    A = np.vstack([sizes, np.ones(len(sizes))]).T
+    slope, alpha = np.linalg.lstsq(A, np.array(times), rcond=None)[0]
+    bw = 1.0 / slope if slope > 0 else float("inf")
+    return bw, max(alpha, 0.0)
+
+
+def sweep_axis(
+    mesh: Mesh,
+    axis: str,
+    ops: Tuple[str, ...] = _OPS,
+    sizes_mb: Tuple[float, ...] = (1, 4, 16, 64),
+) -> Dict[str, dict]:
+    """Measure+fit every collective op along one mesh axis."""
+    out = {}
+    for op in ops:
+        sizes, times = [], []
+        for mb in sizes_mb:
+            nbytes = mb * 2**20
+            t = measure_collective(mesh, axis, op, nbytes)
+            sizes.append(nbytes)
+            times.append(t)
+        bw, alpha = fit_alpha_beta(sizes, times)
+        out[op] = {
+            "fitted_bw_gbps": bw / 1e9,
+            "fitted_latency_us": alpha * 1e6,
+            "samples": list(zip([s / 2**20 for s in sizes], times)),
+        }
+    return out
+
+
+def update_system_from_sweep(system, axis_extent: int, sweep: Dict[str, dict]):
+    """Write fitted per-op efficiencies back into ``system.ici.op``
+    against the theoretical span bandwidth (the write-back step of the
+    reference's one-click pipeline)."""
+    path = system.place_group("_cal", 1, axis_extent)
+    for op, fit in sweep.items():
+        # theoretical time for 64 MiB at eff=1.0
+        probe = 64 * 2**20
+        spec = system.ici.op.setdefault(op, type(next(iter(system.ici.op.values())))())
+        spec.efficient_factor = 1.0
+        theory = system.compute_net_op_time(op, probe, path)
+        slope_time = probe / (fit["fitted_bw_gbps"] * 1e9)
+        if theory > 0 and slope_time > 0:
+            spec.efficient_factor = min(theory / slope_time, 1.0)
+        spec.latency_us = fit["fitted_latency_us"]
+    return system

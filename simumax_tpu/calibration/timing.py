@@ -1,0 +1,91 @@
+"""Timing utilities for microbenchmarks.
+
+Through remote-tunnel TPU backends, ``jax.block_until_ready`` can return
+at dispatch time rather than execution completion, so every measurement
+here forces completion by fetching a scalar from the result, amortizes
+the fixed round-trip over ``amortize`` chained calls, and subtracts the
+separately measured fetch round-trip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_rtt_cache: Optional[float] = None
+
+
+def _fetch_scalar(out) -> float:
+    """Pull one scalar from (the first leaf of) ``out`` — forces the
+    producing computation to finish even on async tunnel backends."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(jnp.ravel(leaf)[0])
+
+
+def fetch_rtt(refresh: bool = False) -> float:
+    """Median scalar-fetch round-trip (seconds) on the default backend."""
+    global _rtt_cache
+    if _rtt_cache is not None and not refresh:
+        return _rtt_cache
+    x = jnp.ones((8,), jnp.float32)
+    f = jax.jit(jnp.sum)
+    _fetch_scalar(f(x))
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _fetch_scalar(f(x))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    _rtt_cache = samples[len(samples) // 2]
+    return _rtt_cache
+
+
+def time_fn(
+    fn: Callable,
+    *args,
+    warmup: int = 1,
+    iters: int = 3,
+    amortize: int = 8,
+) -> float:
+    """Median per-call seconds of ``fn(*args)``.
+
+    Each sample chains ``amortize`` calls and fetches a scalar from the
+    last result; the fetch round-trip is subtracted. Calls must be
+    side-effect-free (results independent) — the chain exists purely to
+    amortize dispatch/fetch overhead.
+    """
+    rtt = fetch_rtt()
+    for _ in range(warmup):
+        _fetch_scalar(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        for _ in range(amortize - 1):
+            out = fn(*args)
+        _fetch_scalar(out)
+        total = time.perf_counter() - t0
+        samples.append(max(total - rtt, 1e-9) / amortize)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def time_stateful(step: Callable, warmup: int = 1, iters: int = 8) -> float:
+    """Per-call seconds for a stateful step (e.g. a training step that
+    threads params/opt state). ``step()`` must return something
+    fetchable and carry its own state forward; successive calls are
+    data-dependent so one final fetch forces the whole chain."""
+    rtt = fetch_rtt()
+    for _ in range(warmup):
+        _fetch_scalar(step())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = step()
+    _fetch_scalar(out)
+    total = time.perf_counter() - t0
+    return max(total - rtt, 1e-9) / iters

@@ -403,6 +403,10 @@ class StrategyConfig(ConfigBase):
     use_fused_ce: bool = False
     use_fp32_accum_grad: bool = True
     grad_reduce_in_bf16: bool = False
+    #: "megatron": distributed-optimizer phases (zero-grad buffer, l2
+    #: norm/clip, adam, fp32->param copy). "functional": one fused
+    #: adam kernel as XLA emits for a functional train step.
+    optimizer_style: str = "megatron"
     attention_sparse_ratio: float = 0.5  # causal => half the score flops
 
     enable_recompute: bool = False
@@ -456,6 +460,12 @@ class StrategyConfig(ConfigBase):
     @property
     def vp_size(self) -> int:
         return max(1, self.interleaving_size)
+
+    @property
+    def vpp_group_size(self) -> int:
+        """Microbatch group size per virtual-pipeline stage (Megatron
+        ``microbatch_group_size_per_vp_stage``; defaults to pp_size)."""
+        return self.microbatch_group_size_per_vp_stage or self.pp_size
 
     @property
     def element_size(self) -> float:
@@ -518,9 +528,16 @@ class StrategyConfig(ConfigBase):
         assert self.cp_comm_type in ("a2a", "all_gather")
         assert self.cp_a2a_mode in ("sync_cp", "async_cp")
         assert self.moe_dispatcher_policy in ("all2all",)
+        assert self.optimizer_style in ("megatron", "functional"), (
+            f"unknown optimizer_style {self.optimizer_style!r}"
+        )
         if self.interleaving_size > 1:
             assert self.pp_size > 1, "VPP requires pp_size > 1"
-            assert self.micro_batch_num % self.pp_size == 0
+            assert self.micro_batch_num % self.vpp_group_size == 0, (
+                f"interleaved schedule requires micro_batch_num "
+                f"({self.micro_batch_num}) divisible by the vp microbatch "
+                f"group size ({self.vpp_group_size})"
+            )
         if self.enable_sequence_parallel:
             assert self.seq_len % (self.tp_size * self.cp_size) == 0
         if self.use_math_sdp:

@@ -343,3 +343,15 @@ class GemmBase(LeafModule):
 
     def comp_key(self, phase: str):
         return (self.matmul_op_key, self.gemm_shape_key(phase))
+
+    def quant_cast_bytes(self, phase: str) -> float:
+        """Extra HBM traffic of quantizing the GEMM input for the
+        low-precision MXU path (reference models this via explicit
+        Quantizer wrapper modules, ``dense_module.py:2365-2453``):
+        read the bf16 activation + write its int8 copy."""
+        if not self.quantized:
+            return 0.0
+        _, m, k, _ = self.gemm_mnk(phase)
+        e = self.ctx.strategy.element_size
+        q = 1.0  # int8 / fp8 byte
+        return m * k * (e + q)

@@ -1,0 +1,5 @@
+"""Real-JAX reference implementation used to validate the analytical
+simulator against measured TPU steps (SURVEY §7 item 11), and to drive
+self-calibration. Pure-functional JAX + pjit sharding; no framework
+dependencies beyond jax/optax.
+"""

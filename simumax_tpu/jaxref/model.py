@@ -1,0 +1,258 @@
+"""Functional JAX Llama (GQA + RoPE + SwiGLU) with mesh sharding.
+
+This is the *measured* counterpart of the analytical model zoo: the
+validation harness runs one real training step of this model on TPU and
+compares step time / HBM use against ``PerfLLM`` predictions (the ±10%
+target in BASELINE.md). It is deliberately idiomatic TPU JAX:
+
+* one ``jax.sharding.Mesh`` with axes ``(dp, tp)``;
+* parameters sharded Megatron-style over ``tp`` (qkv/up column, out/down
+  row, embedding vocab), optionally FSDP-sharded over ``dp``;
+* activations constrained ``P('dp', 'sp', None)`` between blocks when
+  sequence-parallel is on — XLA inserts the all-gather/reduce-scatter
+  pairs exactly where the analytical LinearCol/LinearRow place them;
+* causal flash attention via ``jax.nn.dot_product_attention`` (fused by
+  XLA on the MXU), bf16 compute / fp32 master params, ``lax.scan`` free
+  (layer loop unrolled at trace time: static layer count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    head_num: int = 8
+    kv_head_num: int = 4
+    head_size: int = 128
+    intermediate_size: int = 2816
+    layer_num: int = 4
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def from_model_config(cls, m, layer_num: Optional[int] = None):
+        """Build from a simumax_tpu ModelConfig (analytical <-> measured
+        parity)."""
+        return cls(
+            vocab_size=m.padded_vocab_size or m.vocab_size,
+            hidden_size=m.hidden_size,
+            head_num=m.head_num,
+            kv_head_num=m.kv_head_num,
+            head_size=m.head_size,
+            intermediate_size=m.intermediate_size,
+            layer_num=layer_num or m.layer_num,
+        )
+
+
+# -- parameter init ---------------------------------------------------------
+
+
+def init_params(cfg: LlamaConfig, key) -> Dict:
+    h, d = cfg.hidden_size, cfg.head_size
+    q_out = cfg.head_num * d
+    kv_out = cfg.kv_head_num * d
+    f = cfg.intermediate_size
+    keys = jax.random.split(key, cfg.layer_num + 2)
+
+    def dense(k, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[0]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    layers = []
+    for i in range(cfg.layer_num):
+        lk = jax.random.split(keys[i], 6)
+        layers.append(
+            {
+                "input_norm": jnp.ones((h,), cfg.dtype),
+                "qkv": dense(lk[0], (h, q_out + 2 * kv_out)),
+                "out": dense(lk[1], (q_out, h)),
+                "pre_mlp_norm": jnp.ones((h,), cfg.dtype),
+                "up": dense(lk[2], (h, 2 * f)),
+                "down": dense(lk[3], (f, h)),
+            }
+        )
+    return {
+        "embedding": dense(keys[-2], (cfg.vocab_size, h), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "lm_head": dense(keys[-1], (h, cfg.vocab_size)),
+    }
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh, fsdp: bool = False) -> Dict:
+    """Megatron-style tp sharding specs; dp-sharding of params when fsdp."""
+    dp = "dp" if fsdp else None
+    layer = {
+        "input_norm": P(),
+        "qkv": P(dp, "tp"),  # column parallel
+        "out": P("tp", dp),  # row parallel
+        "pre_mlp_norm": P(),
+        "up": P(dp, "tp"),
+        "down": P("tp", dp),
+    }
+    specs = {
+        "embedding": P("tp", dp),  # vocab parallel
+        "layers": [dict(layer) for _ in range(cfg.layer_num)],
+        "final_norm": P(),
+        "lm_head": P(dp, "tp"),
+    }
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _rms_norm(x, w, eps=1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def _rope(x, theta: float):
+    # x: [b, s, n, d]
+    b, s, n, d = x.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(s, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]  # [s, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+
+def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
+    h, d = cfg.hidden_size, cfg.head_size
+    q_out = cfg.head_num * d
+    kv_out = cfg.kv_head_num * d
+    res = x
+    y = _rms_norm(x, p["input_norm"])
+    qkv = y @ p["qkv"]
+    q, k, v = jnp.split(qkv, [q_out, q_out + kv_out], axis=-1)
+    b, s, _ = q.shape
+    q = _rope(q.reshape(b, s, cfg.head_num, d), cfg.rope_theta)
+    k = _rope(k.reshape(b, s, cfg.kv_head_num, d), cfg.rope_theta)
+    v = v.reshape(b, s, cfg.kv_head_num, d)
+    if shard:
+        q = jax.lax.with_sharding_constraint(q, P("dp", None, "tp", None))
+    o = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    x = res + o.reshape(b, s, q_out) @ p["out"]
+    res = x
+    y = _rms_norm(x, p["pre_mlp_norm"])
+    up = y @ p["up"]
+    gate, val = jnp.split(up, 2, axis=-1)
+    y = (jax.nn.silu(gate) * val) @ p["down"]
+    x = res + y
+    if not shard:
+        return x
+    # Megatron SP: between TP regions the seq dim is sharded over the
+    # same chips as tp — XLA inserts the ag/rs pairs at the boundaries
+    spec = P("dp", "tp", None) if sp else P("dp", None, None)
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def forward(params, ids, cfg: LlamaConfig, sp: bool = False,
+            shard: bool = True):
+    """ids [b, s] int32 -> logits [b, s, vocab] (bf16). ``shard=False``
+    skips sharding constraints for single-device use."""
+    x = params["embedding"][ids]
+    for p in params["layers"]:
+        x = _block(x, p, cfg, sp, shard)
+    x = _rms_norm(x, params["final_norm"])
+    return x @ params["lm_head"]
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, sp: bool = False,
+            shard: bool = True):
+    ids, targets = batch
+    logits = forward(params, ids, cfg, sp, shard).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+# -- training step ------------------------------------------------------------
+
+
+def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
+                    shard: bool = True):
+    """(params, opt_state, batch) -> (params, opt_state, loss). Adam with
+    fp32 moments (mirrors the analytical optimizer accounting)."""
+
+    def init_opt(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "mu": jax.tree.map(zeros, params),
+            "nu": jax.tree.map(zeros, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, sp,
+                                                  shard)
+        step = opt_state["step"] + 1
+        b1, b2, eps = 0.9, 0.95, 1e-8
+
+        def upd(p, g, mu, nu):
+            g = g.astype(jnp.float32)
+            mu = b1 * mu + (1 - b1) * g
+            nu = b2 * nu + (1 - b2) * jnp.square(g)
+            mu_hat = mu / (1 - b1 ** step.astype(jnp.float32))
+            nu_hat = nu / (1 - b2 ** step.astype(jnp.float32))
+            new_p = p.astype(jnp.float32) - lr * mu_hat / (
+                jnp.sqrt(nu_hat) + eps
+            )
+            return new_p.astype(p.dtype), mu, nu
+
+        flat_p, tree = jax.tree.flatten(params)
+        flat_g = jax.tree.leaves(grads)
+        flat_mu = jax.tree.leaves(opt_state["mu"])
+        flat_nu = jax.tree.leaves(opt_state["nu"])
+        out = [upd(*t) for t in zip(flat_p, flat_g, flat_mu, flat_nu)]
+        new_params = jax.tree.unflatten(tree, [o[0] for o in out])
+        new_mu = jax.tree.unflatten(tree, [o[1] for o in out])
+        new_nu = jax.tree.unflatten(tree, [o[2] for o in out])
+        return (
+            new_params,
+            {"mu": new_mu, "nu": new_nu, "step": step},
+            loss,
+        )
+
+    return init_opt, train_step
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, tp: int = 1, backend: Optional[str] = None
+) -> Mesh:
+    """(dp, tp) device mesh over the first ``n_devices`` devices. Falls
+    back to the (virtual, ``xla_force_host_platform_device_count``) CPU
+    backend when the default backend has too few devices."""
+    devices = jax.devices(backend) if backend else jax.devices()
+    if n_devices and len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    devices = devices[: n_devices or len(devices)]
+    n = len(devices)
+    assert n % tp == 0, (n, tp)
+    arr = np.array(devices).reshape(n // tp, tp)
+    return Mesh(arr, ("dp", "tp"))
+
+
+def shard_batch(batch, mesh: Mesh):
+    sharding = NamedSharding(mesh, P("dp", None))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

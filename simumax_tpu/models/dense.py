@@ -218,7 +218,11 @@ class LinearCol(GemmBase):
         _, m, k, n = self.gemm_mnk("fwd")
         io = (m * k + k * n + m * n) * e
         wgrad_extra = k * n * (st.grad_element_size - e)  # fp32 accum out
-        return {"fwd": io, "bwd_act": io, "bwd_w": io + wgrad_extra}
+        return {
+            "fwd": io + self.quant_cast_bytes("fwd"),
+            "bwd_act": io + self.quant_cast_bytes("bwd_act"),
+            "bwd_w": io + wgrad_extra + self.quant_cast_bytes("bwd_w"),
+        }
 
     def activation_info(self) -> ActivationInfo:
         st = _st(self.ctx)
@@ -291,8 +295,12 @@ class LinearRow(GemmBase):
         e = st.element_size
         _, m, k, n = self.gemm_mnk("fwd")
         io = (m * k + k * n + m * n) * e
-        wgrad_extra = k * n * (st.grad_element_size - e)
-        return {"fwd": io, "bwd_act": io, "bwd_w": io + wgrad_extra}
+        wgrad_extra = k * n * (st.grad_element_size - e)  # fp32 accum out
+        return {
+            "fwd": io + self.quant_cast_bytes("fwd"),
+            "bwd_act": io + self.quant_cast_bytes("bwd_act"),
+            "bwd_w": io + wgrad_extra + self.quant_cast_bytes("bwd_w"),
+        }
 
     def activation_info(self) -> ActivationInfo:
         return ActivationInfo(cache_bytes=self.inputs[0].bytes)

@@ -210,7 +210,11 @@ class GroupLinearBase(GemmBase):
         ng, m, k, n = self.gemm_mnk("fwd")
         io = (m * k + ng * k * n + m * n) * e
         wgrad_extra = ng * k * n * (st.grad_element_size - e)
-        return {"fwd": io, "bwd_act": io, "bwd_w": io + wgrad_extra}
+        return {
+            "fwd": io + self.quant_cast_bytes("fwd"),
+            "bwd_act": io + self.quant_cast_bytes("bwd_act"),
+            "bwd_w": io + wgrad_extra + self.quant_cast_bytes("bwd_w"),
+        }
 
     def activation_info(self) -> ActivationInfo:
         return ActivationInfo(cache_bytes=self.inputs[0].bytes)

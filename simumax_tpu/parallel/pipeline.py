@@ -41,8 +41,9 @@ def interleaved_order(
     """
     group = group_size or pp
     total = mbc * vp  # virtual microbatch slots per stage
-    assert mbc % group == 0 or mbc == group, (
-        f"micro_batch_num {mbc} must group by {group}"
+    assert mbc % group == 0, (
+        f"interleaved schedule requires micro_batch_num {mbc} divisible "
+        f"by microbatch group size {group}"
     )
 
     def slot_to_op(slot: int) -> Tuple[int, int]:

@@ -116,6 +116,7 @@ class PerfLLM(PerfBase):
         self.chunks: Dict[tuple, LLMModel] = {}  # (stage, vpp_rank) -> chunk
         self._mem_result = None
         self._cost_result = None
+        self._interleaved_result = None
 
     # ------------------------------------------------------------------
     # Net placement (reference ``analysis_net`` perf_llm.py:369-474)
@@ -222,6 +223,7 @@ class PerfLLM(PerfBase):
         self._run()
         self._mem_result = None
         self._cost_result = None
+        self._interleaved_result = None
         return self
 
     # ------------------------------------------------------------------
@@ -235,31 +237,30 @@ class PerfLLM(PerfBase):
             return self._mem_result
         st = self.strategy
         pp, mbc, vp = st.pp_size, st.micro_batch_num, st.vp_size
-        stages = []
-        for s in range(pp):
-            chunks = self.stage_chunks(s)
-            model_mem = sum(c.param_info.total_bytes for c in chunks)
-            cache_per_mb = sum(c.act_info.cache_bytes for c in chunks)
-            replay_peak = max((c.peak_point.bytes for c in chunks), default=0.0)
-            if vp == 1:
+        if vp > 1:
+            stages = self._analysis_mem_interleaved()
+        else:
+            stages = []
+            for s in range(pp):
+                chunks = self.stage_chunks(s)
+                model_mem = sum(c.param_info.total_bytes for c in chunks)
+                cache_per_mb = sum(c.act_info.cache_bytes for c in chunks)
+                replay_peak = max(
+                    (c.peak_point.bytes for c in chunks), default=0.0
+                )
                 live = min(mbc, pp - s)
-            else:
-                # interleaved: stage s keeps up to pp*(vp-1) + (pp-s) in
-                # flight spread over its vp chunks (Megatron bound)
-                live = min(mbc * vp, pp * (vp - 1) + (pp - s))
-                cache_per_mb = cache_per_mb / vp  # per chunk-microbatch
-            peak = model_mem + max(live - 1, 0) * cache_per_mb + replay_peak
-            stages.append(
-                {
-                    "stage": s,
-                    "model_bytes": model_mem,
-                    "act_cache_per_microbatch_bytes": cache_per_mb,
-                    "live_microbatches": live,
-                    "replay_peak_bytes": replay_peak,
-                    "peak_bytes": peak,
-                    "peak_gib": peak / GiB,
-                }
-            )
+                peak = model_mem + max(live - 1, 0) * cache_per_mb + replay_peak
+                stages.append(
+                    {
+                        "stage": s,
+                        "model_bytes": model_mem,
+                        "act_cache_per_microbatch_bytes": cache_per_mb,
+                        "live_microbatches": live,
+                        "replay_peak_bytes": replay_peak,
+                        "peak_bytes": peak,
+                        "peak_gib": peak / GiB,
+                    }
+                )
         cap = self.system.mem_bytes * st.mem_factor
         result = {
             "stages": stages,
@@ -319,6 +320,9 @@ class PerfLLM(PerfBase):
                 while idx[s] < len(orders[s]):
                     kind, i = orders[s][idx[s]]
                     ph = phase_inputs[s]
+                    blocking = (
+                        0.0 if self.strategy.pp_comm_async else ph["p2p"]
+                    )
                     if kind == "F":
                         dep = 0.0 if s == 0 else F_end[s - 1][i]
                         if s > 0 and dep == 0.0:
@@ -326,6 +330,8 @@ class PerfLLM(PerfBase):
                         start = max(stage_clock[s], dep + (ph["p2p"] if s > 0 else 0.0))
                         end = start + ph["fwd"]
                         F_end[s][i] = end
+                        if s < pp - 1:
+                            end += blocking  # blocking isend stalls sender
                     else:
                         dep = 0.0 if s == pp - 1 else B_end[s + 1][i]
                         if s < pp - 1 and dep == 0.0:
@@ -335,6 +341,8 @@ class PerfLLM(PerfBase):
                         )
                         end = start + ph["bwd"]
                         B_end[s][i] = end
+                        if s > 0:
+                            end += blocking
                     stage_clock[s] = end
                     idx[s] += 1
                     remaining -= 1
@@ -349,6 +357,152 @@ class PerfLLM(PerfBase):
             "bubble": total - work0,
             "per_stage_end": per_stage_end,
         }
+
+    def calculate_interleaved_schedule(self) -> dict:
+        """Event-matched interleaved (VPP) schedule replay (reference
+        ``_compute_interleaved_sync_schedule`` perf_llm.py:2322-2605):
+        ops are (kind, chunk, microbatch); chunk c's forward output on
+        the last stage feeds chunk c+1 on stage 0, and backward wraps
+        the other way."""
+        if self._interleaved_result is not None:
+            return self._interleaved_result
+        from simumax_tpu.parallel.pipeline import interleaved_order
+
+        st = self.strategy
+        pp, mbc, vp = st.pp_size, st.micro_batch_num, st.vp_size
+        orders = [
+            interleaved_order(pp, s, mbc, vp, st.vpp_group_size)
+            for s in range(pp)
+        ]
+        fwd_t = {
+            (s, c): sum(
+                ch.cost_info.fwd_time
+                for ch in self.stage_chunks(s)
+                if ch.chunk_idx == c
+            )
+            for s in range(pp)
+            for c in range(vp)
+        }
+        bwd_t = {
+            (s, c): sum(
+                ch.cost_info.bwd_time
+                for ch in self.stage_chunks(s)
+                if ch.chunk_idx == c
+            )
+            for s in range(pp)
+            for c in range(vp)
+        }
+        p2p = self._stage_phase_inputs(0)["p2p"] if pp > 1 else 0.0
+
+        F_end: Dict[tuple, float] = {}
+        B_end: Dict[tuple, float] = {}
+        clock = [0.0] * pp
+        idx = [0] * pp
+        remaining = sum(len(o) for o in orders)
+        while remaining:
+            progressed = False
+            for s in range(pp):
+                while idx[s] < len(orders[s]):
+                    kind, c, mb = orders[s][idx[s]]
+                    blocking = 0.0 if st.pp_comm_async else p2p
+                    if kind == "F":
+                        if s > 0:
+                            dep = F_end.get((s - 1, c, mb))
+                        elif c > 0:
+                            dep = F_end.get((pp - 1, c - 1, mb))
+                        else:
+                            dep = 0.0
+                        if dep is None:
+                            break
+                        start = max(clock[s], dep + (p2p if (s > 0 or c > 0) else 0.0))
+                        end = start + fwd_t[(s, c)]
+                        F_end[(s, c, mb)] = end
+                        if s < pp - 1 or c < vp - 1:
+                            end += blocking  # blocking isend stalls sender
+                    else:
+                        if s < pp - 1:
+                            dep = B_end.get((s + 1, c, mb))
+                        elif c < vp - 1:
+                            dep = B_end.get((0, c + 1, mb))
+                        else:
+                            dep = 0.0  # loss chunk: ready after own fwd
+                        if dep is None:
+                            break
+                        start = max(
+                            clock[s],
+                            dep + (p2p if (s < pp - 1 or c < vp - 1) else 0.0),
+                        )
+                        end = start + bwd_t[(s, c)]
+                        B_end[(s, c, mb)] = end
+                        if s > 0 or c > 0:
+                            end += blocking
+                    clock[s] = end
+                    idx[s] += 1
+                    remaining -= 1
+                    progressed = True
+            assert progressed, "interleaved schedule deadlocked"
+        total = max(clock)
+        work0 = sum(
+            mbc * (fwd_t[(0, c)] + bwd_t[(0, c)]) for c in range(vp)
+        )
+        self._interleaved_result = {
+            "total": total,
+            "bubble": total - work0,
+            "per_stage_end": clock,
+            "orders": orders,
+        }
+        return self._interleaved_result
+
+    def _analysis_mem_interleaved(self) -> list:
+        """Per-stage peak via interleaved schedule replay (reference
+        sync-VPP phase-sequence memory replay perf_llm.py:1745-1928):
+        walk each stage's (F/B, chunk, mb) op list accumulating
+        per-chunk activation caches."""
+        from simumax_tpu.parallel.pipeline import interleaved_order
+
+        st = self.strategy
+        orders = [
+            interleaved_order(
+                st.pp_size, s, st.micro_batch_num, st.vp_size,
+                st.vpp_group_size,
+            )
+            for s in range(st.pp_size)
+        ]
+        stages = []
+        for s in range(st.pp_size):
+            cache = {
+                ch.chunk_idx: ch.act_info.cache_bytes
+                for ch in self.stage_chunks(s)
+            }
+            replay_peak = max(
+                (ch.peak_point.bytes for ch in self.stage_chunks(s)),
+                default=0.0,
+            )
+            model_mem = sum(
+                ch.param_info.total_bytes for ch in self.stage_chunks(s)
+            )
+            live = peak_live = 0.0
+            for kind, c, _ in orders[s]:
+                if kind == "F":
+                    live += cache.get(c, 0.0)
+                    peak_live = max(peak_live, live)
+                else:
+                    live -= cache.get(c, 0.0)
+            peak = model_mem + max(peak_live - max(cache.values(), default=0.0), 0.0) + replay_peak
+            stages.append(
+                {
+                    "stage": s,
+                    "model_bytes": model_mem,
+                    "act_cache_per_microbatch_bytes": sum(cache.values()) / st.vp_size,
+                    "live_microbatches": int(
+                        peak_live / max(sum(cache.values()) / st.vp_size, 1)
+                    ),
+                    "replay_peak_bytes": replay_peak,
+                    "peak_bytes": peak,
+                    "peak_gib": peak / (1024**3),
+                }
+            )
+        return stages
 
     def _compute_dp_time(self) -> dict:
         """Bucketed DP grad reduce-scatter + param all-gather, dense over
@@ -392,13 +546,25 @@ class PerfLLM(PerfBase):
         return detail
 
     def _compute_optim_time(self) -> float:
-        """Megatron distributed-optimizer step phases, memory-bound on HBM
-        (reference ``_compute_optim_time`` perf_llm.py:1470-1511)."""
+        """Optimizer-step time, memory-bound on HBM.
+
+        "megatron" style models the distributed-optimizer phases
+        (reference ``_compute_optim_time`` perf_llm.py:1470-1511):
+        zero-grad, l2-norm, adam over fp32 master+moments, param copy.
+        "functional" models one fused adam kernel as XLA emits for a
+        functional JAX train step: read grad+param+moments, write
+        param+moments.
+        """
         st, sysc = self.strategy, self.system
         numel = 0.0
         for c in self.stage_chunks(0):
             numel += c.param_info.dense_numel + c.param_info.moe_numel
         shard = numel / max(1, st.dp_size * st.cp_size) if st.zero_state else numel
+        if st.optimizer_style == "functional":
+            e = st.element_size
+            # grad read + param read/write + two fp32 moments read/write
+            traffic = shard * (st.grad_element_size + 2 * e + 16)
+            return sysc.compute_mem_access_time(traffic)
         t = 0.0
         t += sysc.compute_mem_access_time(numel * st.grad_element_size)  # zero grad
         t += sysc.compute_mem_access_time(shard * 4)  # l2 norm read
@@ -424,7 +590,11 @@ class PerfLLM(PerfBase):
             return self._cost_result
         st, m = self.strategy, self.model_config
         phase_inputs = [self._stage_phase_inputs(s) for s in range(st.pp_size)]
-        pp_res = self.calculate_1f1b_bubble(phase_inputs)
+        if st.vp_size > 1:
+            pp_res = self.calculate_interleaved_schedule()
+            pp_res.pop("orders", None)
+        else:
+            pp_res = self.calculate_1f1b_bubble(phase_inputs)
         dp_res = self._compute_dp_time()
         optim = self._compute_optim_time()
         iter_time = pp_res["total"] + dp_res["total"] + optim

@@ -27,11 +27,6 @@ def run_simulation(
     have completed ``run_estimate()``."""
     assert perf.chunks, "call run_estimate() before simulate()"
     st = perf.strategy
-    if st.vp_size > 1:
-        raise NotImplementedError(
-            "interleaved (VPP) schedules are not yet supported by the "
-            "event simulator; use the analytical path"
-        )
     pp = st.pp_size
     engine = SimuEngine(pp)
     trackers = []

@@ -70,8 +70,8 @@ class StageProcess:
             self.tracker.free(t, nbytes, token, tag)
 
     # -- one microbatch forward / backward ---------------------------------
-    def _fwd(self, mb: int, clock: List[float]) -> Generator:
-        for chunk in self.chunks:
+    def _fwd(self, mb: int, clock: List[float], chunks=None) -> Generator:
+        for chunk in (chunks if chunks is not None else self.chunks):
             leaves = chunk.called_leaves()
             if self.granularity == "chunk":
                 dur = chunk.cost_info.fwd_time
@@ -102,8 +102,8 @@ class StageProcess:
                     t = yield ("compute", post, f"{name}.fwd_comm", "comm")
                     clock[0] = t
 
-    def _bwd(self, mb: int, clock: List[float]) -> Generator:
-        for chunk in reversed(self.chunks):
+    def _bwd(self, mb: int, clock: List[float], chunks=None) -> Generator:
+        for chunk in reversed(chunks if chunks is not None else self.chunks):
             leaves = chunk.called_leaves()
             if self.granularity == "chunk":
                 dur = chunk.cost_info.bwd_time
@@ -205,6 +205,9 @@ class StageProcess:
 
     # -- full schedule ------------------------------------------------------
     def process(self) -> Generator:
+        if self.st.vp_size > 1:
+            yield from self._process_interleaved()
+            return
         st, stage, pp = self.st, self.stage, self.pp
         mbc = st.micro_batch_num
         clock = [0.0]
@@ -221,6 +224,8 @@ class StageProcess:
                         f"send_fwd{mb}", "pp_fwd",
                     )
                     clock[0] = t
+                    if not st.pp_comm_async:
+                        yield ("advance", clock[0] + self.p2p_time)
             else:
                 if stage < pp - 1:
                     t = yield ("recv", stage + 1, f"bwd{mb}",
@@ -233,4 +238,53 @@ class StageProcess:
                         f"send_bwd{mb}", "pp_bwd",
                     )
                     clock[0] = t
+                    if not st.pp_comm_async:
+                        yield ("advance", clock[0] + self.p2p_time)
+        yield from self._optimizer(clock)
+
+    def _process_interleaved(self) -> Generator:
+        """Interleaved (VPP) schedule: chunk c's forward on the last
+        stage feeds chunk c+1 on stage 0; backward wraps the other way
+        (Megatron interleaved 1F1B, reference
+        ``pipeline_schedule.py:97-715``)."""
+        from simumax_tpu.parallel.pipeline import interleaved_order
+
+        st, stage, pp = self.st, self.stage, self.pp
+        vp, mbc = st.vp_size, st.micro_batch_num
+        group = st.vpp_group_size
+        by_chunk = {c.chunk_idx: [c] for c in self.chunks}
+        clock = [0.0]
+        for kind, c, mb in interleaved_order(pp, stage, mbc, vp, group):
+            if kind == "F":
+                if not (stage == 0 and c == 0):
+                    src = stage - 1 if stage > 0 else pp - 1
+                    t = yield ("recv", src, f"fwd_c{c}_mb{mb}",
+                               f"recv_fwd_c{c}_mb{mb}", "pp_fwd")
+                    clock[0] = t
+                yield from self._fwd(mb, clock, by_chunk[c])
+                if not (stage == pp - 1 and c == vp - 1):
+                    dst = stage + 1 if stage < pp - 1 else 0
+                    rc = c if stage < pp - 1 else c + 1
+                    t = yield ("send", dst, f"fwd_c{rc}_mb{mb}",
+                               self.p2p_time, f"send_fwd_c{rc}_mb{mb}",
+                               "pp_fwd")
+                    clock[0] = t
+                    if not st.pp_comm_async:
+                        yield ("advance", clock[0] + self.p2p_time)
+            else:
+                if not (stage == pp - 1 and c == vp - 1):
+                    src = stage + 1 if stage < pp - 1 else 0
+                    t = yield ("recv", src, f"bwd_c{c}_mb{mb}",
+                               f"recv_bwd_c{c}_mb{mb}", "pp_bwd")
+                    clock[0] = t
+                yield from self._bwd(mb, clock, by_chunk[c])
+                if not (stage == 0 and c == 0):
+                    dst = stage - 1 if stage > 0 else pp - 1
+                    rc = c if stage > 0 else c - 1
+                    t = yield ("send", dst, f"bwd_c{rc}_mb{mb}",
+                               self.p2p_time, f"send_bwd_c{rc}_mb{mb}",
+                               "pp_bwd")
+                    clock[0] = t
+                    if not st.pp_comm_async:
+                        yield ("advance", clock[0] + self.p2p_time)
         yield from self._optimizer(clock)

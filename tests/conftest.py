@@ -1,13 +1,27 @@
 import os
 import sys
 
-# JAX-dependent tests (calibration / jaxref) run on a virtual 8-device CPU
-# mesh; the analytical simulator itself is hardware-free.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on a virtual 8-device CPU mesh; the analytical simulator is
+# hardware-free and the JAX tests only validate sharding/plumbing, so
+# the suite must never block on a remote accelerator tunnel. Some
+# environments install a TPU-tunnel PJRT plugin via sitecustomize that
+# forces its own platform regardless of JAX_PLATFORMS — deregister it
+# before any backend is initialized.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -159,6 +159,31 @@ class TestClosedFormCrossChecks:
         assert cost["mfu"] == pytest.approx(expect)
 
 
+class TestQuantized:
+    def test_int8_faster_than_bf16(self):
+        base = run("tp2_pp1_dp4_mbs1")
+        q = run("tp2_pp1_dp4_mbs1", fp8=True)
+        assert (
+            q.analysis_cost()["iter_time"]
+            < base.analysis_cost()["iter_time"]
+        )
+        qkv = q.chunks[(0, 0)].blocks[0].attention.qkv_proj
+        assert qkv.comp_key("fwd")[0] == "int8_matmul"
+
+    def test_quant_cast_traffic_counted(self):
+        base = run("tp2_pp1_dp4_mbs1")
+        q = run("tp2_pp1_dp4_mbs1", fp8=True)
+        b_acc = base.chunks[(0, 0)].blocks[0].attention.qkv_proj.compute_info
+        q_acc = q.chunks[(0, 0)].blocks[0].attention.qkv_proj.compute_info
+        assert q_acc.fwd_accessed > b_acc.fwd_accessed
+
+    def test_quantized_moe_group_gemm(self):
+        p = run("ep8_pp1_dp8_mbs1", model="mixtral-8x7b",
+                system="tpu_v5p_256", fp8=True)
+        up = p.chunks[(0, 0)].blocks[0].mlp.experts_up
+        assert up.comp_key("fwd")[0] == "int8_group_matmul"
+
+
 class TestMemoryModel:
     def test_pp_stage0_holds_more_microbatches(self):
         p = run("tp1_pp2_dp4_mbs1")

@@ -144,13 +144,44 @@ class TestPerfVsSimulator:
         assert chunk["num_events"] < leaf["num_events"] / 10
 
 
-class TestGuards:
-    def test_vpp_not_yet_simulated(self):
+class TestVPP:
+    def test_vpp_sim_matches_analytical(self):
         st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
         p = run(st)
-        with pytest.raises(NotImplementedError, match="VPP"):
-            p.simulate(None)
+        c = p.analysis_cost()
+        r = p.simulate(None)
+        assert r["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
 
+    def test_vpp_memory_matches_analytical(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        p = run(st)
+        mem = p.analysis_mem()
+        r = p.simulate(None)
+        for s, m in zip(mem["stages"], r["memory"]):
+            assert m["peak_bytes"] == pytest.approx(s["peak_bytes"], rel=0.08)
+
+    def test_vpp_shrinks_bubble(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        p = run(st)
+        st1 = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        st1.interleaving_size = 1
+        p1 = run(st1)
+        assert (
+            p.analysis_cost()["bubble_time"]
+            < p1.analysis_cost()["bubble_time"]
+        )
+
+    def test_vpp4_runs(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        st.interleaving_size = 4
+        p = run(st)
+        r = p.simulate(None)
+        assert r["end_time"] == pytest.approx(
+            p.analysis_cost()["iter_time"], rel=0.01
+        )
+
+
+class TestGuards:
     def test_disjoint_collective_groups_with_same_key(self):
         eng = SimuEngine(4)
 
