@@ -1,0 +1,47 @@
+"""Search the best parallel strategy for Llama-3-8B on a v5p mesh
+(north-star config 5; mirrors the reference's
+``examples/search_strategy_llama3_8b.py:36-78``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.search import search_best_parallel_strategy
+
+
+def main():
+    model = get_model_config("llama3-8b")
+    system = get_system_config("tpu_v5p_256")
+    base = get_strategy_config("tp1_pp1_dp8_mbs1")
+    base.world_size = 64
+    top = search_best_parallel_strategy(
+        base,
+        model,
+        system,
+        global_batch_size=128,
+        tp_list=(1, 2, 4, 8),
+        pp_list=(1, 2, 4),
+        recompute_types=("none", "selective", "full_block"),
+        topk=5,
+        csv_path=os.environ.get("SIMU_SWEEP_CSV"),
+        verbose=False,
+    )
+    print(f"top {len(top)} strategies for llama3-8b @ 64x v5p, gbs 128:")
+    for r in top:
+        print(
+            f"  tp{r['tp']} cp{r['cp']} pp{r['pp']} dp{r['dp']} vp{r['vp']} "
+            f"mbs{r['mbs']} mbc{r['mbc']} recompute={r['recompute']}: "
+            f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
+            f"peak {r['peak_gib']:.1f} GiB"
+        )
+    return top
+
+
+if __name__ == "__main__":
+    main()

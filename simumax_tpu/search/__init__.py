@@ -1,0 +1,9 @@
+from simumax_tpu.search.searcher import (  # noqa: F401
+    StrategySearcher,
+    evaluate_strategy,
+    search_best_parallel_strategy,
+    search_best_selective_recompute,
+    search_best_recompute_layer_num,
+    search_max_micro_batch_size,
+    search_micro_batch_config,
+)

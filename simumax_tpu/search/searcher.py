@@ -1,0 +1,345 @@
+"""Strategy search family (L7).
+
+Reference: ``simumax/tuning/strategy_searcher.py`` (grid ``StrategySearcher``)
+and the ``PerfLLM.search_*`` family (``perf_llm.py:3080-3578``): binary
+search of the max micro-batch size, fixed-GBS (mbs, mbc) search with a
+GiB safety margin, selective-recompute combos, recompute-layer binary
+search, and the full tp x ep x pp sweep with CSV dump, memoized so the
+sweep stays tractable.
+
+TPU notes: every evaluated candidate records its mesh placement
+(``net`` column in result rows; ``dcn_dims`` in the CSV flags parallel
+dims that spilled over the slice onto DCN).
+"""
+
+from __future__ import annotations
+
+import copy
+import csv
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from simumax_tpu.core.config import (
+    GiB,
+    ModelConfig,
+    StrategyConfig,
+    SystemConfig,
+)
+from simumax_tpu.perf import PerfLLM
+
+#: result-cache key: the strategy fields that affect estimates
+_KEY_FIELDS = (
+    "seq_len", "micro_batch_size", "micro_batch_num", "dtype", "fp8",
+    "world_size", "tp_size", "cp_size", "pp_size", "ep_size", "etp_size",
+    "enable_sequence_parallel", "cp_comm_type", "cp_a2a_mode",
+    "interleaving_size", "microbatch_group_size_per_vp_stage",
+    "pp_comm_async", "zero_state", "use_fused_norm", "use_flash_sdp",
+    "use_fused_ce", "use_fp32_accum_grad", "grad_reduce_in_bf16",
+    "optimizer_style", "enable_recompute", "recompute_granularity",
+    "recompute_layer_num", "attn_recompute", "attn_norm_recompute",
+    "mla_rms_recompute", "mlp_recompute", "mlp_rms_recompute",
+    "sdp_recompute", "moe_capacity_factor",
+)
+
+
+def _strategy_key(st: StrategyConfig, model, system, gib_margin) -> tuple:
+    # model/system identity + margin are part of the verdict, not just
+    # the strategy fields
+    return (
+        id(model), id(system), gib_margin,
+        tuple(getattr(st, f) for f in _KEY_FIELDS),
+    )
+
+
+def evaluate_strategy(
+    strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    cache: Optional[Dict] = None,
+    gib_margin: float = 0.0,
+) -> Optional[dict]:
+    """Estimate one candidate; returns a flat result row or None when
+    the candidate is invalid or does not fit in HBM (reference
+    feasibility gate ``perf_llm.py:3148-3149``)."""
+    key = _strategy_key(strategy, model, system, gib_margin)
+    if cache is not None and key in cache:
+        return cache[key]
+    row = None
+    try:
+        strategy = copy.deepcopy(strategy)
+        strategy.__post_init__()
+        perf = PerfLLM().configure(strategy, model, system)
+        perf.run_estimate()
+        mem = perf.analysis_mem()
+        cost = perf.analysis_cost()
+        fits = mem["max_peak_bytes"] + gib_margin * GiB <= (
+            system.mem_bytes * strategy.mem_factor
+        )
+        row = {
+            "tp": strategy.tp_size, "cp": strategy.cp_size,
+            "pp": strategy.pp_size, "dp": strategy.dp_size,
+            "ep": strategy.ep_size, "etp": strategy.etp_size,
+            "vp": strategy.vp_size,
+            "mbs": strategy.micro_batch_size,
+            "mbc": strategy.micro_batch_num,
+            "recompute": (
+                strategy.recompute.granularity
+                if strategy.recompute.enabled
+                else "none"
+            ),
+            "recompute_layers": strategy.recompute_layer_num,
+            "mfu": cost["mfu"],
+            "iter_ms": cost["iter_time_ms"],
+            "tgs": cost["tgs"],
+            "peak_gib": mem["max_peak_gib"],
+            "fits": fits,
+            "net": {k: p.describe() for k, p in perf.ctx.paths.items()},
+        }
+        if not fits:
+            row = {**row, "mfu": 0.0}
+    except (AssertionError, ValueError, ZeroDivisionError):
+        row = None
+    if cache is not None:
+        cache[key] = row
+    return row
+
+
+def search_max_micro_batch_size(
+    strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    limit: int = 64,
+    cache: Optional[Dict] = None,
+) -> int:
+    """Binary-search the largest feasible micro_batch_size
+    (reference ``perf_llm.py:3080``)."""
+    lo, hi, best = 1, limit, 0
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        st = copy.deepcopy(strategy)
+        st.micro_batch_size = mid
+        row = evaluate_strategy(st, model, system, cache)
+        if row is not None and row["fits"]:
+            best = mid
+            lo = mid + 1
+        else:
+            hi = mid - 1
+    return best
+
+
+def search_micro_batch_config(
+    strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    global_batch_size: int,
+    gib_margin: float = 1.0,
+    cache: Optional[Dict] = None,
+) -> Optional[dict]:
+    """Fixed-GBS (mbs, mbc) search with a GiB safety margin
+    (reference ``perf_llm.py:3111-3167``, ``gmi_error``)."""
+    dp = strategy.dp_size
+    assert global_batch_size % dp == 0, (global_batch_size, dp)
+    per_dp = global_batch_size // dp
+    best = None
+    for mbs in range(1, per_dp + 1):
+        if per_dp % mbs:
+            continue
+        st = copy.deepcopy(strategy)
+        st.micro_batch_size = mbs
+        st.micro_batch_num = per_dp // mbs
+        if st.vp_size > 1 and st.micro_batch_num % st.vpp_group_size:
+            continue
+        row = evaluate_strategy(st, model, system, cache, gib_margin)
+        if row is None or not row["fits"]:
+            continue
+        if best is None or row["mfu"] > best["mfu"]:
+            best = row
+    return best
+
+
+_SELECTIVE_COMBOS = (
+    # curated combos (reference ``perf_llm.py:3213-3268``)
+    dict(sdp_recompute=True),
+    dict(attn_recompute=True, attn_norm_recompute=True),
+    dict(attn_recompute=True, attn_norm_recompute=True,
+         mlp_recompute=True, mlp_rms_recompute=True),
+)
+
+
+def search_best_selective_recompute(
+    strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    cache: Optional[Dict] = None,
+) -> Optional[dict]:
+    best = None
+    for combo in _SELECTIVE_COMBOS:
+        st = copy.deepcopy(strategy)
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.recompute_layer_num = -1
+        for k, v in combo.items():
+            setattr(st, k, v)
+        row = evaluate_strategy(st, model, system, cache)
+        if row is None or not row["fits"]:
+            continue
+        if best is None or row["mfu"] > best["mfu"]:
+            best = row
+    return best
+
+
+def search_best_recompute_layer_num(
+    strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    cache: Optional[Dict] = None,
+) -> Optional[dict]:
+    """Binary-search the fewest full-recompute layers that still fit
+    (reference ``perf_llm.py:3270-3328``) — fewer recomputed layers is
+    always faster, so the optimum is the smallest feasible count."""
+    layers_per_stage = -(-model.layer_num // (strategy.pp_size * strategy.vp_size))
+    lo, hi = 0, layers_per_stage
+    best = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        st = copy.deepcopy(strategy)
+        st.enable_recompute = mid > 0
+        st.recompute_granularity = "full_block"
+        st.recompute_layer_num = mid
+        row = evaluate_strategy(st, model, system, cache)
+        if row is not None and row["fits"]:
+            best = row
+            hi = mid - 1
+        else:
+            lo = mid + 1
+    return best
+
+
+def search_best_parallel_strategy(
+    base_strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    global_batch_size: int,
+    tp_list: Sequence[int] = (1, 2, 4, 8),
+    pp_list: Sequence[int] = (1, 2, 4),
+    ep_list: Sequence[int] = (1,),
+    cp_list: Sequence[int] = (1,),
+    recompute_types: Sequence[str] = ("none", "selective", "full_block"),
+    topk: int = 5,
+    csv_path: Optional[str] = None,
+    verbose: bool = False,
+    cache: Optional[Dict] = None,
+) -> List[dict]:
+    """Full tp x cp x ep x pp sweep (reference
+    ``search_best_parallel_strategy`` perf_llm.py:3355-3578): for each
+    layout, search the batch split, then each recompute family; rank by
+    MFU."""
+    cache = {} if cache is None else cache
+    rows: List[dict] = []
+    world = base_strategy.world_size
+    for tp, cp, ep, pp in itertools.product(tp_list, cp_list, ep_list, pp_list):
+        if world % (tp * cp * pp) or world % (ep * pp):
+            continue
+        if model.model_type != "moe" and ep > 1:
+            continue
+        st = copy.deepcopy(base_strategy)
+        st.tp_size, st.cp_size = tp, cp
+        st.ep_size, st.pp_size = ep, pp
+        st.etp_size = min(st.etp_size, tp) or 1
+        if st.dp_size < 1 or global_batch_size % st.dp_size:
+            continue
+        for rc in recompute_types:
+            candidates: List[Optional[dict]] = []
+            st_rc = copy.deepcopy(st)
+            if rc == "none":
+                st_rc.enable_recompute = False
+                candidates.append(
+                    search_micro_batch_config(
+                        st_rc, model, system, global_batch_size, cache=cache
+                    )
+                )
+            elif rc == "selective":
+                base_batch = search_micro_batch_config(
+                    st_rc, model, system, global_batch_size, cache=cache
+                )
+                bs = base_batch or {"mbs": 1, "mbc": global_batch_size // st.dp_size}
+                st_rc.micro_batch_size = bs["mbs"]
+                st_rc.micro_batch_num = bs["mbc"]
+                candidates.append(
+                    search_best_selective_recompute(
+                        st_rc, model, system, cache=cache
+                    )
+                )
+            elif rc == "full_block":
+                st_rc.micro_batch_size = 1
+                st_rc.micro_batch_num = global_batch_size // st.dp_size
+                candidates.append(
+                    search_best_recompute_layer_num(
+                        st_rc, model, system, cache=cache
+                    )
+                )
+            for row in candidates:
+                if row is not None and row["fits"]:
+                    rows.append(row)
+                    if verbose:
+                        print(
+                            f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
+                            f"pp{row['pp']} {row['recompute']}: "
+                            f"mfu {row['mfu']*100:.2f}% "
+                            f"peak {row['peak_gib']:.1f} GiB"
+                        )
+    # dedup: the recompute-layer search bottoming out at 0 layers is the
+    # same candidate as the no-recompute row
+    seen = set()
+    uniq = []
+    for r in rows:
+        rl = r["recompute_layers"] if r["recompute"] != "none" else 0
+        key = (r["tp"], r["cp"], r["ep"], r["pp"], r["vp"], r["mbs"],
+               r["mbc"], r["recompute"], rl)
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append(r)
+    rows = uniq
+    rows.sort(key=lambda r: r["mfu"], reverse=True)
+    for r in rows:
+        r["dcn_dims"] = ",".join(
+            d for d, desc in r["net"].items() if "dcn[" in desc
+        )
+    if csv_path:
+        fields = [k for k in rows[0] if k != "net"] if rows else []
+        with open(csv_path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            w.writeheader()
+            w.writerows(rows)
+    return rows[:topk]
+
+
+@dataclass
+class StrategySearcher:
+    """Grid searcher over candidate dicts (reference
+    ``tuning/strategy_searcher.py:12-216``)."""
+
+    model: ModelConfig
+    system: SystemConfig
+    base_strategy: StrategyConfig
+    cache: Dict = field(default_factory=dict)
+
+    def search(
+        self,
+        global_batch_size: int,
+        topk: int = 3,
+        csv_path: Optional[str] = None,
+        **sweep_lists,
+    ) -> List[dict]:
+        return search_best_parallel_strategy(
+            self.base_strategy,
+            self.model,
+            self.system,
+            global_batch_size,
+            topk=topk,
+            csv_path=csv_path,
+            cache=self.cache,
+            **sweep_lists,
+        )

@@ -1,0 +1,115 @@
+"""Strategy-search family tests (L7)."""
+
+import os
+
+import pytest
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.search import (
+    StrategySearcher,
+    evaluate_strategy,
+    search_best_parallel_strategy,
+    search_best_recompute_layer_num,
+    search_max_micro_batch_size,
+    search_micro_batch_config,
+)
+
+
+def setup():
+    m = get_model_config("llama3-8b")
+    sysc = get_system_config("tpu_v5p_256")
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    return m, sysc, st
+
+
+class TestEvaluate:
+    def test_returns_row(self):
+        m, sysc, st = setup()
+        row = evaluate_strategy(st, m, sysc)
+        assert row is not None and 0 < row["mfu"] < 1
+        assert "net" in row
+
+    def test_infeasible_marked(self):
+        m, sysc, st = setup()
+        st.micro_batch_size = 64  # won't fit
+        row = evaluate_strategy(st, m, sysc)
+        assert row is not None and not row["fits"] and row["mfu"] == 0.0
+
+    def test_invalid_returns_none(self):
+        m, sysc, st = setup()
+        st.tp_size = 3  # 8 % 3 != 0
+        assert evaluate_strategy(st, m, sysc) is None
+
+    def test_cache_hit(self):
+        m, sysc, st = setup()
+        cache = {}
+        r1 = evaluate_strategy(st, m, sysc, cache)
+        r2 = evaluate_strategy(st, m, sysc, cache)
+        assert r1 is r2 and len(cache) == 1
+
+
+class TestSearches:
+    def test_max_mbs_monotone(self):
+        m, sysc, st = setup()
+        st.tp_size = 8
+        st.world_size = 8
+        mbs8 = search_max_micro_batch_size(st, m, sysc)
+        st2 = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st2.tp_size = 2
+        mbs2 = search_max_micro_batch_size(st2, m, sysc)
+        assert mbs8 > mbs2 > 0  # more tp shards -> more room
+
+    def test_micro_batch_config_respects_gbs(self):
+        m, sysc, st = setup()
+        best = search_micro_batch_config(st, m, sysc, global_batch_size=64)
+        assert best is not None
+        assert best["mbs"] * best["mbc"] * best["dp"] == 64
+
+    def test_recompute_layer_search_minimizes(self):
+        m, sysc, st = setup()
+        sysc_small = get_system_config("tpu_v5e_256")  # 16 GiB: tight
+        st.tp_size = 8
+        st.world_size = 8
+        st.micro_batch_size = 4
+        st.micro_batch_num = 2
+        best = search_best_recompute_layer_num(st, m, sysc_small)
+        if best is not None:
+            assert best["fits"]
+
+    def test_full_sweep_ranked_and_unique(self, tmp_path):
+        m, sysc, st = setup()
+        st.world_size = 64
+        csv_path = str(tmp_path / "sweep.csv")
+        rows = search_best_parallel_strategy(
+            st, m, sysc, global_batch_size=64,
+            tp_list=(1, 2, 4), pp_list=(1, 2), topk=10, csv_path=csv_path,
+        )
+        assert rows
+        mfus = [r["mfu"] for r in rows]
+        assert mfus == sorted(mfus, reverse=True)
+        keys = [(r["tp"], r["pp"], r["mbs"], r["mbc"], r["recompute"]) for r in rows]
+        assert len(keys) == len(set(keys))
+        assert os.path.getsize(csv_path) > 0
+        assert all(r["pp"] in (1, 2) and r["tp"] in (1, 2, 4) for r in rows)
+
+    def test_moe_sweep_with_ep(self):
+        m = get_model_config("mixtral-8x7b")
+        sysc = get_system_config("tpu_v5p_256")
+        st = get_strategy_config("ep8_pp1_dp8_mbs1")
+        st.world_size = 64
+        rows = search_best_parallel_strategy(
+            st, m, sysc, global_batch_size=64,
+            tp_list=(1,), pp_list=(1,), ep_list=(2, 4, 8), topk=5,
+        )
+        assert rows and all(r["ep"] in (2, 4, 8) for r in rows)
+
+    def test_searcher_wrapper(self):
+        m, sysc, st = setup()
+        st.world_size = 16
+        s = StrategySearcher(m, sysc, st)
+        rows = s.search(global_batch_size=16, tp_list=(1, 2), pp_list=(1,), topk=2)
+        assert len(rows) <= 2 and rows[0]["mfu"] >= rows[-1]["mfu"]
