@@ -1,0 +1,116 @@
+"""Interactive web app (L8) — config picker/editor, runs PerfLLM,
+renders results, offers artifact download.
+
+Reference: ``app/streamlit_app.py`` (862 LoC). Requires ``streamlit``
+(not part of the baked environment): ``pip install streamlit`` then
+``streamlit run app/streamlit_app.py``. The same workflows are available
+without extra deps through ``python -m simumax_tpu`` (see
+``simumax_tpu/cli.py``).
+"""
+
+import io
+import json
+import os
+import sys
+import zipfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import streamlit as st
+except ImportError:  # pragma: no cover
+    print(__doc__)
+    sys.exit("streamlit is not installed; use `python -m simumax_tpu` instead")
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import (
+    ModelConfig,
+    StrategyConfig,
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+    list_configs,
+)
+
+st.set_page_config(page_title="simumax-tpu", layout="wide")
+st.title("simumax-tpu — analytical LLM training simulator for TPU")
+
+cfgs = list_configs()
+col1, col2, col3 = st.columns(3)
+with col1:
+    model_name = st.selectbox("model", cfgs["models"], index=max(
+        cfgs["models"].index("llama3-8b") if "llama3-8b" in cfgs["models"] else 0, 0))
+with col2:
+    strategy_name = st.selectbox("strategy", cfgs["strategy"])
+with col3:
+    system_name = st.selectbox("system", cfgs["system"])
+
+model = get_model_config(model_name)
+strategy = get_strategy_config(strategy_name)
+
+with st.expander("edit model config"):
+    model_json = st.text_area(
+        "model json", json.dumps(model.to_dict(), indent=2), height=240
+    )
+    model = ModelConfig.init_from_dict(json.loads(model_json))
+with st.expander("edit strategy config"):
+    strategy_json = st.text_area(
+        "strategy json", json.dumps(strategy.to_dict(), indent=2, default=str),
+        height=240,
+    )
+    data = json.loads(strategy_json)
+    data.pop("recompute", None)
+    strategy = StrategyConfig.init_from_dict(data)
+
+run_sim = st.checkbox("also run the event simulator (Chrome trace)")
+
+if st.button("estimate"):
+    perf = PerfLLM().configure(strategy, model, system_name)
+    perf.run_estimate()
+    result = perf.analysis(verbose=False)
+    cost, mem = result["compute_result"], result["mem_result"]
+
+    c1, c2, c3, c4 = st.columns(4)
+    c1.metric("iteration", f"{cost['iter_time_ms']:.1f} ms")
+    c2.metric("MFU", f"{cost['mfu']*100:.2f} %")
+    c3.metric("TFLOPS/chip", f"{cost['tflops_per_chip']:.1f}")
+    c4.metric(
+        "peak HBM",
+        f"{mem['max_peak_gib']:.2f} GiB",
+        delta="fits" if mem["fits"] else "DOES NOT FIT",
+        delta_color="normal" if mem["fits"] else "inverse",
+    )
+    st.subheader("per-stage memory")
+    st.dataframe(mem["stages"])
+    st.subheader("mesh placement")
+    st.json(result["net_info"])
+    misses = result["efficiency_misses"]
+    if misses:
+        st.info(
+            f"{sum(len(v) for v in misses.values())} efficiency-table "
+            "misses — run `python -m simumax_tpu calibrate` on a TPU to "
+            "refine the prediction."
+        )
+
+    artifacts = {
+        "base_info.json": result["base_info"],
+        "mem_result.json": mem,
+        "compute_result.json": cost,
+        "net_info.json": result["net_info"],
+    }
+    if run_sim:
+        sim = perf.simulate("tmp/app_sim")
+        st.subheader("simulator")
+        st.write(
+            f"event-simulated iteration: {sim['end_time_ms']:.2f} ms "
+            f"({sim['num_events']} events)"
+        )
+        with open(sim["trace_path"]) as f:
+            artifacts["trace.json"] = json.load(f)
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        for name, data in artifacts.items():
+            z.writestr(name, json.dumps(data, indent=1, default=str))
+    st.download_button("download artifacts (.zip)", buf.getvalue(),
+                       "simumax_tpu_results.zip")

@@ -158,7 +158,7 @@ def main():
     print(json.dumps(result))
 
 
-def supervised_main(attempts=2, timeout_s=480):
+def supervised_main(attempts=2, timeout_s=560):
     """The TPU tunnel can hang indefinitely at backend init; run the
     real bench in a child process with a timeout and retry so the
     driver always gets its one JSON line."""

@@ -1,0 +1,46 @@
+"""Llama-3-70B (layer-truncated l12, as in the reference's B200 CP
+table) long-context CP on v5p: Ulysses a2a vs KV-gather ring at 32K and
+128K sequence (north-star config 4)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+
+def run(cp, seq_len, comm_type):
+    model = get_model_config("llama3-70b")
+    model.layer_num = 12
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = 32
+    st.tp_size = 2  # v5p is 95 GiB/chip; shard the 70B weights
+    st.cp_size = cp
+    st.seq_len = seq_len
+    st.micro_batch_num = 4
+    st.cp_comm_type = comm_type
+    st.enable_recompute = True
+    st.recompute_granularity = "selective_recompute"
+    st.sdp_recompute = True
+    st.__post_init__()
+    perf = PerfLLM().configure(st, model, "tpu_v5p_256")
+    perf.run_estimate()
+    c, m = perf.analysis_cost(), perf.analysis_mem()
+    print(
+        f"cp{cp} seq{seq_len} {comm_type:10s}: "
+        f"iter {c['iter_time_ms']:8.1f} ms  MFU {c['mfu']*100:5.2f}%  "
+        f"peak {m['max_peak_gib']:6.2f} GiB  fits={m['fits']}"
+    )
+
+
+def main():
+    for seq in (32768, 131072):
+        for cp in (4, 8):
+            for comm in ("a2a", "all_gather"):
+                run(cp, seq, comm)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Run every example (reference examples/run_all.sh analog).
+set -e
+cd "$(dirname "$0")"
+for f in perf_*.py search_*.py simulator_*.py; do
+  echo "=== $f"
+  python "$f"
+done

@@ -1,0 +1,146 @@
+"""Command-line surface (L8).
+
+Reference user surfaces: 18 example scripts + the Streamlit app
+(``app/streamlit_app.py``). The CLI covers the same workflows
+non-interactively::
+
+    python -m simumax_tpu list
+    python -m simumax_tpu perf --model llama3-8b \
+        --strategy tp1_pp2_dp4_mbs1 --system tpu_v5e_256 [--simulate DIR]
+    python -m simumax_tpu search --model llama3-8b --system tpu_v5p_256 \
+        --world 64 --gbs 128 --tp 1,2,4,8 --pp 1,2,4 [--csv sweep.csv]
+    python -m simumax_tpu calibrate --model ... --strategy ... \
+        --system ... --save my_system.json      # needs a live TPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _ints(s: str):
+    return tuple(int(x) for x in s.split(","))
+
+
+def cmd_list(args):
+    from simumax_tpu.core.config import list_configs
+
+    for kind, names in list_configs().items():
+        print(f"{kind}:")
+        for n in names:
+            print(f"  {n}")
+
+
+def cmd_perf(args):
+    from simumax_tpu import PerfLLM
+
+    perf = PerfLLM().configure(args.strategy, args.model, args.system)
+    perf.run_estimate(capture_graph=args.graph)
+    perf.analysis(save_path=args.save)
+    if args.simulate:
+        result = perf.simulate(args.simulate)
+        print(
+            f"simulated: {result['end_time_ms']:.2f} ms, "
+            f"trace at {result.get('trace_path')}"
+        )
+
+
+def cmd_search(args):
+    from simumax_tpu.core.config import (
+        get_model_config,
+        get_strategy_config,
+        get_system_config,
+    )
+    from simumax_tpu.search import search_best_parallel_strategy
+
+    model = get_model_config(args.model)
+    system = get_system_config(args.system)
+    base = get_strategy_config(args.base_strategy)
+    if args.world:
+        base.world_size = args.world
+    if args.seq_len:
+        base.seq_len = args.seq_len
+    rows = search_best_parallel_strategy(
+        base, model, system, args.gbs,
+        tp_list=_ints(args.tp), pp_list=_ints(args.pp),
+        ep_list=_ints(args.ep), cp_list=_ints(args.cp),
+        topk=args.topk, csv_path=args.csv, verbose=args.verbose,
+    )
+    for r in rows:
+        print(
+            f"tp{r['tp']} cp{r['cp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
+            f"mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
+            f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
+            f"peak {r['peak_gib']:.1f} GiB"
+            + (f"  [DCN: {r['dcn_dims']}]" if r.get("dcn_dims") else "")
+        )
+
+
+def cmd_calibrate(args):
+    from simumax_tpu import PerfLLM
+    from simumax_tpu.calibration import calibrate_system
+
+    perf = PerfLLM().configure(args.strategy, args.model, args.system)
+    perf.run_estimate()
+    measured = calibrate_system(
+        perf, save_path=args.save, max_keys=args.max_keys, verbose=True
+    )
+    n = sum(len(v) for v in measured.values())
+    print(f"calibrated {n} shape keys"
+          + (f"; wrote {args.save}" if args.save else ""))
+    perf.analysis()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="simumax_tpu",
+        description="TPU-native analytical simulator for LLM training",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list", help="list available configs").set_defaults(
+        fn=cmd_list
+    )
+
+    pp = sub.add_parser("perf", help="estimate one configuration")
+    pp.add_argument("--model", required=True)
+    pp.add_argument("--strategy", required=True)
+    pp.add_argument("--system", required=True)
+    pp.add_argument("--save", help="directory for result JSONs")
+    pp.add_argument("--simulate", help="run the event simulator; dir for trace")
+    pp.add_argument("--graph", action="store_true", help="capture op graph")
+    pp.set_defaults(fn=cmd_perf)
+
+    ps = sub.add_parser("search", help="sweep parallel strategies")
+    ps.add_argument("--model", required=True)
+    ps.add_argument("--system", required=True)
+    ps.add_argument("--base-strategy", default="tp1_pp1_dp8_mbs1")
+    ps.add_argument("--world", type=int, default=0)
+    ps.add_argument("--seq-len", type=int, default=0)
+    ps.add_argument("--gbs", type=int, required=True)
+    ps.add_argument("--tp", default="1,2,4,8")
+    ps.add_argument("--pp", default="1,2,4")
+    ps.add_argument("--ep", default="1")
+    ps.add_argument("--cp", default="1")
+    ps.add_argument("--topk", type=int, default=5)
+    ps.add_argument("--csv")
+    ps.add_argument("--verbose", action="store_true")
+    ps.set_defaults(fn=cmd_search)
+
+    pc = sub.add_parser(
+        "calibrate", help="self-calibrate on the local TPU (miss-driven)"
+    )
+    pc.add_argument("--model", required=True)
+    pc.add_argument("--strategy", required=True)
+    pc.add_argument("--system", required=True)
+    pc.add_argument("--save", help="write calibrated system config JSON")
+    pc.add_argument("--max-keys", type=int, default=64)
+    pc.set_defaults(fn=cmd_calibrate)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

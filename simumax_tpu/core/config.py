@@ -903,12 +903,19 @@ _CONFIG_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__fi
 
 
 def _registry(kind: str) -> Dict[str, str]:
-    root = os.path.join(_CONFIG_ROOT, kind)
     out = {}
-    if os.path.isdir(root):
-        for fn in sorted(os.listdir(root)):
-            if fn.endswith(".json"):
-                out[fn[:-5]] = os.path.join(root, fn)
+    # explicit env override wins over the package tree and cwd fallback
+    roots = []
+    env_root = os.environ.get("SIMUMAX_TPU_CONFIG_ROOT")
+    if env_root:
+        roots.append(os.path.join(env_root, kind))
+    roots.append(os.path.join(_CONFIG_ROOT, kind))
+    roots.append(os.path.join(os.getcwd(), "configs", kind))
+    for root in roots:
+        if os.path.isdir(root):
+            for fn in sorted(os.listdir(root)):
+                if fn.endswith(".json"):
+                    out.setdefault(fn[:-5], os.path.join(root, fn))
     return out
 
 

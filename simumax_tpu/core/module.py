@@ -49,6 +49,7 @@ class BuildContext:
         self.system = system
         self.paths = paths or {}
         self.debug = PathDebugContext()
+        self.graph = None  # Optional[GraphBuilder], set by PerfLLM
 
     def path(self, dim: str):
         if dim not in self.paths:
@@ -147,6 +148,8 @@ class MetaModule:
             self.outputs = outs if isinstance(outs, tuple) else (outs,)
             self._aggregate()
         self._called = True
+        if self.is_leaf and self.ctx.graph is not None:
+            self.ctx.graph.add(self)
         for h in self._post_hooks:
             h(self, ins, outs)
         self.ctx.debug.record(self.path_name(), self.cost_info, self.compute_info)

@@ -216,10 +216,15 @@ class PerfLLM(PerfBase):
             chunk.run()
             chunk.compute_activations()
 
-    def run_estimate(self):
+    def run_estimate(self, capture_graph: bool = False):
         assert self.strategy is not None, "call configure() first"
         self.system.reset_status()
         self.build()
+        env_graph = os.environ.get("ENABLE_SIMU_GRAPH", "").lower()
+        if capture_graph or env_graph in ("1", "true", "yes", "on"):
+            from simumax_tpu.core.graph import GraphBuilder
+
+            self.ctx.graph = GraphBuilder()
         self._run()
         self._mem_result = None
         self._cost_result = None
@@ -659,6 +664,24 @@ class PerfLLM(PerfBase):
             for key in ("base_info", "mem_result", "compute_result", "net_info"):
                 with open(os.path.join(save_path, f"{key}.json"), "w") as f:
                     json.dump(result[key], f, indent=2, default=str)
+            with open(os.path.join(save_path, "op_table.json"), "w") as f:
+                json.dump(
+                    {
+                        f"stage{s}": [
+                            row
+                            for c in self.stage_chunks(s)
+                            for row in c.op_table()
+                        ]
+                        for s in range(self.strategy.pp_size)
+                    },
+                    f,
+                    indent=1,
+                )
+            if self.ctx.graph is not None:
+                self.ctx.graph.save_json(
+                    os.path.join(save_path, "graph.json")
+                )
+                self.ctx.graph.save_dot(os.path.join(save_path, "graph.dot"))
         return result
 
     def _print_summary(self, result: dict):

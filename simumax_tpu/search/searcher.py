@@ -39,15 +39,25 @@ _KEY_FIELDS = (
     "optimizer_style", "enable_recompute", "recompute_granularity",
     "recompute_layer_num", "attn_recompute", "attn_norm_recompute",
     "mla_rms_recompute", "mlp_recompute", "mlp_rms_recompute",
-    "sdp_recompute", "moe_capacity_factor",
+    "sdp_recompute", "moe_capacity_factor", "mem_factor",
+    "enable_straggler_model", "num_layers_in_first_pipeline_stage",
+    "num_layers_in_last_pipeline_stage",
+    "account_for_embedding_in_pipeline_split",
+    "account_for_loss_in_pipeline_split", "use_math_sdp", "quant_dtype",
+    "moe_dispatcher_policy", "attention_sparse_ratio", "enable_dropout",
 )
 
 
 def _strategy_key(st: StrategyConfig, model, system, gib_margin) -> tuple:
     # model/system identity + margin are part of the verdict, not just
-    # the strategy fields
+    # the strategy fields; use stable content-ish keys, not id() (which
+    # CPython reuses after GC)
+    model_key = (model.model_name, model.layer_num, model.hidden_size,
+                 model.vocab_size, model.expert_num, model.attention_type)
+    system_key = (system.sys_name, system.accelerator.mem_gbs,
+                  tuple(system.ici.axes), system.num_slices)
     return (
-        id(model), id(system), gib_margin,
+        model_key, system_key, gib_margin,
         tuple(getattr(st, f) for f in _KEY_FIELDS),
     )
 
@@ -260,6 +270,12 @@ def search_best_parallel_strategy(
                     )
                 )
             elif rc == "selective":
+                # pick the batch split under selective-recompute memory,
+                # not whatever recompute the base strategy carried
+                st_rc.enable_recompute = True
+                st_rc.recompute_granularity = "selective"
+                st_rc.recompute_layer_num = -1
+                st_rc.sdp_recompute = True
                 base_batch = search_micro_batch_config(
                     st_rc, model, system, global_batch_size, cache=cache
                 )

@@ -1,0 +1,72 @@
+"""Golden regression tests: pin analytical results against committed
+fixtures (the reference's ``SIMU_CHECK`` golden-diff workflow, SURVEY
+§4.2, with the fixtures the reference never shipped).
+
+If a change intentionally improves the cost/memory model, regenerate
+``tests/golden_results.json`` and explain the delta in the commit.
+"""
+
+import json
+import os
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config
+from simumax_tpu.testing import ResultCheck
+
+GOLDEN = json.load(
+    open(os.path.join(os.path.dirname(__file__), "golden_results.json"))
+)
+
+CASES = {
+    "llama3-8b__tp1_pp2_dp4_mbs1__tpu_v5e_256": (
+        "tp1_pp2_dp4_mbs1", "llama3-8b", "tpu_v5e_256", None),
+    "llama3-8b__tp2_pp1_dp4_mbs1_selective_recompute__tpu_v5e_256": (
+        "tp2_pp1_dp4_mbs1_selective_recompute", "llama3-8b", "tpu_v5e_256", None),
+    "deepseekv2__ep4_pp2_dp4_mbs1__tpu_v5p_256": (
+        "ep4_pp2_dp4_mbs1", "deepseekv2", "tpu_v5p_256",
+        dict(layer_num=4, dense_layers=1)),
+    "llama3-8b__tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt__tpu_v5e_256": (
+        "tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt", "llama3-8b", "tpu_v5e_256", None),
+}
+
+
+@pytest.mark.parametrize("case", sorted(GOLDEN))
+def test_golden(case):
+    strat, model, system, tweak = CASES[case]
+    m = get_model_config(model)
+    if tweak:
+        for k, v in tweak.items():
+            setattr(m, k, v)
+    p = PerfLLM().configure(strat, m, system)
+    p.run_estimate()
+    c, mm = p.analysis_cost(), p.analysis_mem()
+    got = {
+        "mfu": c["mfu"],
+        "iter_time_ms": c["iter_time_ms"],
+        "bubble_time_ms": c["bubble_time"] * 1e3,
+        "optim_time_ms": c["optim_time"] * 1e3,
+        "tgs": c["tgs"],
+        "max_peak_gib": mm["max_peak_gib"],
+        "stage_peaks_gib": [s["peak_gib"] for s in mm["stages"]],
+        "stage_model_gib": [s["model_bytes"] / 2**30 for s in mm["stages"]],
+    }
+    rc = ResultCheck(rtol=1e-6)
+    rc.check(got, GOLDEN[case])
+    assert not rc.mismatches, "golden drift:\n" + rc.report()
+
+
+class TestComparators:
+    def test_rel_diff(self):
+        from simumax_tpu.testing import RelDiffComparator
+
+        c = RelDiffComparator(rtol=0.01)
+        assert c.check(100.4, 100.0)
+        assert not c.check(102.0, 100.0)
+
+    def test_result_check_collects_paths(self):
+        rc = ResultCheck(rtol=0.01)
+        rc.check({"a": 1.0, "b": {"c": [1, 2]}}, {"a": 2.0, "b": {"c": [1, 3]}})
+        assert any("$.a" in m for m in rc.mismatches)
+        assert any("$.b.c[1]" in m for m in rc.mismatches)

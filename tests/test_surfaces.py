@@ -1,0 +1,128 @@
+"""Tests for the user surfaces + aux subsystems: CLI, graph capture,
+DualPP helper, debug probes, artifact exports."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestGraphCapture:
+    def test_graph_nodes_edges_and_dot(self, tmp_path):
+        p = PerfLLM().configure(
+            "tp1_pp1_dp8_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        p.run_estimate(capture_graph=True)
+        g = p.ctx.graph
+        assert len(g.nodes) == len(
+            [l for c in p.chunks.values() for l in c.called_leaves()]
+        )
+        edges = g.edges()
+        assert edges, "graph should have tensor-flow edges"
+        dot = g.to_dot()
+        assert dot.startswith("digraph") and "->" in dot
+        path = g.save_json(str(tmp_path / "g.json"))
+        data = json.load(open(path))
+        assert data["schema"] == "simumax_tpu_graph_v1"
+
+    def test_recompute_marked_in_graph(self):
+        p = PerfLLM().configure(
+            "tp2_pp1_dp4_mbs1_full_recompute", "llama2-tiny", "tpu_v5e_256"
+        )
+        p.run_estimate(capture_graph=True)
+        assert any(n.recompute for n in p.ctx.graph.nodes)
+
+    def test_analysis_exports_graph_and_op_table(self, tmp_path):
+        p = PerfLLM().configure(
+            "tp1_pp2_dp4_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        p.run_estimate(capture_graph=True)
+        p.analysis(save_path=str(tmp_path), verbose=False)
+        for fn in ("graph.json", "graph.dot", "op_table.json",
+                   "mem_result.json", "compute_result.json"):
+            assert os.path.exists(tmp_path / fn), fn
+        table = json.load(open(tmp_path / "op_table.json"))
+        assert set(table) == {"stage0", "stage1"}
+        assert all("fwd_ms" in row for row in table["stage0"])
+
+
+class TestDualPP:
+    def test_dualpp_beats_1f1b_bubble(self):
+        from simumax_tpu.parallel.dualpp import perf_dualpp
+
+        p = PerfLLM().configure("tp1_pp2_dp4_mbs1", "llama3-8b", "tpu_v5e_256")
+        p.run_estimate()
+        res = perf_dualpp(p)
+        assert res["dualpp_bubble"] < res["baseline_bubble"]
+        assert res["speedup"] > 0
+
+    def test_requires_even_pp(self):
+        from simumax_tpu.parallel.dualpp import perf_dualpp
+
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 1
+        st.__post_init__()
+        p = PerfLLM().configure(st, "llama3-8b", "tpu_v5e_256")
+        p.run_estimate()
+        with pytest.raises(AssertionError, match="even"):
+            perf_dualpp(p)
+
+
+class TestCLI:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "simumax_tpu", *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+
+    def test_list(self):
+        r = self._run("list")
+        assert r.returncode == 0 and "llama3-8b" in r.stdout
+
+    def test_perf(self, tmp_path):
+        r = self._run(
+            "perf", "--model", "llama2-tiny",
+            "--strategy", "tp1_pp2_dp4_mbs1", "--system", "tpu_v5e_256",
+            "--save", str(tmp_path), "--simulate", str(tmp_path / "sim"),
+        )
+        assert r.returncode == 0, r.stderr
+        assert "MFU" in r.stdout and "simulated" in r.stdout
+        assert (tmp_path / "sim" / "trace.json").exists()
+
+    def test_search(self):
+        r = self._run(
+            "search", "--model", "llama2-tiny", "--system", "tpu_v5e_256",
+            "--world", "8", "--gbs", "8", "--tp", "1,2", "--pp", "1",
+            "--topk", "2",
+        )
+        assert r.returncode == 0, r.stderr
+        assert "MFU" in r.stdout
+
+    def test_bad_args(self):
+        r = self._run("perf", "--model", "nope",
+                      "--strategy", "tp1_pp2_dp4_mbs1",
+                      "--system", "tpu_v5e_256")
+        assert r.returncode != 0
+
+
+class TestMultiSlice:
+    def test_dp_spills_to_dcn_across_slices(self):
+        from simumax_tpu.core.config import get_system_config
+
+        sysc = get_system_config("tpu_v5e_256")
+        sysc.num_slices = 4
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st.tp_size = 4
+        st.world_size = 1024  # 4 slices of 256
+        p = PerfLLM().configure(st, "llama3-8b", sysc)
+        p.run_estimate()
+        dp_path = p.ctx.paths["dp_cp"]
+        assert dp_path.on_dcn
+        assert p.analysis_cost()["mfu"] > 0
