@@ -156,6 +156,10 @@ def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
     res = x
     y = _rms_norm(x, p["pre_mlp_norm"])
     up = y @ p["up"]
+    # NB: plain jnp here (not the pallas kernel): under sharded jit the
+    # [.., 2f] tensor is tp-column-sharded and pallas_call has no GSPMD
+    # partitioning rule; the kernel is used where shapes are shard-local
+    # (jaxref.parallel's shard_map body).
     gate, val = jnp.split(up, 2, axis=-1)
     y = (jax.nn.silu(gate) * val) @ p["down"]
     x = res + y
