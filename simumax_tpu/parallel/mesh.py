@@ -1,0 +1,65 @@
+"""Rank <-> parallel-group mapping.
+
+Reference: ``get_rank_group`` (``simumax/core/utils.py:215-249``) —
+rank grouping for order tp-cp-dp-pp and etp-ep-edp-pp. Used by tooling
+that needs the concrete group membership of every rank (e.g. building
+``jax.sharding`` device assignments for a real job that matches the
+simulated strategy, or labelling multi-host traces).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from simumax_tpu.core.config import StrategyConfig
+
+#: innermost-first dim orders (rank = sum_i idx_i * stride_i)
+DENSE_ORDER = ("tp", "cp", "dp", "pp")
+MOE_ORDER = ("etp", "ep", "edp", "pp")
+
+
+def _sizes(st: StrategyConfig, order) -> List[int]:
+    return [
+        {
+            "tp": st.tp_size, "cp": st.cp_size, "dp": st.dp_size,
+            "pp": st.pp_size, "etp": st.etp_size, "ep": st.ep_size,
+            "edp": st.edp_size,
+        }[d]
+        for d in order
+    ]
+
+
+def rank_coords(rank: int, st: StrategyConfig, order=DENSE_ORDER) -> Dict[str, int]:
+    """Decompose a global rank into per-dim indices (innermost-first)."""
+    coords = {}
+    rem = rank
+    for dim, size in zip(order, _sizes(st, order)):
+        coords[dim] = rem % size
+        rem //= size
+    return coords
+
+
+def rank_groups(st: StrategyConfig, dim: str, order=None) -> List[List[int]]:
+    """All groups of ranks that communicate over ``dim``: ranks whose
+    coords differ only in ``dim``."""
+    if order is None:
+        order = MOE_ORDER if dim in ("etp", "ep", "edp") else DENSE_ORDER
+    assert dim in order, (dim, order)
+    sizes = _sizes(st, order)
+    world = 1
+    for s in sizes:
+        world *= s
+    assert world == st.world_size, (world, st.world_size, order)
+    groups: Dict[tuple, List[int]] = {}
+    for rank in range(st.world_size):
+        coords = rank_coords(rank, st, order)
+        key = tuple(v for d, v in coords.items() if d != dim)
+        groups.setdefault(key, []).append(rank)
+    return list(groups.values())
+
+
+def group_of(rank: int, st: StrategyConfig, dim: str) -> List[int]:
+    for g in rank_groups(st, dim):
+        if rank in g:
+            return g
+    raise ValueError(rank)

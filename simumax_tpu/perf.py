@@ -216,7 +216,8 @@ class PerfLLM(PerfBase):
             chunk.run()
             chunk.compute_activations()
 
-    def run_estimate(self, capture_graph: bool = False):
+    def run_estimate(self, capture_graph: bool = False,
+                     debug: bool = False):
         assert self.strategy is not None, "call configure() first"
         self.system.reset_status()
         self.build()
@@ -225,6 +226,10 @@ class PerfLLM(PerfBase):
             from simumax_tpu.core.graph import GraphBuilder
 
             self.ctx.graph = GraphBuilder()
+        # per-path cost probes (reference debug_points -> cost_log.json)
+        env_debug = os.environ.get("SIMU_DEBUG", "").lower()
+        if debug or env_debug in ("1", "true", "yes", "on"):
+            self.ctx.debug.enabled = True
         self._run()
         self._mem_result = None
         self._cost_result = None
@@ -682,6 +687,9 @@ class PerfLLM(PerfBase):
                     os.path.join(save_path, "graph.json")
                 )
                 self.ctx.graph.save_dot(os.path.join(save_path, "graph.dot"))
+            if self.ctx.debug.enabled and self.ctx.debug.rows:
+                with open(os.path.join(save_path, "cost_log.json"), "w") as f:
+                    json.dump(self.ctx.debug.rows, f, indent=1)
         return result
 
     def _print_summary(self, result: dict):

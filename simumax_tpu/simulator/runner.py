@@ -65,11 +65,23 @@ def run_simulation(
             trace_path, engine.events, trackers if track_memory else None
         )
         result["trace_path"] = trace_path
-        with open(os.path.join(save_path, "simu_result.json"), "w") as f:
-            json.dump(result, f, indent=2)
         if track_memory:
+            snaps = [t.snapshot() for t in trackers]
             with open(
                 os.path.join(save_path, "simu_memory_snapshot.json"), "w"
             ) as f:
-                json.dump([t.snapshot() for t in trackers], f)
+                json.dump(snaps, f)
+            try:
+                from simumax_tpu.simulator.plot import plot_memory_timeline
+
+                result["memory_plot"] = plot_memory_timeline(
+                    snaps,
+                    os.path.join(save_path, "memory_timeline.png"),
+                    hbm_gib=perf.system.accelerator.mem_gbs,
+                )
+            except ImportError:
+                pass
+    if save_path:
+        with open(os.path.join(save_path, "simu_result.json"), "w") as f:
+            json.dump(result, f, indent=2)
     return result

@@ -126,3 +126,53 @@ class TestMultiSlice:
         dp_path = p.ctx.paths["dp_cp"]
         assert dp_path.on_dcn
         assert p.analysis_cost()["mfu"] > 0
+
+
+class TestRankGroups:
+    def test_dense_order_groups(self):
+        from simumax_tpu.core.config import get_strategy_config
+        from simumax_tpu.parallel.mesh import group_of, rank_groups
+
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")  # world 8
+        tp_groups = rank_groups(st, "tp")
+        assert tp_groups == [[0, 1], [2, 3], [4, 5], [6, 7]]
+        dp_groups = rank_groups(st, "dp")
+        assert dp_groups == [[0, 2, 4, 6], [1, 3, 5, 7]]
+        assert group_of(3, st, "tp") == [2, 3]
+
+    def test_moe_order_groups(self):
+        from simumax_tpu.core.config import get_strategy_config
+        from simumax_tpu.parallel.mesh import rank_groups
+
+        st = get_strategy_config("ep4_pp2_dp4_mbs1")
+        ep_groups = rank_groups(st, "ep")
+        assert ep_groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        edp_groups = rank_groups(st, "edp")
+        assert all(len(g) == st.edp_size for g in edp_groups)
+
+    def test_every_rank_in_exactly_one_group_per_dim(self):
+        from simumax_tpu.core.config import get_strategy_config
+        from simumax_tpu.parallel.mesh import rank_groups
+
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.world_size = 16
+        st.pp_size = 2
+        for dim in ("tp", "cp", "dp", "pp"):
+            groups = rank_groups(st, dim)
+            flat = sorted(r for g in groups for r in g)
+            assert flat == list(range(16))
+
+
+class TestDebugAndPlot:
+    def test_cost_log_and_memory_plot(self, tmp_path):
+        pytest.importorskip("matplotlib")
+        p = PerfLLM().configure(
+            "tp1_pp2_dp4_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        p.run_estimate(debug=True)
+        p.analysis(save_path=str(tmp_path), verbose=False)
+        rows = json.load(open(tmp_path / "cost_log.json"))
+        assert rows and {"path", "fwd_ms", "bwd_ms"} <= set(rows[0])
+        r = p.simulate(str(tmp_path / "sim"))
+        assert os.path.exists(r["memory_plot"])
+        assert os.path.getsize(r["memory_plot"]) > 10000
