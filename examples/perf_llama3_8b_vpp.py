@@ -1,0 +1,36 @@
+"""Interleaved (VPP) pipeline on Llama-3-8B: compare pp4 against
+pp4/vp2 — the interleaved schedule trades smaller bubbles for more p2p
+traffic and different per-stage memory."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_strategy_config
+
+
+def run(vp):
+    st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+    st.interleaving_size = vp
+    st.__post_init__()
+    perf = PerfLLM().configure(st, "llama3-8b", "tpu_v5e_256")
+    perf.run_estimate()
+    c, m = perf.analysis_cost(), perf.analysis_mem()
+    sim = perf.simulate(None)
+    print(
+        f"pp4 vp{vp}: iter {c['iter_time_ms']:7.1f} ms  "
+        f"bubble {c['bubble_time']*1e3:6.1f} ms  "
+        f"sim {sim['end_time_ms']:7.1f} ms  "
+        f"stage0 peak {m['stages'][0]['peak_gib']:.2f} GiB"
+    )
+
+
+def main():
+    for vp in (1, 2, 4):
+        run(vp)
+
+
+if __name__ == "__main__":
+    main()

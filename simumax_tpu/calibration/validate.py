@@ -1,0 +1,69 @@
+"""Validation harness: predicted vs XLA-compiled memory and measured
+step time (SURVEY hard-part #1: anchoring the memory model against
+``compiled.memory_analysis()``).
+
+On a real TPU backend, ``xla_memory_report`` returns the buffer
+assignment XLA actually uses for the jaxref train step (argument /
+output / temp / peak bytes); ``validate_memory`` compares the
+analytical prediction against it. On CPU backends the XLA numbers are
+not representative (host buffer accounting) — the harness still runs
+for plumbing tests but real anchoring needs a TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_memory_report(
+    model_config, batch_size: int = 1, seq_len: int = 2048,
+    layer_num: Optional[int] = None,
+) -> Dict[str, float]:
+    """Compile the jaxref train step for this model and return XLA's
+    memory analysis (bytes)."""
+    from simumax_tpu.jaxref.model import (
+        LlamaConfig,
+        init_params,
+        make_train_step,
+    )
+
+    cfg = LlamaConfig.from_model_config(model_config, layer_num=layer_num)
+    params = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    init_opt, step = make_train_step(cfg, shard=False)
+    opt = jax.eval_shape(init_opt, params)
+    ids = jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32)
+    lowered = jax.jit(step, donate_argnums=(0, 1)).lower(
+        params, opt, (ids, ids)
+    )
+    ma = lowered.compile().memory_analysis()
+    fields = (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "peak_memory_in_bytes",
+    )
+    return {f: float(getattr(ma, f, 0.0)) for f in fields}
+
+
+def validate_memory(perf, layer_num: Optional[int] = None) -> Dict[str, float]:
+    """Compare ``perf``'s predicted peak (single-chip strategy) against
+    the XLA buffer assignment for the equivalent jaxref step."""
+    st = perf.strategy
+    assert st.world_size == 1, "memory validation compares one chip"
+    xla = xla_memory_report(
+        perf.model_config, st.micro_batch_size, st.seq_len, layer_num
+    )
+    mem = perf.analysis_mem()
+    predicted = mem["max_peak_bytes"]
+    # XLA peak under donation ~= live args + temps
+    xla_peak = xla["peak_memory_in_bytes"]
+    return {
+        **xla,
+        "predicted_peak_bytes": predicted,
+        "ratio": predicted / xla_peak if xla_peak else float("nan"),
+    }

@@ -146,6 +146,7 @@ class MetaModule:
         else:
             outs = self.forward(*ins)
             self.outputs = outs if isinstance(outs, tuple) else (outs,)
+            self._post_forward()
             self._aggregate()
         self._called = True
         if self.is_leaf and self.ctx.graph is not None:
@@ -160,6 +161,40 @@ class MetaModule:
         for c in self.children():
             x = c(x)
         return x
+
+    def _post_forward(self):
+        """Composite hook running after forward() but before child-info
+        aggregation — the place to re-apportion overlap between
+        children (e.g. bound async-CP a2a hiding by the attention
+        compute)."""
+
+    def expose_unhidden(self, leaves, phase: str, budget: float):
+        """Move the portion of the given leaves' hidden collective time
+        that exceeds ``budget`` back onto the critical path,
+        proportionally per call. Keeps the leaf CostInfo and the
+        CollectiveCall exposed_time consistent (the simulator replays
+        the same numbers)."""
+        calls = [
+            c
+            for l in leaves
+            for c in l.collective_calls
+            if c.phase == phase and c.time > c.exposed_time
+        ]
+        hidden = sum(c.time - c.exposed_time for c in calls)
+        extra = max(0.0, hidden - budget)
+        if extra <= 0 or hidden <= 0:
+            return
+        for l in leaves:
+            for c in l.collective_calls:
+                if c.phase != phase or c.time <= c.exposed_time:
+                    continue
+                share = extra * (c.time - c.exposed_time) / hidden
+                c.exposed_time += share
+                l.cost_info.net_exposed.add(phase, share)
+                l.cost_info.net_hidden.add(phase, -share)
+                # a recomputed leaf replays its fwd (incl. exposed comm)
+                if phase == "fwd" and l.in_recompute:
+                    l.cost_info.recompute_time += share
 
     def _aggregate(self):
         kids = [c for c in self.children() if c._called]
@@ -259,10 +294,9 @@ class MetaModule:
         for call in self.collective_calls:
             path = self.ctx.path(call.dim)
             call.time = sysc.compute_net_op_time(call.op, call.size_bytes, path)
-            if call.exposed:
-                cost.net_exposed.add(call.phase, call.time)
-            else:
-                cost.net_hidden.add(call.phase, call.time)
+            call.exposed_time = call.time if call.exposed else 0.0
+            cost.net_exposed.add(call.phase, call.exposed_time)
+            cost.net_hidden.add(call.phase, call.time - call.exposed_time)
         # recompute: the fwd work is replayed before bwd_act
         if self.in_recompute:
             cost.recompute_time = cost.compute.fwd + cost.net_exposed.fwd

@@ -124,6 +124,11 @@ class CollectiveCall:
     point: str = "pre"  # pre | post
     exposed: bool = True
     time: float = 0.0  # filled by the framework
+    #: serialized portion of ``time`` on the critical path; defaults to
+    #: ``time`` when exposed, 0 when overlapped — composites may move
+    #: part of a "hidden" call back onto the critical path when the
+    #: overlap budget (adjacent compute) is smaller than the comm
+    exposed_time: float = 0.0
 
 
 @_addable

@@ -585,6 +585,22 @@ class ParallelCE(LeafModule):
 # --------------------------------------------------------------------------
 
 
+def bound_async_cp_overlap(attention: MetaModule):
+    """Async CP can hide its a2a only under the attention-core compute;
+    the excess goes back onto the critical path (shared by Attention and
+    MLAAttention _post_forward hooks)."""
+    st = _st(attention.ctx)
+    if not (st.cp_size > 1 and st.cp_comm_type == "a2a"
+            and st.cp_a2a_mode == "async_cp"):
+        return
+    cp_leaves = [
+        c for c in attention.children() if isinstance(c, ContextParallelA2A)
+    ]
+    for phase in ("fwd", "bwd_act"):
+        budget = attention.core.cost_info.compute.get(phase)
+        attention.expose_unhidden(cp_leaves, phase, budget)
+
+
 class Attention(MetaModule):
     """GQA/MHA attention (reference ``dense_module.py:2454-2568``):
     LinearCol(qkv) -> split -> RoPE -> [CP re-shard] -> CoreAttention ->
@@ -613,6 +629,9 @@ class Attention(MetaModule):
         self.out_proj = LinearRow(
             ctx, self.q_out, m.hidden_size, "out_proj", quantized=quantized
         )
+
+    def _post_forward(self):
+        bound_async_cp_overlap(self)
 
     def forward(self, x: TensorSpec) -> TensorSpec:
         st = _st(self.ctx)

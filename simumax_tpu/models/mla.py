@@ -77,6 +77,11 @@ class MLAAttention(MetaModule):
             [self.q_norm] if m.q_lora_rank else []
         )
 
+    def _post_forward(self):
+        from simumax_tpu.models.dense import bound_async_cp_overlap
+
+        bound_async_cp_overlap(self)
+
     def forward(self, x: TensorSpec) -> TensorSpec:
         st, m = _st(self.ctx), self.ctx.model
         tp = st.tp_size

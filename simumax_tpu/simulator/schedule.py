@@ -22,15 +22,16 @@ from simumax_tpu.simulator.memory import SimuMemoryTracker
 
 
 def _leaf_events(leaf, phase: str):
-    """(pre_comm, compute, post_comm) exposed seconds for one leaf/phase."""
+    """(pre_comm, compute, post_comm) exposed seconds for one leaf/phase
+    (partial exposure of overlapped collectives included)."""
     pre = post = 0.0
     for c in leaf.collective_calls:
-        if c.phase != phase or not c.exposed:
+        if c.phase != phase or c.exposed_time <= 0:
             continue
         if c.point == "pre":
-            pre += c.time
+            pre += c.exposed_time
         else:
-            post += c.time
+            post += c.exposed_time
     return pre, leaf.cost_info.compute.get(phase), post
 
 
