@@ -199,3 +199,37 @@ class TestMemoryModel:
         expect = n * (2 + 4 + 12 / 8)
         got = p.analysis_mem()["stages"][0]["model_bytes"]
         assert got == pytest.approx(expect, rel=1e-6)
+
+
+class TestUnevenPP:
+    def test_first_last_layer_overrides(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 4
+        st.num_layers_in_first_pipeline_stage = 5
+        st.num_layers_in_last_pipeline_stage = 5
+        st.__post_init__()
+        p = run(st)
+        assert p.stage_layer_counts() == [[5], [11], [11], [5]]
+        c = p.analysis_cost()
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
+
+    def test_embedding_loss_split(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.account_for_embedding_in_pipeline_split = True
+        st.account_for_loss_in_pipeline_split = True
+        st.__post_init__()
+        p = run(st)
+        assert p.stage_layer_counts() == [[16], [16]]
+        # first/last stages got one fewer transformer layer each
+        fwd0 = p.stage_chunks(0)[0].cost_info.fwd_time
+        fwd1 = p.stage_chunks(1)[0].cost_info.fwd_time
+        assert fwd0 > 0 and fwd1 > 0
+
+    def test_uneven_split_must_divide(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 4
+        st.num_layers_in_first_pipeline_stage = 3  # 29 % 2 != 0... 32-3=29 over 3 stages
+        st.__post_init__()
+        with pytest.raises(AssertionError, match="split evenly"):
+            run(st)
