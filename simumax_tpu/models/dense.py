@@ -466,6 +466,25 @@ class ContextParallelA2A(LeafModule):
 # --------------------------------------------------------------------------
 
 
+class Dropout(LeafModule):
+    """Hidden dropout: memory-bound elementwise with a cached 1-byte
+    mask per element for the backward. (The reference warns and ignores
+    ``enable_dropout`` — config.py:678-681; modeled fully here:
+    embedding-output + both residual-branch sites, the standard
+    Megatron recipe.)"""
+
+    def forward_spec(self, x: TensorSpec) -> TensorSpec:
+        return x
+
+    def op_accessed(self) -> Dict[str, float]:
+        nb = self.inputs[0].bytes
+        mask = self.inputs[0].numel()
+        return {"fwd": 2 * nb + mask, "bwd_act": 2 * nb + mask}
+
+    def activation_info(self) -> ActivationInfo:
+        return ActivationInfo(cache_bytes=self.inputs[0].numel())  # mask
+
+
 class SeqAllGather(LeafModule):
     """Gather a seq-sharded tensor over a parallel dim (fwd all-gather,
     bwd-act reduce-scatter) — used for e.g. the MLA RoPE branch whose

@@ -16,6 +16,7 @@ from simumax_tpu.core.tensor import TensorSpec
 from simumax_tpu.models.dense import (
     AddFunction,
     Attention,
+    Dropout,
     Embedding,
     LayerNorm,
     LinearCol,
@@ -54,6 +55,8 @@ class LLMBlock(MetaModule):
             self.attention = MLAAttention(ctx, quantized=quantized)
         else:
             self.attention = Attention(ctx, quantized=quantized)
+        if ctx.strategy.enable_dropout:
+            self.attn_dropout = Dropout(ctx, name="attn_dropout")
         self.add_attn = AddFunction(ctx, name="residual_attn")
         self.pre_mlp_norm = LayerNorm(ctx, name="pre_mlp_norm")
         self.is_moe_layer = (
@@ -65,6 +68,8 @@ class LLMBlock(MetaModule):
             self.mlp = ExpertMLP(ctx, quantized=quantized)
         else:
             self.mlp = MLP(ctx, quantized=quantized)
+        if ctx.strategy.enable_dropout:
+            self.mlp_dropout = Dropout(ctx, name="mlp_dropout")
         self.add_mlp = AddFunction(ctx, name="residual_mlp")
         self._wire_recompute(idx_in_stage)
 
@@ -95,9 +100,13 @@ class LLMBlock(MetaModule):
     def forward(self, x: TensorSpec) -> TensorSpec:
         h = self.input_norm(x)
         h = self.attention(h)
+        if self.ctx.strategy.enable_dropout:
+            h = self.attn_dropout(h)
         x = self.add_attn(x, h)
         h = self.pre_mlp_norm(x)
         h = self.mlp(h)
+        if self.ctx.strategy.enable_dropout:
+            h = self.mlp_dropout(h)
         return self.add_mlp(x, h)
 
 
@@ -127,6 +136,8 @@ class LLMModel(MetaModule):
         m = ctx.model
         if preprocess:
             self.embedding = Embedding(ctx)
+            if ctx.strategy.enable_dropout:
+                self.embedding_dropout = Dropout(ctx, name="embedding_dropout")
         self.blocks: List[LLMBlock] = []
         for i in range(layer_num):
             blk = LLMBlock(ctx, layer_offset + i, i)
@@ -153,6 +164,8 @@ class LLMModel(MetaModule):
     def forward(self, x: TensorSpec) -> TensorSpec:
         if self.preprocess:
             x = self.embedding(x)
+            if self.ctx.strategy.enable_dropout:
+                x = self.embedding_dropout(x)
         for blk in self.blocks:
             x = blk(x)
         if self.postprocess:

@@ -233,3 +233,18 @@ class TestUnevenPP:
         st.__post_init__()
         with pytest.raises(AssertionError, match="split evenly"):
             run(st)
+
+
+class TestDropout:
+    def test_dropout_adds_mask_caches(self):
+        base = run("tp1_pp1_dp8_mbs1")
+        drop = run("tp1_pp1_dp8_mbs1", enable_dropout=True)
+        b = base.chunks[(0, 0)].blocks[0].act_info.cache_bytes
+        d = drop.chunks[(0, 0)].blocks[0].act_info.cache_bytes
+        st, m = base.strategy, base.model_config
+        expect = 2 * st.micro_batch_size * st.seq_len * m.hidden_size
+        assert d - b == pytest.approx(expect)
+        assert (
+            drop.analysis_cost()["iter_time"]
+            > base.analysis_cost()["iter_time"]
+        )
