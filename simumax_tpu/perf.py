@@ -260,10 +260,16 @@ class PerfLLM(PerfBase):
                 )
                 live = min(mbc, pp - s)
                 peak = model_mem + max(live - 1, 0) * cache_per_mb + replay_peak
+                weight = sum(c.param_info.weight_bytes + c.param_info.moe_weight_bytes for c in chunks)
+                grad = sum(c.param_info.grad_bytes + c.param_info.moe_grad_bytes for c in chunks)
+                state = sum(c.param_info.state_bytes + c.param_info.moe_state_bytes for c in chunks)
                 stages.append(
                     {
                         "stage": s,
                         "model_bytes": model_mem,
+                        "weight_bytes": weight,
+                        "grad_bytes": grad,
+                        "optimizer_state_bytes": state,
                         "act_cache_per_microbatch_bytes": cache_per_mb,
                         "live_microbatches": live,
                         "replay_peak_bytes": replay_peak,
@@ -484,13 +490,11 @@ class PerfLLM(PerfBase):
                 ch.chunk_idx: ch.act_info.cache_bytes
                 for ch in self.stage_chunks(s)
             }
+            chunks = self.stage_chunks(s)
             replay_peak = max(
-                (ch.peak_point.bytes for ch in self.stage_chunks(s)),
-                default=0.0,
+                (ch.peak_point.bytes for ch in chunks), default=0.0
             )
-            model_mem = sum(
-                ch.param_info.total_bytes for ch in self.stage_chunks(s)
-            )
+            model_mem = sum(ch.param_info.total_bytes for ch in chunks)
             live = peak_live = 0.0
             for kind, c, _ in orders[s]:
                 if kind == "F":
@@ -503,6 +507,18 @@ class PerfLLM(PerfBase):
                 {
                     "stage": s,
                     "model_bytes": model_mem,
+                    "weight_bytes": sum(
+                        ch.param_info.weight_bytes + ch.param_info.moe_weight_bytes
+                        for ch in chunks
+                    ),
+                    "grad_bytes": sum(
+                        ch.param_info.grad_bytes + ch.param_info.moe_grad_bytes
+                        for ch in chunks
+                    ),
+                    "optimizer_state_bytes": sum(
+                        ch.param_info.state_bytes + ch.param_info.moe_state_bytes
+                        for ch in chunks
+                    ),
                     "act_cache_per_microbatch_bytes": sum(cache.values()) / st.vp_size,
                     "live_microbatches": int(
                         peak_live / max(sum(cache.values()) / st.vp_size, 1)
@@ -615,9 +631,19 @@ class PerfLLM(PerfBase):
         model_flops = m.train_flops_per_token(st.seq_len) * tokens
         per_chip = model_flops / st.world_size / iter_time
         peak = self.system.accelerator.op["default"].tflops * 1e12
-        # net exposure accounting (stage 0 representative)
+        # time breakdown (stage 0 representative, per microbatch)
         chunks0 = self.stage_chunks(0)
         net_exposed = sum(c.cost_info.total_net_exposed for c in chunks0)
+        compute_mb = sum(c.cost_info.compute.total for c in chunks0)
+        recompute_mb = sum(c.cost_info.recompute_time for c in chunks0)
+        breakdown = {
+            "compute_per_microbatch": compute_mb,
+            "exposed_comm_per_microbatch": net_exposed,
+            "recompute_per_microbatch": recompute_mb,
+            "bubble": pp_res["bubble"],
+            "dp_comm": dp_res["total"],
+            "optimizer": optim,
+        }
         result = {
             "iter_time": iter_time,
             "iter_time_ms": iter_time * 1e3,
@@ -632,6 +658,7 @@ class PerfLLM(PerfBase):
             "tgs": tokens / iter_time / st.world_size,
             "stage_phase_inputs": phase_inputs,
             "net_exposed_per_microbatch": net_exposed,
+            "time_breakdown": breakdown,
         }
         self._cost_result = result
         return result

@@ -12,8 +12,9 @@ import os
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_model_config
+from simumax_tpu.core.config import get_model_config, get_strategy_config
 from simumax_tpu.testing import ResultCheck
+from tests.test_perf_dense import run
 
 GOLDEN = json.load(
     open(os.path.join(os.path.dirname(__file__), "golden_results.json"))
@@ -29,18 +30,26 @@ CASES = {
         dict(layer_num=4, dense_layers=1)),
     "llama3-8b__tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt__tpu_v5e_256": (
         "tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt", "llama3-8b", "tpu_v5e_256", None),
+    "llama3-70b-l4__cp8_seq32k_a2a__tpu_v5p_256": (
+        "tp1_pp1_dp8_mbs1", "llama3-70b", "tpu_v5p_256", dict(layer_num=4),
+        dict(world_size=16, cp_size=8, seq_len=32768, micro_batch_num=2)),
+    "llama3-8b__tp2_int8__tpu_v5e_256": (
+        "tp2_pp1_dp4_mbs1", "llama3-8b", "tpu_v5e_256", None, dict(fp8=True)),
+    "llama3-8b__tp2_dropout__tpu_v5e_256": (
+        "tp2_pp1_dp4_mbs1", "llama3-8b", "tpu_v5e_256", None,
+        dict(enable_dropout=True)),
 }
 
 
 @pytest.mark.parametrize("case", sorted(GOLDEN))
 def test_golden(case):
-    strat, model, system, tweak = CASES[case]
+    strat, model, system, tweak, *rest = CASES[case]
     m = get_model_config(model)
     if tweak:
         for k, v in tweak.items():
             setattr(m, k, v)
-    p = PerfLLM().configure(strat, m, system)
-    p.run_estimate()
+    overrides = rest[0] if rest and rest[0] else {}
+    p = run(get_strategy_config(strat), model=m, system=system, **overrides)
     c, mm = p.analysis_cost(), p.analysis_mem()
     got = {
         "mfu": c["mfu"],
