@@ -177,7 +177,8 @@ class LinearCol(GemmBase):
     """
 
     def __init__(self, ctx, in_features, out_features, name="linear_col",
-                 quantized=False, skip_comm=False, replicated=False):
+                 quantized=False, skip_comm=False, replicated=False,
+                 count_params=True):
         super().__init__(ctx, name, quantized=quantized)
         st = _st(ctx)
         self.in_features = in_features
@@ -188,6 +189,9 @@ class LinearCol(GemmBase):
         self.out_local = out_features // (1 if replicated else st.tp_size)
         self.numel = in_features * self.out_local
         self.skip_comm = skip_comm or replicated
+        # tied-weight layers (lm_head sharing the embedding) compute but
+        # do not own parameters
+        self.count_params = count_params
 
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         st = _st(self.ctx)
@@ -235,6 +239,8 @@ class LinearCol(GemmBase):
                               bwd_temp_bytes=temp)
 
     def extra_param_info(self):
+        if not self.count_params:
+            return self.make_param_info(0)
         return self.make_param_info(self.numel)
 
     def collectives(self) -> List[CollectiveCall]:

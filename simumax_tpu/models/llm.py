@@ -145,8 +145,12 @@ class LLMModel(MetaModule):
             self.blocks.append(blk)
         if postprocess:
             self.final_norm = LayerNorm(ctx, name="final_norm")
+            # a tied lm_head owns no extra params only when the
+            # embedding lives in the same chunk; at pp>1 the last stage
+            # holds a physical replica of the tied weight (Megatron)
             self.lm_head = LinearCol(
-                ctx, m.hidden_size, m.padded_vocab_size, "lm_head"
+                ctx, m.hidden_size, m.padded_vocab_size, "lm_head",
+                count_params=m.untie_embeddings or not preprocess,
             )
             self.ce = ParallelCE(ctx, name="parallel_ce")
         self.peak_point: Optional[PeakPoint] = None

@@ -556,6 +556,21 @@ class PerfLLM(PerfBase):
             detail["dense_grad_rs_time"] = rs
             detail["dense_param_ag_time"] = ag
             t += rs + ag
+        # tied-embedding grad sync between first/last stage replicas
+        # (Megatron embedding-group all-reduce), ~a ring of two over the
+        # pp path: two p2p transfers of the grad
+        if st.pp_size > 1 and not self.model_config.untie_embeddings:
+            emb_grad = (
+                self.model_config.padded_vocab_size
+                * self.model_config.hidden_size
+                / st.tp_size
+                * st.grad_element_size
+            )
+            t_tied = 2 * sysc.compute_net_op_time(
+                "p2p", emb_grad, self.ctx.path("pp")
+            )
+            detail["tied_embedding_grad_ar_time"] = t_tied
+            t += t_tied
         if st.edp_size > 1 and moe_numel:
             path = self.ctx.path("edp")
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"

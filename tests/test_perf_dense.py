@@ -248,3 +248,29 @@ class TestDropout:
             drop.analysis_cost()["iter_time"]
             > base.analysis_cost()["iter_time"]
         )
+
+
+class TestTiedEmbeddings:
+    def test_tied_lm_head_not_double_counted(self):
+        m = get_model_config("llama3-8b")
+        m.untie_embeddings = False
+        p = run("tp1_pp1_dp8_mbs1", model=m)
+        total = sum(c.param_info.dense_numel for c in p.chunks.values())
+        assert total == pytest.approx(p.model_config.param_numel(), rel=1e-9)
+        # compute still happens: lm head flops unchanged
+        head = p.chunks[(0, 0)].lm_head
+        assert head.compute_info.fwd_flops > 0
+
+    def test_tied_pp_last_stage_holds_replica(self):
+        m = get_model_config("llama3-8b")
+        m.untie_embeddings = False
+        p = run("tp1_pp2_dp4_mbs1", model=m)
+        head = p.chunks[(1, 0)].lm_head
+        assert head.param_info.dense_numel > 0  # physical replica
+        total = sum(c.param_info.dense_numel for c in p.chunks.values())
+        expect = m.param_numel() + m.padded_vocab_size * m.hidden_size
+        assert total == pytest.approx(expect, rel=1e-9)
+        assert (
+            p.analysis_cost()["dp_comm"].get("tied_embedding_grad_ar_time", 0)
+            > 0
+        )
