@@ -161,6 +161,88 @@ def measure_sdp_efficiency(
     return min(eff, 1.0)
 
 
+# -- HBM bandwidth classes ----------------------------------------------------
+
+
+def measure_bandwidth_efficiency(
+    kind: str, peak_gbps: float, nbytes: float = 256 * 2**20,
+    vocab: int = 32000,
+) -> float:
+    """Measured HBM efficiency for a bandwidth class (reference
+    ``test_ce_permute_efficiency.py``): 'default' times a streaming
+    reduction, 'permute_fwd' a pseudo-random row gather, 'permute_bwd'
+    a row scatter-add, 'ce' a log-softmax cross-entropy pass. Each
+    benchmark ends in a full reduction so the simplifier cannot elide
+    the traffic. Returns achieved/peak bandwidth (of the modeled
+    traffic — reads only where the reduction fuses away the write)."""
+    if kind == "permute_bwd":
+        rows = max(int(nbytes // (2 * 1024)), 16)
+        x = jnp.ones((rows, 1024), jnp.bfloat16)
+        stride = 104729  # prime, ~random row order
+        idx = (jnp.arange(rows) * stride) % rows
+
+        def op(carry):
+            y = jnp.zeros_like(x).at[idx].add(x + carry.astype(x.dtype))
+            return jnp.sum(y.astype(jnp.float32)) * 1e-30
+
+        traffic = 3 * rows * 1024 * 2  # read + scatter write + reduce read
+    elif kind.startswith("permute"):
+        rows = max(int(nbytes // (2 * 1024)), 16)
+        x = jnp.ones((rows, 1024), jnp.bfloat16)
+        stride = 104729
+        idx = (jnp.arange(rows) * stride) % rows
+
+        def op(carry):
+            y = jnp.take(x + carry.astype(x.dtype), idx, axis=0)
+            return jnp.sum(y.astype(jnp.float32)) * 1e-30
+
+        traffic = rows * 1024 * 2  # random-order read (reduce fuses)
+    elif kind.startswith("ce"):
+        tokens = max(int(nbytes // (vocab * 2)), 8)
+        logits = jnp.ones((tokens, vocab), jnp.bfloat16)
+        targets = jnp.zeros((tokens,), jnp.int32)
+
+        def op(carry):
+            lp = jax.nn.log_softmax(
+                (logits + carry.astype(logits.dtype)).astype(jnp.float32), -1
+            )
+            ll = jnp.take_along_axis(lp, targets[:, None], -1)
+            return -jnp.mean(ll) * 1e-30
+
+        traffic = tokens * vocab * 4  # ~two bf16 passes over the logits
+    else:
+        elems = max(int(nbytes // 2), 1024)
+        x = jnp.ones((elems,), jnp.bfloat16)
+
+        def op(carry):
+            return jnp.sum((x + carry.astype(x.dtype)).astype(jnp.float32)) * 1e-30
+
+        traffic = elems * 2  # streaming read (reduce fuses the write)
+    t = time_fn(_chain_scan(op, length=8), amortize=1) / 8
+    eff = traffic / t / (peak_gbps * 1e9)
+    return min(eff, 1.0)
+
+
+def calibrate_bandwidth_classes(system, verbose: bool = False,
+                                nbytes: float = 256 * 2**20,
+                                vocab: int = 32000):
+    """Measure the HBM bandwidth classes in the system config and write
+    the efficiencies back. ``ce_fusion`` is skipped: a fused CE kernel
+    avoids exactly the fp32 materialization the unfused benchmark
+    performs, so measuring it with this benchmark would erase the
+    fusion benefit — its prior stays."""
+    out = {}
+    for key, spec in system.accelerator.bandwidth.items():
+        if key == "ce_fusion":
+            continue
+        eff = measure_bandwidth_efficiency(key, spec.gbps, nbytes, vocab)
+        spec.efficient_factor = eff
+        out[key] = eff
+        if verbose:
+            print(f"[cal] bandwidth {key}: eff {eff:.3f}")
+    return out
+
+
 # -- miss-driven loop ---------------------------------------------------------
 
 

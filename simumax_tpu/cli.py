@@ -83,6 +83,32 @@ def cmd_calibrate(args):
 
     perf = PerfLLM().configure(args.strategy, args.model, args.system)
     perf.run_estimate()
+    if args.bandwidth:
+        from simumax_tpu.calibration.autocal import calibrate_bandwidth_classes
+
+        calibrate_bandwidth_classes(
+            perf.system, verbose=True,
+            vocab=perf.model_config.padded_vocab_size,
+        )
+    if args.collectives:
+        import jax
+
+        from simumax_tpu.calibration.collective_bench import (
+            sweep_axis,
+            update_system_from_sweep,
+        )
+        from simumax_tpu.jaxref.model import make_mesh
+
+        n = len(jax.devices())
+        if n < 2:
+            print("[cal] collectives: need >1 device, skipping")
+        else:
+            mesh = make_mesh(n, tp=n)
+            sweep = sweep_axis(mesh, "tp")
+            update_system_from_sweep(perf.system, n, sweep)
+            for op, fit in sweep.items():
+                print(f"[cal] {op}: {fit['fitted_bw_gbps']:.1f} GB/s, "
+                      f"{fit['fitted_latency_us']:.1f} us")
     measured = calibrate_system(
         perf, save_path=args.save, max_keys=args.max_keys, verbose=True
     )
@@ -136,6 +162,10 @@ def main(argv=None):
     pc.add_argument("--system", required=True)
     pc.add_argument("--save", help="write calibrated system config JSON")
     pc.add_argument("--max-keys", type=int, default=64)
+    pc.add_argument("--bandwidth", action="store_true",
+                    help="also calibrate HBM bandwidth classes")
+    pc.add_argument("--collectives", action="store_true",
+                    help="also sweep+fit collectives (needs >1 device)")
     pc.set_defaults(fn=cmd_calibrate)
 
     args = p.parse_args(argv)

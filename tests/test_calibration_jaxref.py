@@ -237,3 +237,22 @@ class TestPallasKernels:
         x = jnp.ones((2, 8, 64), jnp.float32)
         out = swiglu(x)  # cpu backend -> jnp path
         assert out.shape == (2, 8, 32)
+
+
+class TestBandwidthCalibration:
+    def test_all_classes_measurable(self):
+        from simumax_tpu.calibration.autocal import (
+            calibrate_bandwidth_classes,
+        )
+        from simumax_tpu.core.config import get_system_config
+
+        sysc = get_system_config("tpu_v5e_256")
+        out = calibrate_bandwidth_classes(sysc, nbytes=1 * 2**20, vocab=512)
+        expect = set(sysc.accelerator.bandwidth) - {"ce_fusion"}
+        assert set(out) == expect
+        for key, eff in out.items():
+            assert 0 < eff <= 1.0
+            assert sysc.accelerator.bandwidth[key].efficient_factor == eff
+        # ce_fusion keeps its prior (fused kernels avoid the benchmarked
+        # fp32 materialization)
+        assert sysc.accelerator.bandwidth["ce_fusion"].efficient_factor == 0.75
