@@ -247,6 +247,7 @@ class TestBandwidthCalibration:
         from simumax_tpu.core.config import get_system_config
 
         sysc = get_system_config("tpu_v5e_256")
+        prior = sysc.accelerator.bandwidth["ce_fusion"].efficient_factor
         out = calibrate_bandwidth_classes(sysc, nbytes=1 * 2**20, vocab=512)
         expect = set(sysc.accelerator.bandwidth) - {"ce_fusion"}
         assert set(out) == expect
@@ -254,5 +255,11 @@ class TestBandwidthCalibration:
             assert 0 < eff <= 1.0
             assert sysc.accelerator.bandwidth[key].efficient_factor == eff
         # ce_fusion keeps its prior (fused kernels avoid the benchmarked
-        # fp32 materialization)
-        assert sysc.accelerator.bandwidth["ce_fusion"].efficient_factor == 0.75
+        # fp32 materialization) and is rejected by the measurer
+        assert sysc.accelerator.bandwidth["ce_fusion"].efficient_factor == prior
+        from simumax_tpu.calibration.autocal import (
+            measure_bandwidth_efficiency,
+        )
+
+        with pytest.raises(ValueError, match="ce_fusion"):
+            measure_bandwidth_efficiency("ce_fusion", 819.0)
