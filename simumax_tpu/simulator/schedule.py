@@ -226,6 +226,10 @@ class StageProcess:
                     )
                     clock[0] = t
                     if not st.pp_comm_async:
+                        # blocking isend approximation: sender stalls for
+                        # the transfer. True rendezvous needs fused
+                        # send/recv pairs (Megatron batch_isend_irecv) —
+                        # unfused blocking sends deadlock in warmup.
                         yield ("advance", clock[0] + self.p2p_time)
             else:
                 if stage < pp - 1:
