@@ -79,6 +79,12 @@ class ActivationInfo:
     input_bytes: float = 0.0
     output_bytes: float = 0.0
 
+    @property
+    def grad_flight_bytes(self) -> float:
+        """Gradient tensors live while this module's backward runs:
+        incoming output-grad + outgoing input-grad."""
+        return self.input_bytes + self.output_bytes
+
 
 @_addable
 @dataclass

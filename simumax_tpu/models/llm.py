@@ -245,12 +245,16 @@ class LLMModel(MetaModule):
                 live -= saved
                 # consume raw caches in reverse as bwd proceeds
                 for sl in reversed(seg_leaves):
-                    bump(sl.path_name(), "bwd", live + sl.raw_act_info.bwd_temp_bytes)
+                    bump(sl.path_name(), "bwd",
+                         live + sl.raw_act_info.bwd_temp_bytes
+                         + sl.raw_act_info.grad_flight_bytes)
                     live -= sl.raw_act_info.cache_bytes
                     done.add(id(sl))
                 i -= 1
                 continue
-            bump(leaf.path_name(), "bwd", live + leaf.raw_act_info.bwd_temp_bytes)
+            bump(leaf.path_name(), "bwd",
+                 live + leaf.raw_act_info.bwd_temp_bytes
+                 + leaf.raw_act_info.grad_flight_bytes)
             live -= leaf.act_info.cache_bytes
             done.add(id(leaf))
             i -= 1

@@ -148,10 +148,14 @@ class StageProcess:
                             + sl.cost_info.phase_time("bwd_w")
                         )
                         lname = sl.path_name().split(".", 1)[-1]
+                        flight = (sl.raw_act_info.bwd_temp_bytes
+                                  + sl.raw_act_info.grad_flight_bytes)
+                        self._alloc(clock[0], flight, tag="temp")
                         if dur:
                             t = yield ("compute", dur, f"{lname}.bwd#mb{mb}",
                                        "comp")
                             clock[0] = t
+                        self._free(clock[0], flight, tag="temp")
                         if sl.raw_act_info.cache_bytes:
                             self._free(clock[0], token=f"mb{mb}:r{id(sl)}",
                                        tag="recompute")
@@ -165,14 +169,16 @@ class StageProcess:
                 if dur_comm:
                     t = yield ("compute", dur_comm, f"{name}.bwd_comm", "comm")
                     clock[0] = t
-                self._alloc(clock[0], leaf.raw_act_info.bwd_temp_bytes,
-                            tag="temp")
+                # grad-in-flight: incoming output-grad + outgoing
+                # input-grad live while the bwd op runs
+                flight = (leaf.raw_act_info.bwd_temp_bytes
+                          + leaf.raw_act_info.grad_flight_bytes)
+                self._alloc(clock[0], flight, tag="temp")
                 if comp_a + comp_w:
                     t = yield ("compute", comp_a + comp_w,
                                f"{name}.bwd#mb{mb}", "comp")
                     clock[0] = t
-                self._free(clock[0], leaf.raw_act_info.bwd_temp_bytes,
-                           tag="temp")
+                self._free(clock[0], flight, tag="temp")
                 if leaf.act_info.cache_bytes:
                     self._free(clock[0], token=f"mb{mb}:{id(leaf)}",
                                tag="act")

@@ -1,0 +1,53 @@
+"""Regenerate tests/golden_results.json from the current cost model.
+
+Run ONLY when a deliberate model change shifts the numbers; explain the
+delta in the commit message.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+from tests.test_golden import CASES
+
+
+def main():
+    golden = {}
+    for name, (strat, model, system, tweak, *rest) in CASES.items():
+        m = get_model_config(model)
+        if tweak:
+            for k, v in tweak.items():
+                setattr(m, k, v)
+        st = get_strategy_config(strat)
+        if rest and rest[0]:
+            for k, v in rest[0].items():
+                setattr(st, k, v)
+            st.__post_init__()
+        p = PerfLLM().configure(st, m, system)
+        p.run_estimate()
+        c, mm = p.analysis_cost(), p.analysis_mem()
+        golden[name] = {
+            "mfu": c["mfu"],
+            "iter_time_ms": c["iter_time_ms"],
+            "bubble_time_ms": c["bubble_time"] * 1e3,
+            "optim_time_ms": c["optim_time"] * 1e3,
+            "tgs": c["tgs"],
+            "max_peak_gib": mm["max_peak_gib"],
+            "stage_peaks_gib": [s["peak_gib"] for s in mm["stages"]],
+            "stage_model_gib": [s["model_bytes"] / 2**30 for s in mm["stages"]],
+        }
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "golden_results.json",
+    )
+    with open(path, "w") as f:
+        json.dump(golden, f, indent=2)
+    print(f"wrote {len(golden)} cases to {path}")
+
+
+if __name__ == "__main__":
+    main()
