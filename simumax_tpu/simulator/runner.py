@@ -22,22 +22,83 @@ def run_simulation(
     save_path: Optional[str] = None,
     granularity: str = "leaf",
     track_memory: bool = True,
+    world_ranks: bool = False,
+    perturbation: Optional[dict] = None,
 ) -> dict:
     """Discrete-event replay of one training iteration. ``perf`` must
-    have completed ``run_estimate()``."""
+    have completed ``run_estimate()``.
+
+    ``world_ranks=True`` simulates every global rank (instead of one
+    representative per pipeline stage): intra-stage collectives become
+    true rendezvous among each rank's tp/cp/ep groups and the optimizer
+    syncs over real dp groups — enabling per-rank straggler injection
+    via ``perturbation`` ({rank: compute-time multiplier}). The
+    reference only approximates stragglers with a closed-form inflation
+    (perf_llm.py:255-291); here the slowdown propagates through the
+    actual collective dependency graph. Memory tracking is a
+    per-representative-stage feature and is disabled in world mode
+    (result carries no 'memory' key)."""
     assert perf.chunks, "call run_estimate() before simulate()"
     st = perf.strategy
     pp = st.pp_size
-    engine = SimuEngine(pp)
-    trackers = []
-    for s in range(pp):
-        static = sum(c.param_info.total_bytes for c in perf.stage_chunks(s))
-        tracker = (
-            SimuMemoryTracker(s, static_bytes=static) if track_memory else None
-        )
-        trackers.append(tracker)
-        proc = StageProcess(perf, s, tracker=tracker, granularity=granularity)
-        engine.add_rank(s, proc.process())
+    perturbation = perturbation or {}
+    if world_ranks:
+        from simumax_tpu.parallel.mesh import rank_coords, rank_groups
+
+        n = st.world_size
+        bad = [r for r in perturbation if not 0 <= r < n]
+        assert not bad, f"perturbation for nonexistent ranks {bad} (world {n})"
+        # memory tracking is per-representative-stage; world mode is for
+        # timing/straggler analysis
+        track_memory = False
+        # group membership computed once per dim, shared by all ranks
+        memberships = {}
+        for dim in ("tp", "cp", "ep", "etp"):
+            if getattr(st, f"{dim}_size") > 1:
+                by_rank = {}
+                for g in rank_groups(st, dim):
+                    for r in g:
+                        by_rank[r] = g
+                memberships[dim] = by_rank
+        dp_groups = {}
+        if st.dp_size * st.cp_size > 1:
+            from collections import defaultdict
+
+            buckets = defaultdict(list)
+            for r in range(n):
+                c = rank_coords(r, st)
+                buckets[(c["tp"], c["pp"])].append(r)
+            for g in buckets.values():
+                for r in g:
+                    dp_groups[r] = sorted(g)
+        engine = SimuEngine(n)
+        trackers = []
+        for r in range(n):
+            stage = rank_coords(r, st)["pp"]
+            proc = StageProcess(
+                perf, stage, tracker=None, granularity=granularity,
+                rank=r, perturb=perturbation.get(r, 1.0),
+                groups={d: m[r] for d, m in memberships.items() if r in m},
+                dp_cp_group=dp_groups.get(r),
+            )
+            engine.add_rank(r, proc.process())
+    else:
+        engine = SimuEngine(pp)
+        trackers = []
+        for s in range(pp):
+            static = sum(
+                c.param_info.total_bytes for c in perf.stage_chunks(s)
+            )
+            tracker = (
+                SimuMemoryTracker(s, static_bytes=static)
+                if track_memory
+                else None
+            )
+            trackers.append(tracker)
+            proc = StageProcess(
+                perf, s, tracker=tracker, granularity=granularity
+            )
+            engine.add_rank(s, proc.process())
     end_time = engine.run()
     # machine-variance inflation, same as the analytical path
     # (perf-vs-simulator agreement must survive the straggler model)
@@ -85,3 +146,32 @@ def run_simulation(
         with open(os.path.join(save_path, "simu_result.json"), "w") as f:
             json.dump(result, f, indent=2)
     return result
+
+
+def analyze_stragglers(
+    perf,
+    slow_ranks: dict,
+    granularity: str = "chunk",
+) -> dict:
+    """Quantify the iteration-time impact of per-rank slowdowns
+    ({rank: multiplier}) by replaying the schedule with every global
+    rank simulated. Returns baseline/perturbed times, the realized
+    inflation, and the reference-style closed-form ratio for
+    comparison."""
+    base = run_simulation(
+        perf, None, granularity=granularity, world_ranks=True
+    )
+    slow = run_simulation(
+        perf, None, granularity=granularity, world_ranks=True,
+        perturbation=slow_ranks,
+    )
+    return {
+        "baseline_ms": base["end_time_ms"],
+        "perturbed_ms": slow["end_time_ms"],
+        "inflation": slow["end_time"] / base["end_time"],
+        #: naive serial expectation: the worst single multiplier (what
+        #: you'd get if the slow rank gated everything); the simulated
+        #: inflation shows how much the schedule actually absorbs
+        "worst_multiplier": max(slow_ranks.values(), default=1.0),
+        "slow_ranks": slow_ranks,
+    }

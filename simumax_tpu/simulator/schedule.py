@@ -21,18 +21,11 @@ from simumax_tpu.parallel.pipeline import one_f_one_b_order
 from simumax_tpu.simulator.memory import SimuMemoryTracker
 
 
-def _leaf_events(leaf, phase: str):
-    """(pre_comm, compute, post_comm) exposed seconds for one leaf/phase
-    (partial exposure of overlapped collectives included)."""
-    pre = post = 0.0
-    for c in leaf.collective_calls:
-        if c.phase != phase or c.exposed_time <= 0:
-            continue
-        if c.point == "pre":
-            pre += c.exposed_time
-        else:
-            post += c.exposed_time
-    return pre, leaf.cost_info.compute.get(phase), post
+def _leaf_calls(leaf, phase: str, point: str):
+    return [
+        c for c in leaf.collective_calls
+        if c.phase == phase and c.point == point and c.exposed_time > 0
+    ]
 
 
 class StageProcess:
@@ -44,6 +37,10 @@ class StageProcess:
         stage: int,
         tracker: Optional[SimuMemoryTracker] = None,
         granularity: str = "leaf",
+        rank: Optional[int] = None,
+        perturb: float = 1.0,
+        groups: Optional[dict] = None,
+        dp_cp_group: Optional[list] = None,
     ):
         self.perf = perf
         self.stage = stage
@@ -52,6 +49,20 @@ class StageProcess:
         self.granularity = granularity
         self.chunks = perf.stage_chunks(stage)
         self.pp = self.st.pp_size
+        #: world-rank mode: this process IS global rank ``rank``; exposed
+        #: intra-stage collectives become true rendezvous among the
+        #: rank's groups, and ``perturb`` scales its compute (straggler
+        #: injection)
+        self.rank = rank
+        self.perturb = perturb
+        self._groups = groups or {}
+        self._dp_cp_group = dp_cp_group
+        if rank is not None and not self._groups:
+            from simumax_tpu.parallel.mesh import group_of
+
+            for dim in ("tp", "cp", "ep", "etp"):
+                if getattr(self.st, f"{dim}_size") > 1:
+                    self._groups[dim] = group_of(rank, self.st, dim)
         path = perf.ctx.path("pp")
         self.p2p_time = (
             perf.system.compute_net_op_time(
@@ -60,6 +71,40 @@ class StageProcess:
             if self.pp > 1
             else 0.0
         )
+
+    def _pp_stride(self) -> int:
+        st = self.st
+        return st.tp_size * st.cp_size * st.dp_size
+
+    def _neighbor(self, stage: int) -> int:
+        """Engine rank id of the same position at another pp stage."""
+        if self.rank is None:
+            return stage
+        return self.rank + (stage - self.stage) * self._pp_stride()
+
+    def _comm_events(self, leaf, phase: str, point: str):
+        """Yield exposed-comm engine requests for one leaf phase/point:
+        lumped local time in merged mode; true per-group rendezvous in
+        world-rank mode."""
+        name = leaf.path_name().split(".", 1)[-1]
+        if self.rank is None:
+            total = sum(c.exposed_time for c in _leaf_calls(leaf, phase, point))
+            if total:
+                yield ("compute", total, f"{name}.{phase}_comm", "comm")
+            return
+        for c in _leaf_calls(leaf, phase, point):
+            group = self._groups.get(c.dim)
+            if group is None:
+                if c.exposed_time:
+                    yield ("compute", c.exposed_time, f"{name}.{c.op}", "comm")
+                continue
+            yield (
+                "collective",
+                (c.dim, tuple(group)),
+                c.exposed_time,
+                f"{name}.{c.op}[{c.dim}]",
+                list(group),
+            )
 
     # -- memory helpers ----------------------------------------------------
     def _alloc(self, t, nbytes, token=None, tag=""):
@@ -75,17 +120,18 @@ class StageProcess:
         for chunk in (chunks if chunks is not None else self.chunks):
             leaves = chunk.called_leaves()
             if self.granularity == "chunk":
-                dur = chunk.cost_info.fwd_time
+                dur = (chunk.cost_info.compute.fwd * self.perturb
+                       + chunk.cost_info.net_exposed.fwd)
                 t = yield ("compute", dur, f"fwd_mb{mb}", "comp")
                 clock[0] = t
                 self._alloc(t, chunk.act_info.cache_bytes,
                             f"mb{mb}:c{chunk.chunk_idx}", "act")
                 continue
             for leaf in leaves:
-                pre, comp, post = _leaf_events(leaf, "fwd")
+                comp = leaf.cost_info.compute.fwd * self.perturb
                 name = leaf.path_name().split(".", 1)[-1]
-                if pre:
-                    t = yield ("compute", pre, f"{name}.fwd_comm", "comm")
+                for ev in self._comm_events(leaf, "fwd", "pre"):
+                    t = yield ev
                     clock[0] = t
                 self._alloc(clock[0], leaf.raw_act_info.fwd_temp_bytes,
                             tag="temp")
@@ -99,15 +145,20 @@ class StageProcess:
                         clock[0], leaf.act_info.cache_bytes,
                         f"mb{mb}:{id(leaf)}", "act",
                     )
-                if post:
-                    t = yield ("compute", post, f"{name}.fwd_comm", "comm")
+                for ev in self._comm_events(leaf, "fwd", "post"):
+                    t = yield ev
                     clock[0] = t
 
     def _bwd(self, mb: int, clock: List[float], chunks=None) -> Generator:
         for chunk in reversed(chunks if chunks is not None else self.chunks):
             leaves = chunk.called_leaves()
             if self.granularity == "chunk":
-                dur = chunk.cost_info.bwd_time
+                dur = (
+                    chunk.cost_info.compute.bwd * self.perturb
+                    + chunk.cost_info.recompute_time * self.perturb
+                    + chunk.cost_info.net_exposed.bwd_act
+                    + chunk.cost_info.net_exposed.bwd_w
+                )
                 t = yield ("compute", dur, f"bwd_mb{mb}", "comp")
                 clock[0] = t
                 self._free(t, token=f"mb{mb}:c{chunk.chunk_idx}", tag="act")
@@ -126,7 +177,7 @@ class StageProcess:
                         if getattr(l, "recompute_segment", None) is seg
                     ]
                     replay = sum(
-                        sl.cost_info.compute.fwd
+                        sl.cost_info.compute.fwd * self.perturb
                         + sl.cost_info.net_exposed.fwd
                         for sl in seg_leaves
                     )
@@ -144,8 +195,9 @@ class StageProcess:
                                    tag="act")
                     for sl in reversed(seg_leaves):
                         dur = (
-                            sl.cost_info.phase_time("bwd_act")
-                            + sl.cost_info.phase_time("bwd_w")
+                            sl.cost_info.compute.bwd * self.perturb
+                            + sl.cost_info.net_exposed.bwd_act
+                            + sl.cost_info.net_exposed.bwd_w
                         )
                         lname = sl.path_name().split(".", 1)[-1]
                         flight = (sl.raw_act_info.bwd_temp_bytes
@@ -162,13 +214,14 @@ class StageProcess:
                         done.add(id(sl))
                     i -= 1
                     continue
-                pre_a, comp_a, post_a = _leaf_events(leaf, "bwd_act")
-                pre_w, comp_w, post_w = _leaf_events(leaf, "bwd_w")
+                comp_a = leaf.cost_info.compute.bwd_act * self.perturb
+                comp_w = leaf.cost_info.compute.bwd_w * self.perturb
                 name = leaf.path_name().split(".", 1)[-1]
-                dur_comm = pre_a + post_a + pre_w + post_w
-                if dur_comm:
-                    t = yield ("compute", dur_comm, f"{name}.bwd_comm", "comm")
-                    clock[0] = t
+                for phase in ("bwd_act", "bwd_w"):
+                    for point in ("pre", "post"):
+                        for ev in self._comm_events(leaf, phase, point):
+                            t = yield ev
+                            clock[0] = t
                 # grad-in-flight: incoming output-grad + outgoing
                 # input-grad live while the bwd op runs
                 flight = (leaf.raw_act_info.bwd_temp_bytes
@@ -192,21 +245,44 @@ class StageProcess:
         # grad reduce-scatter (dense + moe)
         rs = dp.get("dense_grad_rs_time", 0.0) + dp.get("moe_grad_rs_time", 0.0)
         ag = dp.get("dense_param_ag_time", 0.0) + dp.get("moe_param_ag_time", 0.0)
-        if rs:
+        st = self.st
+        group = self._dp_cp_group
+        if group is None and self.rank is not None and st.dp_size * st.cp_size > 1:
+            from simumax_tpu.parallel.mesh import rank_coords
+
+            mine = rank_coords(self.rank, st)
+            group = sorted(
+                r
+                for r in range(st.world_size)
+                if rank_coords(r, st)["tp"] == mine["tp"]
+                and rank_coords(r, st)["pp"] == mine["pp"]
+            )
+        if self.rank is not None and group:
+            if rs:
+                t = yield ("collective", ("dp_cp_rs", tuple(group)), rs,
+                           "grad_reduce_scatter", group)
+                clock[0] = t
+        elif rs:
             t = yield ("compute", rs, "grad_reduce_scatter", "comm")
             clock[0] = t
         # world barrier before the step (rerun_state_machine analog)
+        n_ranks = self.pp if self.rank is None else st.world_size
         t = yield (
             "collective",
             "optimizer_barrier",
             0.0,
             "optimizer_barrier",
-            list(range(self.pp)),
+            list(range(n_ranks)),
         )
         clock[0] = t
-        t = yield ("compute", perf._compute_optim_time(), "adam_step", "comp")
+        t = yield ("compute", perf._compute_optim_time() * self.perturb,
+                   "adam_step", "comp")
         clock[0] = t
-        if ag:
+        if self.rank is not None and group and ag:
+            t = yield ("collective", ("dp_cp_ag", tuple(group)), ag,
+                       "param_all_gather", group)
+            clock[0] = t
+        elif ag:
             t = yield ("compute", ag, "param_all_gather", "comm")
             clock[0] = t
 
@@ -221,14 +297,14 @@ class StageProcess:
         for kind, mb in one_f_one_b_order(pp, stage, mbc):
             if kind == "F":
                 if stage > 0:
-                    t = yield ("recv", stage - 1, f"fwd{mb}",
+                    t = yield ("recv", self._neighbor(stage - 1), f"fwd{mb}",
                                f"recv_fwd{mb}", "pp_fwd")
                     clock[0] = t
                 yield from self._fwd(mb, clock)
                 if stage < pp - 1:
                     t = yield (
-                        "send", stage + 1, f"fwd{mb}", self.p2p_time,
-                        f"send_fwd{mb}", "pp_fwd",
+                        "send", self._neighbor(stage + 1), f"fwd{mb}",
+                        self.p2p_time, f"send_fwd{mb}", "pp_fwd",
                     )
                     clock[0] = t
                     if not st.pp_comm_async:
@@ -239,14 +315,14 @@ class StageProcess:
                         yield ("advance", clock[0] + self.p2p_time)
             else:
                 if stage < pp - 1:
-                    t = yield ("recv", stage + 1, f"bwd{mb}",
+                    t = yield ("recv", self._neighbor(stage + 1), f"bwd{mb}",
                                f"recv_bwd{mb}", "pp_bwd")
                     clock[0] = t
                 yield from self._bwd(mb, clock)
                 if stage > 0:
                     t = yield (
-                        "send", stage - 1, f"bwd{mb}", self.p2p_time,
-                        f"send_bwd{mb}", "pp_bwd",
+                        "send", self._neighbor(stage - 1), f"bwd{mb}",
+                        self.p2p_time, f"send_bwd{mb}", "pp_bwd",
                     )
                     clock[0] = t
                     if not st.pp_comm_async:
@@ -268,13 +344,13 @@ class StageProcess:
         for kind, c, mb in interleaved_order(pp, stage, mbc, vp, group):
             if kind == "F":
                 if not (stage == 0 and c == 0):
-                    src = stage - 1 if stage > 0 else pp - 1
+                    src = self._neighbor(stage - 1 if stage > 0 else pp - 1)
                     t = yield ("recv", src, f"fwd_c{c}_mb{mb}",
                                f"recv_fwd_c{c}_mb{mb}", "pp_fwd")
                     clock[0] = t
                 yield from self._fwd(mb, clock, by_chunk[c])
                 if not (stage == pp - 1 and c == vp - 1):
-                    dst = stage + 1 if stage < pp - 1 else 0
+                    dst = self._neighbor(stage + 1 if stage < pp - 1 else 0)
                     rc = c if stage < pp - 1 else c + 1
                     t = yield ("send", dst, f"fwd_c{rc}_mb{mb}",
                                self.p2p_time, f"send_fwd_c{rc}_mb{mb}",
@@ -284,13 +360,13 @@ class StageProcess:
                         yield ("advance", clock[0] + self.p2p_time)
             else:
                 if not (stage == pp - 1 and c == vp - 1):
-                    src = stage + 1 if stage < pp - 1 else 0
+                    src = self._neighbor(stage + 1 if stage < pp - 1 else 0)
                     t = yield ("recv", src, f"bwd_c{c}_mb{mb}",
                                f"recv_bwd_c{c}_mb{mb}", "pp_bwd")
                     clock[0] = t
                 yield from self._bwd(mb, clock, by_chunk[c])
                 if not (stage == 0 and c == 0):
-                    dst = stage - 1 if stage > 0 else pp - 1
+                    dst = self._neighbor(stage - 1 if stage > 0 else pp - 1)
                     rc = c if stage > 0 else c - 1
                     t = yield ("send", dst, f"bwd_c{rc}_mb{mb}",
                                self.p2p_time, f"send_bwd_c{rc}_mb{mb}",

@@ -221,3 +221,49 @@ class TestArtifacts:
         trace = json.load(open(os.path.join(tmp_path, "trace.json")))
         names = {e.get("name", "") for e in trace["traceEvents"]}
         assert any("recompute" in n for n in names)
+
+
+class TestWorldRanks:
+    """Full world-rank simulation: every global rank with true tp/dp
+    rendezvous (the reference's merge_lanes=False analog) + per-rank
+    straggler injection beyond its closed-form model."""
+
+    @pytest.mark.parametrize(
+        "strat", ["tp2_pp1_dp4_mbs1", "tp1_pp2_dp4_mbs1"]
+    )
+    def test_symmetric_world_matches_merged(self, strat):
+        p = run(strat)
+        merged = p.simulate(None)
+        world = p.simulate(None, world_ranks=True)
+        assert world["end_time"] == pytest.approx(
+            merged["end_time"], rel=1e-9
+        )
+
+    def test_world_mode_moe(self):
+        p = run("ep4_pp2_dp4_mbs1", model="mixtral-8x7b",
+                system="tpu_v5p_256")
+        merged = p.simulate(None)
+        world = p.simulate(None, world_ranks=True)
+        assert world["end_time"] == pytest.approx(
+            merged["end_time"], rel=1e-6
+        )
+
+    def test_straggler_propagates_through_collectives(self):
+        from simumax_tpu.simulator.runner import analyze_stragglers
+
+        p = run("tp1_pp2_dp4_mbs1")
+        one = analyze_stragglers(p, {0: 1.2})
+        assert 1.0 < one["inflation"] < 1.2
+        # one slow rank hurts as much as the whole stage being slow:
+        # the collective sync serializes on the slowest member
+        all_stage0 = analyze_stragglers(p, {r: 1.2 for r in range(4)})
+        assert one["inflation"] == pytest.approx(
+            all_stage0["inflation"], rel=1e-6
+        )
+
+    def test_unperturbed_analysis_is_identity(self):
+        from simumax_tpu.simulator.runner import analyze_stragglers
+
+        p = run("tp2_pp1_dp4_mbs1")
+        r = analyze_stragglers(p, {})
+        assert r["inflation"] == pytest.approx(1.0)
