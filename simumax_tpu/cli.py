@@ -11,6 +11,8 @@ non-interactively::
         --world 64 --gbs 128 --tp 1,2,4,8 --pp 1,2,4 [--csv sweep.csv]
     python -m simumax_tpu calibrate --model ... --strategy ... \
         --system ... --save my_system.json      # needs a live TPU
+    python -m simumax_tpu straggler --model ... --strategy ... \
+        --system ... --ranks 0:1.2,5:1.5        # per-rank slowdowns
 """
 
 from __future__ import annotations
@@ -118,6 +120,36 @@ def cmd_calibrate(args):
     perf.analysis()
 
 
+def cmd_straggler(args):
+    from simumax_tpu import PerfLLM
+    from simumax_tpu.simulator.runner import analyze_stragglers
+
+    perf = PerfLLM().configure(args.strategy, args.model, args.system)
+    slow = {}
+    for spec in args.ranks.split(","):
+        try:
+            r, f = spec.split(":")
+            slow[int(r)] = float(f)
+        except ValueError:
+            raise SystemExit(
+                f"bad --ranks entry {spec!r}: expected rank:multiplier "
+                "(e.g. 0:1.2,5:1.5)"
+            )
+    world = perf.strategy.world_size
+    bad = [r for r in slow if not 0 <= r < world]
+    if bad:
+        raise SystemExit(
+            f"ranks {bad} out of range for world_size {world}"
+        )
+    perf.run_estimate()
+    res = analyze_stragglers(perf, slow)
+    print(
+        f"baseline {res['baseline_ms']:.1f} ms -> perturbed "
+        f"{res['perturbed_ms']:.1f} ms  (inflation {res['inflation']:.3f}, "
+        f"worst injected multiplier {res['worst_multiplier']:.2f})"
+    )
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="simumax_tpu",
@@ -167,6 +199,19 @@ def main(argv=None):
     pc.add_argument("--collectives", action="store_true",
                     help="also sweep+fit collectives (needs >1 device)")
     pc.set_defaults(fn=cmd_calibrate)
+
+    pst = sub.add_parser(
+        "straggler",
+        help="world-rank simulation with per-rank slowdown injection",
+    )
+    pst.add_argument("--model", required=True)
+    pst.add_argument("--strategy", required=True)
+    pst.add_argument("--system", required=True)
+    pst.add_argument(
+        "--ranks", required=True,
+        help="rank:multiplier list, e.g. 0:1.2,5:1.5",
+    )
+    pst.set_defaults(fn=cmd_straggler)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,106 @@
+"""Randomized-config invariant sweep (property-test style, seeded):
+sample valid (model, strategy) combinations and assert the framework's
+cross-cutting invariants hold on every one — activation conservation
+(internal assert), perf-vs-simulator agreement, parameter-accounting
+reconstruction, memory-breakdown consistency.
+"""
+
+import random
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import (
+    StrategyConfig,
+    get_model_config,
+)
+
+MODELS = ["llama2-tiny", "llama3-8b", "mixtral-8x1b", "deepseekv2-lite"]
+
+
+def sample_strategy(rng, model):
+    for _ in range(50):
+        tp = rng.choice([1, 2, 4])
+        cp = rng.choice([1, 2]) if model.model_type == "dense" else 1
+        pp = rng.choice([1, 2, 4])
+        dp = rng.choice([1, 2, 4])
+        world = tp * cp * pp * dp
+        ep = 1
+        if model.model_type == "moe":
+            choices = [
+                e for e in (1, 2, 4)
+                if model.expert_num % e == 0 and (dp * cp * tp) % e == 0
+            ]
+            ep = rng.choice(choices)
+        mbc = rng.choice([1, 2, 4, 8])
+        vp = rng.choice([1, 2]) if pp > 1 and mbc % pp == 0 else 1
+        st = StrategyConfig(
+            world_size=world, tp_size=tp, cp_size=cp, pp_size=pp,
+            ep_size=ep, micro_batch_num=mbc, interleaving_size=vp,
+            seq_len=rng.choice([1024, 2048]),
+            enable_sequence_parallel=rng.random() < 0.8,
+            enable_recompute=rng.random() < 0.4,
+            recompute_granularity=rng.choice(
+                ["full_block", "selective_recompute"]
+            ),
+            sdp_recompute=rng.random() < 0.5,
+            attn_recompute=rng.random() < 0.5,
+            mlp_recompute=rng.random() < 0.5,
+            fp8=rng.random() < 0.3,
+            enable_dropout=rng.random() < 0.3,
+            zero_state=rng.choice([0, 1]),
+            use_fused_ce=rng.random() < 0.5,
+            optimizer_style=rng.choice(["megatron", "functional"]),
+        )
+        try:
+            st.sanity_check()
+        except AssertionError:
+            continue
+        if model.head_num % (tp * cp):
+            continue
+        if st.enable_sequence_parallel and st.seq_len % (tp * cp):
+            continue
+        total_stages = pp * vp
+        if model.layer_num % total_stages:
+            continue
+        return st
+    return None
+
+
+@pytest.mark.parametrize("seed", range(24))
+def test_random_config_invariants(seed):
+    rng = random.Random(seed)
+    model_name = rng.choice(MODELS)
+    model = get_model_config(model_name)
+    st = sample_strategy(rng, model)
+    if st is None:
+        pytest.skip("no valid sample for this seed")
+    p = PerfLLM()
+    try:
+        p.configure(st, model, "tpu_v5p_256")
+    except AssertionError:
+        pytest.skip("cross-sanity rejected sample")
+    p.run_estimate()  # asserts activation conservation internally
+    cost = p.analysis_cost()
+    mem = p.analysis_mem()
+    assert 0 < cost["mfu"] < 1, (model_name, vars(st))
+    # memory breakdown consistency
+    for s in mem["stages"]:
+        total = s["weight_bytes"] + s["grad_bytes"] + s["optimizer_state_bytes"]
+        assert total == pytest.approx(s["model_bytes"], rel=1e-9)
+        assert s["peak_bytes"] >= s["model_bytes"]
+    # param accounting: exact reconstruction at tp=1 (linears shard by
+    # tp, norms replicate, so only bounds hold otherwise)
+    dense = sum(c.param_info.dense_numel for c in p.chunks.values())
+    moe = sum(c.param_info.moe_numel for c in p.chunks.values())
+    total_cfg = model.param_numel()
+    if st.tp_size == 1 and st.etp_size == 1:
+        assert dense + moe * st.ep_size == pytest.approx(total_cfg, rel=1e-9)
+    else:
+        assert total_cfg / (st.tp_size * 1.001) <= dense + moe * st.ep_size * st.etp_size
+        assert dense + moe * st.ep_size <= total_cfg * 1.001
+    # perf vs simulator
+    sim = p.simulate(None, granularity="chunk", track_memory=False)
+    assert sim["end_time"] == pytest.approx(cost["iter_time"], rel=0.01), (
+        model_name, vars(st),
+    )
