@@ -15,7 +15,6 @@ factors back — so one command closes the loop for any model/strategy.
 from __future__ import annotations
 
 import json
-import re
 from typing import Dict, Optional
 
 import jax

@@ -10,8 +10,8 @@ TensorSpec uids, so no explicit wiring is needed in the ops.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import Dict, List
 
 
 @dataclass

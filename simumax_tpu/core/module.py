@@ -15,7 +15,7 @@ Redesign notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 from simumax_tpu.core.config import ModelConfig, StrategyConfig, SystemConfig
 from simumax_tpu.core.records import (

@@ -19,8 +19,7 @@ target in BASELINE.md). It is deliberately idiomatic TPU JAX:
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

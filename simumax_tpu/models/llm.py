@@ -11,7 +11,6 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 from simumax_tpu.core.module import BuildContext, MetaModule
-from simumax_tpu.core.records import RecomputeStatus
 from simumax_tpu.core.tensor import TensorSpec
 from simumax_tpu.models.dense import (
     AddFunction,

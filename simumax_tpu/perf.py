@@ -29,7 +29,7 @@ from simumax_tpu.core.config import (
     get_system_config,
 )
 from simumax_tpu.core.module import BuildContext
-from simumax_tpu.core.utils import human_bytes, human_time
+from simumax_tpu.core.utils import human_time
 from simumax_tpu.models.llm import LLMModel
 
 
@@ -85,6 +85,16 @@ class PerfBase:
             pass  # kv heads replicated within tp; allowed
         if m.model_type == "moe":
             assert m.expert_num % st.ep_size == 0, "expert_num % ep != 0"
+        if st.fp8:
+            needed = [f"{st.quant_dtype}_matmul"]
+            if m.model_type == "moe":
+                needed.append(f"{st.quant_dtype}_group_matmul")
+            for key in needed:
+                assert key in sysc.accelerator.op, (
+                    f"system {sysc.sys_name!r} has no {key!r} efficiency "
+                    f"table — this chip does not support {st.quant_dtype} "
+                    f"matmuls (available: {sorted(sysc.accelerator.op)})"
+                )
         total_stages = st.pp_size * st.vp_size
         layers = m.layer_num
         if st.num_layers_in_first_pipeline_stage:

@@ -18,7 +18,7 @@ import copy
 import csv
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 from simumax_tpu.core.config import (
     GiB,

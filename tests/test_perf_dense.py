@@ -274,3 +274,23 @@ class TestTiedEmbeddings:
             p.analysis_cost()["dp_comm"].get("tied_embedding_grad_ar_time", 0)
             > 0
         )
+
+
+class TestMathSDP:
+    def test_math_path_caches_scores(self):
+        flash = run("tp2_pp1_dp4_mbs1")
+        math_p = run("tp2_pp1_dp4_mbs1", use_flash_sdp=False,
+                     use_math_sdp=True)
+        fc = flash.chunks[(0, 0)].blocks[0].attention.core
+        mc = math_p.chunks[(0, 0)].blocks[0].attention.core
+        assert mc.act_info.cache_bytes > 2 * fc.act_info.cache_bytes
+        assert (
+            math_p.analysis_cost()["iter_time"]
+            > flash.analysis_cost()["iter_time"]
+        )
+
+
+class TestQuantDtypeGuard:
+    def test_unsupported_quant_dtype_rejected(self):
+        with pytest.raises(AssertionError, match="no 'fp8_matmul'"):
+            run("tp2_pp1_dp4_mbs1", fp8=True, quant_dtype="fp8")
