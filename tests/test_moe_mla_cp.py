@@ -256,3 +256,48 @@ class TestContextParallel:
         ts = ps.analysis_cost()["iter_time"]
         ta = pa.analysis_cost()["iter_time"]
         assert ta < ts
+
+
+class TestComposition:
+    """Everything at once: the dims and features must compose."""
+
+    def test_kitchen_sink_dense(self):
+        p = run(
+            "tp1_pp2_dp4_mbs1", "llama3-8b", "tpu_v5p_256",
+            world_size=32, tp_size=2, cp_size=2, pp_size=2,
+            micro_batch_num=8, fp8=True, enable_dropout=True,
+            enable_recompute=True,
+            recompute_granularity="selective_recompute",
+            sdp_recompute=True, mlp_recompute=True,
+        )
+        c = p.analysis_cost()
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
+        world = p.simulate(None, world_ranks=True)
+        assert world["end_time"] == pytest.approx(sim["end_time"], rel=1e-6)
+
+    def test_kitchen_sink_moe(self):
+        m = get_model_config("deepseekv2")
+        m.layer_num = 4
+        m.dense_layers = 1
+        p = run(
+            "ep4_pp2_dp4_mbs1", m, "tpu_v5p_256",
+            world_size=32, tp_size=2, ep_size=4, etp_size=2, pp_size=2,
+            micro_batch_num=8, fp8=True, enable_recompute=True,
+            recompute_granularity="full_block", recompute_layer_num=1,
+        )
+        c = p.analysis_cost()
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
+        mem = p.analysis_mem()
+        assert mem["max_peak_bytes"] > 0
+
+    def test_cp_with_pp_vpp(self):
+        p = run(
+            "tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt", "llama3-8b",
+            "tpu_v5p_256", world_size=32, cp_size=2, seq_len=8192,
+        )
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(
+            p.analysis_cost()["iter_time"], rel=0.01
+        )
