@@ -755,7 +755,10 @@ class SystemConfig(ConfigBase):
     # (reference ``compute_mem_access_time`` config.py:863-893)
     # ----------------------------------------------------------------------
     def compute_mem_access_time(self, bytes_: float, bw_key: str = "default") -> float:
-        spec: BandwidthSpec = self.accelerator.bandwidth.get(bw_key) or self.accelerator.bandwidth["default"]
+        spec: BandwidthSpec = (
+            self.accelerator.bandwidth.get(bw_key)
+            or self.accelerator.bandwidth["default"]
+        )
         if bytes_ <= 0:
             return 0.0
         return bytes_ / (spec.gbps * 1e9 * spec.efficient_factor) + spec.latency_us * 1e-6

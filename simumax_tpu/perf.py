@@ -270,9 +270,18 @@ class PerfLLM(PerfBase):
                 )
                 live = min(mbc, pp - s)
                 peak = model_mem + max(live - 1, 0) * cache_per_mb + replay_peak
-                weight = sum(c.param_info.weight_bytes + c.param_info.moe_weight_bytes for c in chunks)
-                grad = sum(c.param_info.grad_bytes + c.param_info.moe_grad_bytes for c in chunks)
-                state = sum(c.param_info.state_bytes + c.param_info.moe_state_bytes for c in chunks)
+                weight = sum(
+                    c.param_info.weight_bytes + c.param_info.moe_weight_bytes
+                    for c in chunks
+                )
+                grad = sum(
+                    c.param_info.grad_bytes + c.param_info.moe_grad_bytes
+                    for c in chunks
+                )
+                state = sum(
+                    c.param_info.state_bytes + c.param_info.moe_state_bytes
+                    for c in chunks
+                )
                 stages.append(
                     {
                         "stage": s,

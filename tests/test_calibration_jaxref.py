@@ -20,9 +20,8 @@ from simumax_tpu.calibration.autocal import (
 from simumax_tpu.calibration.collective_bench import (
     fit_alpha_beta,
     measure_collective,
-    sweep_axis,
 )
-from simumax_tpu.core.config import StrategyConfig, get_strategy_config
+from simumax_tpu.core.config import get_strategy_config
 
 
 def small_perf():

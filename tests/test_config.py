@@ -1,7 +1,5 @@
 """L0 unit tests: configs + TPU cost primitives (hand-computed cases)."""
 
-import math
-
 import pytest
 
 from simumax_tpu.core.config import (
@@ -9,7 +7,6 @@ from simumax_tpu.core.config import (
     StrategyConfig,
     SystemConfig,
     get_model_config,
-    get_system_config,
     get_strategy_config,
     list_configs,
 )

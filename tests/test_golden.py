@@ -11,7 +11,6 @@ import os
 
 import pytest
 
-from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import get_model_config, get_strategy_config
 from simumax_tpu.testing import ResultCheck
 from tests.test_perf_dense import run

@@ -3,7 +3,7 @@ invariants + closed-form cross-checks instead of golden GPU numbers)."""
 
 import pytest
 
-from simumax_tpu import PerfLLM, StrategyConfig
+from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import get_model_config, get_strategy_config
 
 

@@ -2,8 +2,6 @@
 
 import os
 
-import pytest
-
 from simumax_tpu.core.config import (
     get_model_config,
     get_strategy_config,

@@ -8,7 +8,7 @@ import os
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.core.config import get_strategy_config
 from simumax_tpu.simulator.engine import DeadlockError, SimuEngine
 
 

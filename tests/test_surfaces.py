@@ -9,7 +9,7 @@ import sys
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.core.config import get_strategy_config
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -1,0 +1,76 @@
+"""Minimal dependency-free linter (reference ``tools/lint`` analog).
+
+Checks: syntax (compile), unused imports (AST), overlong lines, and
+tabs. Exit code 1 on findings. Usage::
+
+    python tools/lint.py [paths...]
+    # default paths: simumax_tpu tests tools examples
+"""
+
+import ast
+import os
+import sys
+
+MAX_LINE = 100
+
+
+def check_file(path):
+    issues = []
+    src = open(path).read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    names = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    attrs = {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
+    is_init = os.path.basename(path) == "__init__.py"
+    for name, lineno in imported.items():
+        if name == "annotations" or is_init:
+            continue  # __init__ re-exports are the public API
+        if (
+            name not in names
+            and name not in attrs
+            and f"{name}." not in src
+            and f'"{name}"' not in src
+        ):
+            issues.append(f"{path}:{lineno}: unused import {name}")
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line:
+            issues.append(f"{path}:{i}: tab character")
+        if len(line) > MAX_LINE and "http" not in line:
+            issues.append(f"{path}:{i}: line too long ({len(line)})")
+    return issues
+
+
+def main(paths):
+    paths = paths or ["simumax_tpu", "tests", "tools", "examples"]
+    issues = []
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path {p!r}")
+            return 2
+        if os.path.isfile(p):
+            issues += check_file(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for fn in files:
+                if fn.endswith(".py"):
+                    issues += check_file(os.path.join(root, fn))
+    for i in issues:
+        print(i)
+    print(f"{len(issues)} issue(s)")
+    return 1 if issues else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -52,7 +52,47 @@ def run_case(name, model, layers, strat_name, system, **overrides):
     }
 
 
+def build_small_cases(system):
+    """Case matrix sized for ~16 GiB chips (v5e-class)."""
+    cases = []
+    for tp in (4, 8):
+        for mbc in (4, 8):
+            cases.append(run_case(
+                f"llama3_8b_l16_tp{tp}_mbc{mbc}", "llama3-8b", 16,
+                "tp1_pp1_dp8_mbs1", system,
+                world_size=16, tp_size=tp, micro_batch_num=mbc,
+                enable_recompute=True,
+                recompute_granularity="selective_recompute",
+                sdp_recompute=True,
+            ))
+    cases.append(run_case(
+        "llama3_8b_l16_tp4_pp2_mbc8", "llama3-8b", 16,
+        "tp1_pp2_dp4_mbs1", system, world_size=16, tp_size=4,
+        micro_batch_num=8, enable_recompute=True,
+        recompute_granularity="full_block",
+    ))
+    for strat, name in (("ep8_pp1_dp8_mbs1", "ep8"),
+                        ("ep4_pp2_dp4_mbs1", "ep4_pp2")):
+        cases.append(run_case(
+            f"dsv2lite_l8_{name}_mbc8", "deepseekv2-lite", 8, strat,
+            system, micro_batch_num=8, enable_recompute=True,
+            recompute_granularity="full_block",
+        ))
+    cases.append(run_case(
+        "llama3_8b_l16_tp4_cp4_seq32768", "llama3-8b", 16,
+        "tp1_pp1_dp8_mbs1", system, world_size=32, tp_size=4,
+        cp_size=4, seq_len=32768, micro_batch_num=4,
+        enable_recompute=True, recompute_granularity="full_block",
+    ))
+    return cases
+
+
 def build_cases(system):
+    from simumax_tpu.core.config import get_system_config
+
+    sysc = get_system_config(system)
+    if sysc.accelerator.mem_gbs < 32:
+        return build_small_cases(system)
     cases = []
     # dense llama3-70b l12: tp grid x mbc (reference B200 dense table)
     for tp in (2, 4, 8):
