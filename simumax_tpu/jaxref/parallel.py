@@ -46,6 +46,13 @@ class PPConfig:
     intermediate_size: int = 512
     layers_per_stage: int = 2
     moe_every: int = 2  # every n-th layer in a stage is MoE (0 = dense)
+    #: "psum": experts sharded over ep, tokens replicated in the group;
+    #: "a2a": capacity-based all_to_all token dispatch/combine (the
+    #: layout the analytical Permutation/UnPermutation ops cost)
+    ep_dispatch: str = "psum"
+
+    def __post_init__(self):
+        assert self.ep_dispatch in ("psum", "a2a"), self.ep_dispatch
     expert_num: int = 8
     topk: int = 2
     moe_ffn: int = 256
@@ -145,30 +152,34 @@ def _stage_block(x, p, li, cfg: PPConfig, is_moe: bool):
     res = x
     y = _rms(x)
     if is_moe:
-        # experts sharded over ep, tokens replicated within the ep
-        # group: each rank runs its local experts, psum(ep) combines
-        ep = jax.lax.axis_size("ep")
-        e_local = cfg.expert_num // ep
-        eidx = jax.lax.axis_index("ep") * e_local
-        gate_logits = y @ p["gate"][li].astype(y.dtype)  # [b, s/tp, E]
-        probs = jax.nn.softmax(gate_logits.astype(jnp.float32), -1)
-        topv, topi = jax.lax.top_k(probs, cfg.topk)
-        mask = jax.nn.one_hot(topi, cfg.expert_num).sum(-2)
-        weights = (probs * mask) / (
-            jnp.sum(probs * mask, -1, keepdims=True) + 1e-9
-        )
-        w_up = p["moe_up"][li]  # [E/ep, h, 2m] (already local)
-        w_dn = p["moe_down"][li]
-        from simumax_tpu.jaxref.kernels import swiglu
+        if cfg.ep_dispatch == "a2a":
+            o = _moe_a2a_dispatch(y, p, li, cfg)
+        else:
+            # experts sharded over ep, tokens replicated within the ep
+            # group: each rank runs its local experts, psum(ep) combines
+            ep = jax.lax.axis_size("ep")
+            e_local = cfg.expert_num // ep
+            eidx = jax.lax.axis_index("ep") * e_local
+            b_, s_, _ = y.shape
+            topi, topw = _gate(y, p, li, cfg)
+            weights = (
+                jnp.zeros((b_ * s_, cfg.expert_num), y.dtype)
+                .at[jnp.arange(b_ * s_)[:, None], topi]
+                .add(topw)
+                .reshape(b_, s_, cfg.expert_num)
+            )
+            w_up = p["moe_up"][li]  # [E/ep, h, 2m] (already local)
+            w_dn = p["moe_down"][li]
+            from simumax_tpu.jaxref.kernels import swiglu
 
-        up = jnp.einsum("bsh,ehf->bsef", y, w_up)
-        act = swiglu(up)  # pallas on TPU: shapes are shard-local here
-        out = jnp.einsum("bsef,efh->bseh", act, w_dn)
-        w_loc = jax.lax.dynamic_slice_in_dim(
-            weights.astype(out.dtype), eidx, e_local, 2
-        )
-        o = jnp.einsum("bseh,bse->bsh", out, w_loc)
-        o = jax.lax.psum(o, "ep")  # expert combine (same tokens)
+            up = jnp.einsum("bsh,ehf->bsef", y, w_up)
+            act = swiglu(up)  # pallas on TPU: shard-local shapes here
+            out = jnp.einsum("bsef,efh->bseh", act, w_dn)
+            w_loc = jax.lax.dynamic_slice_in_dim(
+                weights.astype(out.dtype), eidx, e_local, 2
+            )
+            o = jnp.einsum("bseh,bse->bsh", out, w_loc)
+            o = jax.lax.psum(o, "ep")  # expert combine (same tokens)
     else:
         from simumax_tpu.jaxref.kernels import swiglu
 
@@ -179,6 +190,82 @@ def _stage_block(x, p, li, cfg: PPConfig, is_moe: bool):
         o = swiglu(up) @ p["down"][li]
         o = jax.lax.psum_scatter(o, "tp", scatter_dimension=1, tiled=True)
     return res + o
+
+
+def _gate(y, p, li, cfg: PPConfig):
+    """Shared top-k gating: returns (topi [T,k], topw [T,k]) with
+    weights normalized over the selected experts."""
+    T = y.shape[0] * y.shape[1]
+    gate_logits = y @ p["gate"][li].astype(y.dtype)
+    probs = jax.nn.softmax(
+        gate_logits.reshape(T, cfg.expert_num).astype(jnp.float32), -1
+    )
+    topv, topi = jax.lax.top_k(probs, cfg.topk)
+    topw = (topv / (jnp.sum(topv, -1, keepdims=True) + 1e-9)).astype(y.dtype)
+    return topi, topw
+
+
+def _moe_a2a_dispatch(y, p, li, cfg: PPConfig):
+    """Capacity-based EP token dispatch: route each (token, expert)
+    assignment to the expert-owner rank with ``lax.all_to_all``, run the
+    local experts on the received tokens only, and combine through the
+    reverse a2a — the exact communication pattern the analytical
+    Permutation/UnPermutation ops cost. Dropless here (capacity = all
+    assignments) so it is numerically identical to the psum layout."""
+    from simumax_tpu.jaxref.kernels import swiglu
+
+    b, s_loc, h = y.shape
+    T = b * s_loc
+    k = cfg.topk
+    ep = jax.lax.axis_size("ep")
+    e_local = cfg.expert_num // ep
+    eidx = jax.lax.axis_index("ep") * e_local
+
+    topi, topw = _gate(y, p, li, cfg)
+
+    yf = y.reshape(T, h)
+    flat_e = topi.reshape(T * k)
+    flat_w = topw.reshape(T * k)
+    flat_tok = jnp.repeat(jnp.arange(T), k)
+    dest = flat_e // e_local  # owning ep rank per assignment
+
+    # stable sort by destination; slot = index within the dest segment
+    order = jnp.argsort(dest, stable=True)
+    sorted_dest = dest[order]
+    seg_start = jnp.searchsorted(sorted_dest, jnp.arange(ep))
+    slot = jnp.arange(T * k) - seg_start[sorted_dest]
+    C = T * k  # dropless capacity per destination row
+
+    send = jnp.zeros((ep, C, h), y.dtype).at[sorted_dest, slot].set(
+        yf[flat_tok[order]]
+    )
+    send_e = jnp.full((ep, C), -1, jnp.int32).at[sorted_dest, slot].set(
+        flat_e[order]
+    )
+    recv = jax.lax.all_to_all(send, "ep", split_axis=0, concat_axis=0,
+                              tiled=True)
+    recv_e = jax.lax.all_to_all(send_e, "ep", split_axis=0, concat_axis=0,
+                                tiled=True)
+
+    local_e = recv_e.reshape(ep * C) - eidx
+    valid = (recv_e.reshape(ep * C) >= 0) & (local_e >= 0) & (local_e < e_local)
+    sel = jax.nn.one_hot(jnp.where(valid, local_e, 0), e_local,
+                         dtype=y.dtype) * valid[:, None].astype(y.dtype)
+    xin = recv.reshape(ep * C, h)
+    up = jnp.einsum("th,ehf->tef", xin, p["moe_up"][li])
+    act = swiglu(up)
+    down = jnp.einsum("tef,efh->teh", act, p["moe_down"][li])
+    out_tok = jnp.einsum("teh,te->th", down, sel)
+
+    back = jax.lax.all_to_all(
+        out_tok.reshape(ep, C, h), "ep", split_axis=0, concat_axis=0,
+        tiled=True,
+    )
+    vals = back[sorted_dest, slot]  # values in `order` ordering
+    o = jnp.zeros((T, h), y.dtype).at[flat_tok[order]].add(
+        vals * flat_w[order][:, None]
+    )
+    return o.reshape(b, s_loc, h)
 
 
 def _stage_fwd(x, p, cfg: PPConfig):
@@ -259,11 +346,11 @@ def make_pp_train_step(cfg: PPConfig, mesh: Mesh, lr: float = 1e-3):
 
 def run_pp_dryrun(
     n_devices: int, pp: int = 2, tp: int = 2, ep: int = 1,
-    backend: Optional[str] = None,
+    backend: Optional[str] = None, ep_dispatch: str = "psum",
 ) -> float:
     """One full pp+tp+sp+dp+ep training step on tiny shapes; returns
     the loss (finite => the sharded program compiled and executed)."""
-    cfg = PPConfig()
+    cfg = PPConfig(ep_dispatch=ep_dispatch)
     mesh = make_pp_mesh(n_devices, pp=pp, tp=tp, ep=ep, backend=backend)
     params, specs = init_pp_params(cfg, mesh, jax.random.PRNGKey(0))
     train_step = make_pp_train_step(cfg, mesh)(specs)

@@ -20,6 +20,8 @@ Request vocabulary (yielded by rank coroutines):
 * ``("recv", src, tag, name, lane)`` — blocks until the matching send's
   data has arrived (``send_post_time + duration``)
 * ``("advance", t)`` — jump lane clock to at least t
+* ``("trace", duration, name, lane)`` — zero-advance visibility span
+  (overlapped comm shown in the trace without consuming rank time)
 """
 
 from __future__ import annotations
@@ -125,6 +127,16 @@ class SimuEngine:
             _, t = req
             self.clock[rank] = max(self.clock[rank], t)
             self._advance_rank(rank, self.clock[rank])
+            return True
+        if kind == "trace":
+            # zero-advance visibility span (e.g. overlapped async comm)
+            _, duration, name, lane = req
+            start = self.clock[rank]
+            self.events.append(
+                TraceEvent(rank, lane, name, start, start + duration,
+                           kind="comm")
+            )
+            self._advance_rank(rank, start)
             return True
         if kind == "collective":
             _, key, duration, name, peers = req

@@ -85,8 +85,17 @@ class StageProcess:
     def _comm_events(self, leaf, phase: str, point: str):
         """Yield exposed-comm engine requests for one leaf phase/point:
         lumped local time in merged mode; true per-group rendezvous in
-        world-rank mode."""
+        world-rank mode. Overlapped (hidden) collective time is emitted
+        as zero-advance trace spans so traces show the async comm."""
         name = leaf.path_name().split(".", 1)[-1]
+        hidden = sum(
+            c.time - c.exposed_time
+            for c in leaf.collective_calls
+            if c.phase == phase and c.point == point
+            and c.time > c.exposed_time
+        )
+        if hidden > 0:
+            yield ("trace", hidden, f"{name}.{phase}_comm_async", "comm")
         if self.rank is None:
             total = sum(c.exposed_time for c in _leaf_calls(leaf, phase, point))
             if total:
