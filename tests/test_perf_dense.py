@@ -298,13 +298,13 @@ class TestQuantDtypeGuard:
     def test_uneven_with_vpp(self):
         """First/last overrides apply to virtual stages under vp>1."""
         st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
-        st.num_layers_in_first_pipeline_stage = 2
-        st.num_layers_in_last_pipeline_stage = 2
+        st.num_layers_in_first_pipeline_stage = 4
+        st.num_layers_in_last_pipeline_stage = 4
         st.__post_init__()
         p = run(st)
         counts = p.stage_layer_counts()
-        assert counts[0][0] == 2  # first virtual stage
-        assert counts[3][1] == 2  # last virtual stage
+        assert counts[0][0] == 4  # first virtual stage
+        assert counts[3][1] == 4  # last virtual stage
         assert sum(sum(c) for c in counts) == 32
         sim = p.simulate(None)
         assert sim["end_time"] == pytest.approx(
