@@ -64,3 +64,90 @@ def swiglu(x, use_pallas: bool = True):
     f = x.shape[-1] // 2
     gate, val = x[..., :f], x[..., f:]
     return jax.nn.silu(gate) * val
+
+
+# -- flash attention ---------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+                      sm_scale, causal):
+    """Online-softmax flash attention forward for one (batch*head,
+    q-block) grid cell. K/V live fully in VMEM (sized for the
+    seq-lengths jaxref uses); the m/l accumulators run in fp32."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    skv = k_ref.shape[1]
+    nkb = skv // block_k
+    d = q.shape[-1]
+
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # [block_q, block_k]
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, -1))
+        # fully-masked rows keep m=-inf; avoid nan from exp(-inf - -inf)
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(jnp.where(jnp.isneginf(s), -jnp.inf, s - safe_m[:, None]))
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l = l * corr + jnp.sum(p, -1)
+        acc = acc * corr[:, None] + p @ v
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def pallas_flash_attention(
+    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
+    interpret: bool = False,
+):
+    """Flash-attention forward: q,k,v [b, s, h, d] -> o [b, s, h, d]
+    (MHA: kv head count must equal q head count; broadcast GQA upstream).
+
+    Forward-only (no custom VJP yet — jax.grad through it raises; the
+    backward kernel is a round-2 item, TODO_ROUND2.md #5). Intended for
+    inference paths and sdp_fwd calibration.
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    assert k.shape[2] == h, "broadcast GQA kv heads before the kernel"
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    assert sq % block_q == 0 and skv % block_k == 0
+    sm_scale = 1.0 / (d ** 0.5)
+
+    # [b, s, h, d] -> [b*h, s, d]
+    def to_bh(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    qb, kb, vb = to_bh(q, sq), to_bh(k, skv), to_bh(v, skv)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+            sm_scale=sm_scale, causal=causal,
+        ),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -297,3 +297,47 @@ class TestEPDispatch:
         loss = run_pp_dryrun(8, pp=2, tp=2, ep=2, backend="cpu",
                              ep_dispatch="a2a")
         assert 0 < loss < 20
+
+
+class TestFlashAttention:
+    def _rand(self, b, s, h, d, dtype=jnp.float32, seed=0):
+        rs = np.random.RandomState(seed)
+        return (
+            jnp.array(rs.randn(b, s, h, d), dtype),
+            jnp.array(rs.randn(b, s, h, d), dtype),
+            jnp.array(rs.randn(b, s, h, d), dtype),
+        )
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        from simumax_tpu.jaxref.kernels import pallas_flash_attention
+
+        q, k, v = self._rand(2, 256, 4, 64)
+        got = pallas_flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+        assert float(jnp.max(jnp.abs(got - ref))) < 1e-5
+
+    def test_bf16(self):
+        from simumax_tpu.jaxref.kernels import pallas_flash_attention
+
+        q, k, v = self._rand(1, 128, 2, 64, jnp.bfloat16)
+        got = pallas_flash_attention(q, k, v, interpret=True)
+        ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        err = jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)))
+        assert float(err) < 0.05  # bf16 ulps
+
+    def test_multiple_kv_blocks(self):
+        from simumax_tpu.jaxref.kernels import pallas_flash_attention
+
+        q, k, v = self._rand(1, 512, 2, 32)
+        got = pallas_flash_attention(q, k, v, causal=True, block_q=128,
+                                     block_k=64, interpret=True)
+        ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        assert float(jnp.max(jnp.abs(got - ref))) < 1e-5
+
+    def test_small_seq_clamps_blocks(self):
+        from simumax_tpu.jaxref.kernels import pallas_flash_attention
+
+        q, k, v = self._rand(1, 64, 2, 32)
+        got = pallas_flash_attention(q, k, v, interpret=True)
+        assert got.shape == (1, 64, 2, 32)
