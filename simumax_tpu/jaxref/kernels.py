@@ -78,6 +78,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
     skv = k_ref.shape[1]
     nkb = skv // block_k
+    if causal:
+        # standard flash block-skip: blocks fully past the diagonal of
+        # this q block contribute nothing
+        nkb_dyn = jnp.minimum(
+            ((qi + 1) * block_q + block_k - 1) // block_k, nkb
+        )
+    else:
+        nkb_dyn = nkb
     d = q.shape[-1]
 
     m = jnp.full((block_q,), -jnp.inf, jnp.float32)
@@ -99,15 +107,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
             )
             s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, -1))
-        # fully-masked rows keep m=-inf; avoid nan from exp(-inf - -inf)
+        # fully-masked rows keep m=-inf; use a finite max so exp() of
+        # (-inf - finite) underflows to 0 instead of producing nan
         safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(jnp.where(jnp.isneginf(s), -jnp.inf, s - safe_m[:, None]))
+        p = jnp.exp(s - safe_m[:, None])
         corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
         l = l * corr + jnp.sum(p, -1)
         acc = acc * corr[:, None] + p @ v
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(0, nkb_dyn, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
@@ -126,8 +135,11 @@ def pallas_flash_attention(
     skv = k.shape[1]
     assert k.shape[2] == h, "broadcast GQA kv heads before the kernel"
     block_q = min(block_q, sq)
+    while sq % block_q:
+        block_q -= 1
     block_k = min(block_k, skv)
-    assert sq % block_q == 0 and skv % block_k == 0
+    while skv % block_k:
+        block_k -= 1
     sm_scale = 1.0 / (d ** 0.5)
 
     # [b, s, h, d] -> [b*h, s, d]
