@@ -18,6 +18,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
+def _fit_block(size: int, block: int) -> int:
+    """Largest block <= ``block`` that divides ``size``."""
+    block = min(block, size)
+    while size % block:
+        block -= 1
+    return block
+
+
 def _swiglu_kernel(x_ref, o_ref):
     x = x_ref[...]
     f = x.shape[-1] // 2
@@ -42,9 +50,7 @@ def pallas_swiglu(x, block_rows: int = 256, interpret: bool = False):
     for d in orig_shape[:-1]:
         rows *= d
     x2 = x.reshape(rows, f2)
-    block = min(block_rows, rows)
-    while rows % block:
-        block -= 1
+    block = _fit_block(rows, block_rows)
     out = pl.pallas_call(
         _swiglu_kernel,
         grid=(rows // block,),
@@ -69,8 +75,8 @@ def swiglu(x, use_pallas: bool = True):
 # -- flash attention ---------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
-                      sm_scale, causal):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
+                      block_k, sm_scale, causal):
     """Online-softmax flash attention forward for one (batch*head,
     q-block) grid cell. K/V live fully in VMEM (sized for the
     seq-lengths jaxref uses); the m/l accumulators run in fp32."""
@@ -118,28 +124,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
 
     m, l, acc = jax.lax.fori_loop(0, nkb_dyn, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
+    lse_ref[0] = safe_m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def pallas_flash_attention(
     q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-    interpret: bool = False,
+    interpret: bool = False, return_lse: bool = False,
 ):
     """Flash-attention forward: q,k,v [b, s, h, d] -> o [b, s, h, d]
     (MHA: kv head count must equal q head count; broadcast GQA upstream).
 
-    Forward-only (no custom VJP yet — jax.grad through it raises; the
-    backward kernel is a round-2 item, TODO_ROUND2.md #5). Intended for
-    inference paths and sdp_fwd calibration.
+    Differentiable via :func:`flash_attention` (custom VJP with Pallas
+    dq/dkv backward kernels); this raw entry point is fwd-only.
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
     assert k.shape[2] == h, "broadcast GQA kv heads before the kernel"
-    block_q = min(block_q, sq)
-    while sq % block_q:
-        block_q -= 1
-    block_k = min(block_k, skv)
-    while skv % block_k:
-        block_k -= 1
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(skv, block_k)
     sm_scale = 1.0 / (d ** 0.5)
 
     # [b, s, h, d] -> [b*h, s, d]
@@ -158,8 +161,187 @@ def pallas_flash_attention(
             pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+    o = out[0].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return o, out[1].reshape(b, h, sq)
+    return o
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q, block_k, sm_scale, causal):
+    """dq for one (batch*head, q-block) cell: stream kv blocks, rebuild
+    p from the saved lse, accumulate ds @ k."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [block_q]
+    delta = delta_ref[0]  # [block_q] = rowsum(do * o)
+    skv = k_ref.shape[1]
+    nkb = skv // block_k
+    if causal:
+        nkb_dyn = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k,
+                              nkb)
+    else:
+        nkb_dyn = nkb
+    d = q.shape[-1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(i, dq):
+        k = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T
+        if causal:
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        ds = p * (do @ v.T - delta[:, None])
+        return dq + ds @ k
+
+    dq = jax.lax.fori_loop(
+        0, nkb_dyn, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, block_k, sm_scale,
+                          causal):
+    """dk/dv for one (batch*head, kv-block) cell: stream q blocks."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    sq = q_ref.shape[1]
+    nqb = sq // block_q
+    d = k.shape[-1]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    # causal: q blocks before this kv block's diagonal contribute nothing
+    start_qb = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = (q * sm_scale) @ k.T
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + p.T @ do
+        ds = p * (do @ v.T - delta[:, None])
+        dk = dk + (ds.T @ q) * sm_scale
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        start_qb, nqb, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(skv, block_k)
+    sm_scale = 1.0 / (d ** 0.5)
+
+    def to_bh(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    qb, kb, vb = to_bh(q, sq), to_bh(k, skv), to_bh(v, skv)
+    dob = to_bh(do, sq)
+    lseb = lse.reshape(b * h, sq)
+    delta = jnp.sum(dob.astype(jnp.float32)
+                    * to_bh(o, sq).astype(jnp.float32), -1)
+
+    common = dict(block_q=block_q, block_k=block_k, sm_scale=sm_scale,
+                  causal=causal)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(qb, kb, vb, dob, lseb, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(b * h, skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, sq), lambda bh, ki: (bh, 0)),
+            pl.BlockSpec((1, sq), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lseb, delta)
+
+    def from_bh(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, sq), from_bh(dk, skv), from_bh(dv, skv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                    interpret=False):
+    """Differentiable flash attention (Pallas fwd + dq/dkv bwd kernels).
+    q,k,v [b, s, h, d]; MHA layout (broadcast GQA upstream)."""
+    return pallas_flash_attention(q, k, v, causal=causal, block_q=block_q,
+                                  block_k=block_k, interpret=interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = pallas_flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, return_lse=True,
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, causal, block_q, block_k,
+                      interpret)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
