@@ -345,3 +345,17 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def attention(q, k, v, causal: bool = True, use_pallas=None):
+    """Attention with backend dispatch: the differentiable Pallas flash
+    kernel on TPU (MHA layout — broadcast GQA kv heads upstream), XLA's
+    fused attention elsewhere. Callers running under an explicit device
+    mesh must pass ``use_pallas`` resolved from the mesh's platform —
+    the process default backend can differ from the mesh (e.g. a CPU
+    mesh on a TPU host)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and k.shape[2] == q.shape[2]:
+        return flash_attention(q, k, v, causal)
+    return jax.nn.dot_product_attention(q, k, v, is_causal=causal)

@@ -50,6 +50,9 @@ class PPConfig:
     #: "a2a": capacity-based all_to_all token dispatch/combine (the
     #: layout the analytical Permutation/UnPermutation ops cost)
     ep_dispatch: str = "psum"
+    #: resolved from the mesh platform by make_pp_train_step (pallas
+    #: kernels require real TPU devices, not the process default)
+    use_flash: bool = False
 
     def __post_init__(self):
         assert self.ep_dispatch in ("psum", "a2a"), self.ep_dispatch
@@ -139,11 +142,14 @@ def _stage_block(x, p, li, cfg: PPConfig, is_moe: bool):
     qq, kk, vv = jnp.split(qkv, 3, axis=-1)
     b, s, qloc = qq.shape
     hl = qloc // d
-    o = jax.nn.dot_product_attention(
+    from simumax_tpu.jaxref.kernels import attention
+
+    o = attention(
         qq.reshape(b, s, hl, d),
         kk.reshape(b, s, hl, d),
         vv.reshape(b, s, hl, d),
-        is_causal=True,
+        causal=True,
+        use_pallas=cfg.use_flash,
     )
     o = o.reshape(b, s, qloc) @ p["attn_out"][li]  # partial sums over tp
     o = jax.lax.psum_scatter(o, "tp", scatter_dimension=1, tiled=True)
@@ -283,6 +289,9 @@ def make_pp_train_step(cfg: PPConfig, mesh: Mesh, lr: float = 1e-3):
     back through the reverse ppermutes automatically."""
     pp = mesh.shape["pp"]
     tp = mesh.shape["tp"]
+    # pallas only where the mesh actually runs on TPU devices
+    platform = next(iter(mesh.devices.flat)).platform
+    cfg = dataclasses.replace(cfg, use_flash=(platform == "tpu"))
 
     def spmd_loss(params, ids, targets):
         tp_i = jax.lax.axis_index("tp")
