@@ -751,6 +751,11 @@ class PerfLLM(PerfBase):
             if self.ctx.debug.enabled and self.ctx.debug.rows:
                 with open(os.path.join(save_path, "cost_log.json"), "w") as f:
                     json.dump(self.ctx.debug.rows, f, indent=1)
+            # annotated module tree (reference model_arch dump)
+            with open(os.path.join(save_path, "model_arch.txt"), "w") as f:
+                for (stage, chunk_idx), chunk in sorted(self.chunks.items()):
+                    f.write(f"===== stage {stage} chunk {chunk_idx} =====\n")
+                    f.write(repr(chunk) + "\n")
         return result
 
     def _print_summary(self, result: dict):

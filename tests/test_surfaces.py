@@ -176,3 +176,18 @@ class TestDebugAndPlot:
         r = p.simulate(str(tmp_path / "sim"))
         assert os.path.exists(r["memory_plot"])
         assert os.path.getsize(r["memory_plot"]) > 10000
+
+
+class TestModelArch:
+    def test_repr_and_arch_dump(self, tmp_path):
+        p = PerfLLM().configure(
+            "tp1_pp2_dp4_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        p.run_estimate()
+        r = repr(p.chunks[(0, 0)])
+        assert "LLMModel" in r and "CoreAttention" in r
+        assert "fwd=" in r and "cache=" in r
+        p.analysis(save_path=str(tmp_path), verbose=False)
+        txt = open(tmp_path / "model_arch.txt").read()
+        assert "stage 0" in txt and "stage 1" in txt
+        assert "parallel_ce" in txt  # postprocess only on the last stage
