@@ -395,7 +395,11 @@ class StrategyConfig(ConfigBase):
     account_for_embedding_in_pipeline_split: bool = False
     account_for_loss_in_pipeline_split: bool = False
 
-    zero_state: int = 1  # 0 or 1 (2/3 collapse to 1 with a warning)
+    #: 0: replicated grads+state; 1: ZeRO-1 (state sharded); 2: +grads
+    #: sharded (per-microbatch reduce-scatter); 3: FSDP (params sharded,
+    #: per-layer all-gathers). The reference clamps 2/3 to 1; modeled
+    #: fully here — FSDP is the dominant TPU/JAX pattern.
+    zero_state: int = 1
     enable_dropout: bool = False
     use_fused_norm: bool = True
     use_math_sdp: bool = False
@@ -437,8 +441,7 @@ class StrategyConfig(ConfigBase):
                 "sdp_recompute": self.sdp_recompute,
             }
         )
-        if self.zero_state >= 2:
-            self.zero_state = 1  # reference warns + clamps (config.py:684-687)
+
 
     # -- derived sizes (reference ``config.py:352-368``) -------------------
     @property
@@ -524,7 +527,7 @@ class StrategyConfig(ConfigBase):
         assert self.etp_size <= self.tp_size, "etp must divide tp"
         assert self.tp_size % self.etp_size == 0
         assert self.dtype in DTYPE_BYTES
-        assert self.zero_state in (0, 1)
+        assert self.zero_state in (0, 1, 2, 3)
         assert self.cp_comm_type in ("a2a", "all_gather")
         assert self.cp_a2a_mode in ("sync_cp", "async_cp")
         assert self.moe_dispatcher_policy in ("all2all",)

@@ -168,17 +168,19 @@ class MetaModule:
         children (e.g. bound async-CP a2a hiding by the attention
         compute)."""
 
-    def expose_unhidden(self, leaves, phase: str, budget: float):
+    def expose_unhidden(self, leaves, phase: str, budget: float,
+                        dims=None):
         """Move the portion of the given leaves' hidden collective time
         that exceeds ``budget`` back onto the critical path,
-        proportionally per call. Keeps the leaf CostInfo and the
-        CollectiveCall exposed_time consistent (the simulator replays
-        the same numbers)."""
+        proportionally per call (optionally only calls on ``dims``).
+        Keeps the leaf CostInfo and the CollectiveCall exposed_time
+        consistent (the simulator replays the same numbers)."""
         calls = [
             c
             for l in leaves
             for c in l.collective_calls
             if c.phase == phase and c.time > c.exposed_time
+            and (dims is None or c.dim in dims)
         ]
         hidden = sum(c.time - c.exposed_time for c in calls)
         extra = max(0.0, hidden - budget)
@@ -186,7 +188,8 @@ class MetaModule:
             return
         for l in leaves:
             for c in l.collective_calls:
-                if c.phase != phase or c.time <= c.exposed_time:
+                if (c.phase != phase or c.time <= c.exposed_time
+                        or (dims is not None and c.dim not in dims)):
                     continue
                 share = extra * (c.time - c.exposed_time) / hidden
                 c.exposed_time += share
@@ -195,6 +198,16 @@ class MetaModule:
                 # a recomputed leaf replays its fwd (incl. exposed comm)
                 if phase == "fwd" and l.in_recompute:
                     l.cost_info.recompute_time += share
+
+    def reaggregate(self):
+        """Recompute composite sums bottom-up after a _post_forward hook
+        mutated descendant leaf infos (e.g. overlap re-exposure)."""
+        if self.is_leaf:
+            return
+        for c in self.children():
+            if c._called:
+                c.reaggregate()
+        self._aggregate()
 
     def _aggregate(self):
         kids = [c for c in self.children() if c._called]
@@ -251,6 +264,10 @@ class MetaModule:
         shard = st.edp_size if is_moe else st.dp_size * st.cp_size
         if st.zero_state >= 1:
             state = state / max(1, shard)
+        if st.zero_state >= 2:  # grads live sharded between uses
+            g = g / max(1, shard)
+        if st.zero_state >= 3:  # FSDP: parameters sharded too
+            w = w / max(1, shard)
         if is_moe:
             return ParamInfo(
                 moe_weight_bytes=w, moe_grad_bytes=g, moe_state_bytes=state,

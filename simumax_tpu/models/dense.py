@@ -105,18 +105,23 @@ class Embedding(LeafModule):
         return {"fwd": 2 * full, "bwd_w": 2 * full + self.inputs[0].bytes}
 
     def activation_info(self) -> ActivationInfo:
-        return ActivationInfo(cache_bytes=self.inputs[0].numel() * 4)  # ids
+        fsdp = _fsdp_temp(self, self.numel)
+        return ActivationInfo(
+            cache_bytes=self.inputs[0].numel() * 4,  # ids
+            fwd_temp_bytes=fsdp,
+            bwd_temp_bytes=fsdp + _zero_grad_temp(self, self.numel),
+        )
 
     def extra_param_info(self):
         return self.make_param_info(self.numel)
 
     def collectives(self) -> List[CollectiveCall]:
         st = _st(self.ctx)
+        calls = _fsdp_calls(self, self.numel)
         if st.tp_size == 1:
-            return []
+            return calls
         out = self.outputs[0]
         full = out.bytes * (st.tp_size if st.enable_sequence_parallel else 1)
-        calls = []
         if st.enable_sequence_parallel:
             calls.append(CollectiveCall("fwd", "reduce_scatter", "tp", full, "post"))
             calls.append(CollectiveCall("bwd_w", "all_gather", "tp", full, "pre"))
@@ -166,6 +171,54 @@ class LayerNorm(LeafModule):
 # --------------------------------------------------------------------------
 # Linear layers
 # --------------------------------------------------------------------------
+
+
+def _fsdp_calls(leaf, numel, is_moe=False):
+    """ZeRO-3/FSDP per-layer weight collectives: all-gather the shard
+    before fwd and again before the wgrad, reduce-scatter the grads
+    right after bwd (per microbatch)."""
+    st = leaf.ctx.strategy
+    if st.zero_state < 3 or numel <= 0:
+        return []
+    dim = "edp" if is_moe else "dp_cp"
+    group = st.edp_size if is_moe else st.dp_size * st.cp_size
+    if group <= 1:
+        return []
+    w_bytes = numel * st.element_size
+    g_bytes = numel * st.grad_element_size
+    # FSDP prefetches gathers under compute; the excess beyond the
+    # block's compute budget is re-exposed by LLMBlock._post_forward
+    return [
+        CollectiveCall("fwd", "all_gather", dim, w_bytes, "pre",
+                       exposed=False),
+        CollectiveCall("bwd_act", "all_gather", dim, w_bytes, "pre",
+                       exposed=False),
+        CollectiveCall("bwd_w", "reduce_scatter", dim, g_bytes, "post",
+                       exposed=False),
+    ]
+
+
+def _fsdp_temp(leaf, numel, is_moe=False):
+    """Transient full (gathered) weight bytes while the op runs."""
+    st = leaf.ctx.strategy
+    if st.zero_state < 3 or numel <= 0:
+        return 0.0
+    group = st.edp_size if is_moe else st.dp_size * st.cp_size
+    if group <= 1:
+        return 0.0
+    return numel * st.element_size * (1 - 1 / group)
+
+
+def _zero_grad_temp(leaf, numel, is_moe=False):
+    """ZeRO>=2: the full-size layer gradient exists between the wgrad
+    and its reduce-scatter; only the shard survives."""
+    st = leaf.ctx.strategy
+    if st.zero_state < 2 or numel <= 0:
+        return 0.0
+    group = st.edp_size if is_moe else st.dp_size * st.cp_size
+    if group <= 1:
+        return 0.0
+    return numel * st.grad_element_size * (1 - 1 / group)
 
 
 class LinearCol(GemmBase):
@@ -235,8 +288,13 @@ class LinearCol(GemmBase):
         temp = 0.0
         if st.enable_sequence_parallel and st.tp_size > 1 and not self.skip_comm:
             temp = cached * st.tp_size  # gathered copy live during compute
-        return ActivationInfo(cache_bytes=cached, fwd_temp_bytes=temp,
-                              bwd_temp_bytes=temp)
+        n = self.numel if self.count_params else 0
+        fsdp = _fsdp_temp(self, n)
+        return ActivationInfo(
+            cache_bytes=cached,
+            fwd_temp_bytes=temp + fsdp,
+            bwd_temp_bytes=temp + fsdp + _zero_grad_temp(self, n),
+        )
 
     def extra_param_info(self):
         if not self.count_params:
@@ -245,17 +303,20 @@ class LinearCol(GemmBase):
 
     def collectives(self) -> List[CollectiveCall]:
         st = _st(self.ctx)
+        calls = _fsdp_calls(self, self.numel if self.count_params else 0)
         if st.tp_size == 1 or self.skip_comm:
-            return []
+            return calls
         _, m, k, _ = self.gemm_mnk("fwd")
         full_in = m * k * st.element_size
         if st.enable_sequence_parallel:
-            return [
+            return calls + [
                 CollectiveCall("fwd", "all_gather", "tp", full_in, "pre"),
                 CollectiveCall("bwd_act", "reduce_scatter", "tp", full_in, "post"),
                 CollectiveCall("bwd_w", "all_gather", "tp", full_in, "pre"),
             ]
-        return [CollectiveCall("bwd_act", "all_reduce", "tp", full_in, "post")]
+        return calls + [
+            CollectiveCall("bwd_act", "all_reduce", "tp", full_in, "post")
+        ]
 
 
 class LinearRow(GemmBase):
@@ -309,23 +370,31 @@ class LinearRow(GemmBase):
         }
 
     def activation_info(self) -> ActivationInfo:
-        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+        fsdp = _fsdp_temp(self, self.numel)
+        return ActivationInfo(
+            cache_bytes=self.inputs[0].bytes,
+            fwd_temp_bytes=fsdp,
+            bwd_temp_bytes=fsdp + _zero_grad_temp(self, self.numel),
+        )
 
     def extra_param_info(self):
         return self.make_param_info(self.numel)
 
     def collectives(self) -> List[CollectiveCall]:
         st = _st(self.ctx)
+        calls = _fsdp_calls(self, self.numel)
         if st.tp_size == 1 or self.skip_comm:
-            return []
+            return calls
         _, m, _, n = self.gemm_mnk("fwd")
         full_out = m * n * st.element_size
         if st.enable_sequence_parallel:
-            return [
+            return calls + [
                 CollectiveCall("fwd", "reduce_scatter", "tp", full_out, "post"),
                 CollectiveCall("bwd_act", "all_gather", "tp", full_out, "pre"),
             ]
-        return [CollectiveCall("fwd", "all_reduce", "tp", full_out, "post")]
+        return calls + [
+            CollectiveCall("fwd", "all_reduce", "tp", full_out, "post")
+        ]
 
 
 # --------------------------------------------------------------------------

@@ -96,6 +96,32 @@ class LLMBlock(MetaModule):
         if rc.mlp_norm_recompute:
             self.pre_mlp_norm.mark_recompute()
 
+    def _post_forward(self):
+        st = self.ctx.strategy
+        if st.zero_state >= 3:
+            # FSDP gathers/reduce-scatters hide under the block's own
+            # compute; only the excess lands on the critical path. The
+            # compute already granted to async-CP a2a hiding is not
+            # available twice.
+            leaves = self.called_leaves()
+            for phase in ("fwd", "bwd_act", "bwd_w"):
+                compute = sum(
+                    l.cost_info.compute.get(phase) for l in leaves
+                )
+                cp_hidden = sum(
+                    c.time - c.exposed_time
+                    for l in leaves
+                    for c in l.collective_calls
+                    if c.dim == "cp" and c.phase == phase
+                )
+                budget = max(compute - cp_hidden, 0.0)
+                self.expose_unhidden(leaves, phase, budget,
+                                     dims=("dp_cp", "edp"))
+            # leaf mutations must propagate through the intermediate
+            # composites (attention/mlp) before this block aggregates
+            for c in self.children():
+                c.reaggregate()
+
     def forward(self, x: TensorSpec) -> TensorSpec:
         h = self.input_norm(x)
         h = self.attention(h)

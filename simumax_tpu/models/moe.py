@@ -23,7 +23,15 @@ from typing import Dict, List
 from simumax_tpu.core.module import BuildContext, GemmBase, LeafModule, MetaModule
 from simumax_tpu.core.records import ActivationInfo, CollectiveCall
 from simumax_tpu.core.tensor import TensorSpec
-from simumax_tpu.models.dense import MLP, AddFunction, Swiglu, _st
+from simumax_tpu.models.dense import (
+    MLP,
+    AddFunction,
+    Swiglu,
+    _fsdp_calls,
+    _fsdp_temp,
+    _zero_grad_temp,
+    _st,
+)
 
 
 def _tokens_post_dispatch(ctx: BuildContext, t0: int) -> int:
@@ -217,10 +225,19 @@ class GroupLinearBase(GemmBase):
         }
 
     def activation_info(self) -> ActivationInfo:
-        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+        fsdp = _fsdp_temp(self, self.numel, is_moe=True)
+        return ActivationInfo(
+            cache_bytes=self.inputs[0].bytes,
+            fwd_temp_bytes=fsdp,
+            bwd_temp_bytes=fsdp + _zero_grad_temp(self, self.numel,
+                                                  is_moe=True),
+        )
 
     def extra_param_info(self):
         return self.make_param_info(self.numel, is_moe=True)
+
+    def collectives(self) -> List[CollectiveCall]:
+        return _fsdp_calls(self, self.numel, is_moe=True)
 
 
 class GroupLinearCol(GroupLinearBase):

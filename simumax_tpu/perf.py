@@ -563,10 +563,16 @@ class PerfLLM(PerfBase):
         p_el = st.element_size
         t = 0.0
         detail = {}
-        if st.dp_size * st.cp_size > 1 and dense_numel:
+        if st.dp_size * st.cp_size > 1 and dense_numel and st.zero_state < 3:
+            # ZeRO-3 grads reduce-scatter per layer inside the backward
+            # (leaf collectives) and params gather per layer in the next
+            # forward — no step-end bulk comm for dense params
             path = self.ctx.path("dp_cp")
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
             rs = sysc.compute_net_op_time(op, dense_numel * g_el, path)
+            if st.zero_state == 2:
+                # grads live sharded: reduce-scatter each microbatch
+                rs *= st.micro_batch_num
             ag = (
                 sysc.compute_net_op_time("all_gather", dense_numel * p_el, path)
                 if st.zero_state >= 1
@@ -590,10 +596,12 @@ class PerfLLM(PerfBase):
             )
             detail["tied_embedding_grad_ar_time"] = t_tied
             t += t_tied
-        if st.edp_size > 1 and moe_numel:
+        if st.edp_size > 1 and moe_numel and st.zero_state < 3:
             path = self.ctx.path("edp")
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
             rs = sysc.compute_net_op_time(op, moe_numel * g_el, path)
+            if st.zero_state == 2:
+                rs *= st.micro_batch_num
             ag = (
                 sysc.compute_net_op_time("all_gather", moe_numel * p_el, path)
                 if st.zero_state >= 1

@@ -310,3 +310,68 @@ class TestQuantDtypeGuard:
         assert sim["end_time"] == pytest.approx(
             p.analysis_cost()["iter_time"], rel=0.01
         )
+
+
+class TestZero23:
+    """ZeRO-2/3 (FSDP) — modeled fully (the reference clamps to 1)."""
+
+    def _run(self, zero, rc=False, mbc=2):
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st.world_size = 64
+        st.zero_state = zero
+        st.micro_batch_num = mbc
+        if rc:
+            st.enable_recompute = True
+            st.recompute_granularity = "full_block"
+        st.__post_init__()
+        return run(st)
+
+    def test_memory_scales_down_with_zero_level(self):
+        peaks = {}
+        for zero in (1, 2, 3):
+            peaks[zero] = self._run(zero).analysis_mem()["max_peak_bytes"]
+        assert peaks[3] < peaks[2] < peaks[1]
+
+    def test_zero3_shards_weights_and_grads(self):
+        p = self._run(3)
+        s0 = p.analysis_mem()["stages"][0]
+        n = p.model_config.param_numel()
+        assert s0["weight_bytes"] == pytest.approx(n * 2 / 64, rel=1e-6)
+        assert s0["grad_bytes"] == pytest.approx(n * 4 / 64, rel=1e-6)
+
+    def test_zero3_emits_fsdp_collectives(self):
+        p = self._run(3)
+        chunk = p.chunks[(0, 0)]
+        ag = [
+            c for c in chunk.collective_calls
+            if c.dim == "dp_cp" and c.op == "all_gather"
+        ]
+        rs = [
+            c for c in chunk.collective_calls
+            if c.dim == "dp_cp" and c.op == "reduce_scatter"
+        ]
+        assert ag and rs  # per-layer gathers + grad reduce-scatters
+
+    def test_zero3_gathers_overlap_under_compute(self):
+        """Big per-layer compute: the FSDP comm should be mostly
+        hidden, costing far less than fully-exposed gathers."""
+        p = self._run(3)
+        chunk = p.chunks[(0, 0)]
+        hidden = chunk.cost_info.net_hidden.total
+        exposed = chunk.cost_info.net_exposed.total
+        assert hidden > exposed  # most of it overlapped
+
+    @pytest.mark.parametrize("zero,rc", [(2, False), (3, False), (3, True)])
+    def test_sim_agreement(self, zero, rc):
+        p = self._run(zero, rc)
+        c = p.analysis_cost()
+        r = p.simulate(None)
+        assert r["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
+
+    def test_fsdp_fits_8b_on_16gib_chips(self):
+        """The FSDP headline: llama3-8B trains on v5e (16 GiB) with
+        pure data parallelism + recompute."""
+        p = self._run(3, rc=True)
+        m = p.analysis_mem()
+        assert m["fits"] and m["max_peak_gib"] < 8
+        assert p.analysis_cost()["mfu"] > 0.35
