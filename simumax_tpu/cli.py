@@ -63,16 +63,24 @@ def cmd_search(args):
         base.world_size = args.world
     if args.seq_len:
         base.seq_len = args.seq_len
+    zero_list = _ints(args.zero)
+    bad = [z for z in zero_list if z not in (0, 1, 2, 3)]
+    if bad:
+        raise SystemExit(
+            f"invalid --zero levels {bad}: expected a comma list of "
+            "0-3 (e.g. --zero 1,3)"
+        )
     rows = search_best_parallel_strategy(
         base, model, system, args.gbs,
         tp_list=_ints(args.tp), pp_list=_ints(args.pp),
         ep_list=_ints(args.ep), cp_list=_ints(args.cp),
+        zero_list=zero_list,
         topk=args.topk, csv_path=args.csv, verbose=args.verbose,
     )
     for r in rows:
         print(
             f"tp{r['tp']} cp{r['cp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
-            f"mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
+            f"z{r['zero']} mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
             f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
             f"peak {r['peak_gib']:.1f} GiB"
             + (f"  [DCN: {r['dcn_dims']}]" if r.get("dcn_dims") else "")
@@ -181,6 +189,7 @@ def main(argv=None):
     ps.add_argument("--pp", default="1,2,4")
     ps.add_argument("--ep", default="1")
     ps.add_argument("--cp", default="1")
+    ps.add_argument("--zero", default="1", help="zero_state levels, e.g. 1,3")
     ps.add_argument("--topk", type=int, default=5)
     ps.add_argument("--csv")
     ps.add_argument("--verbose", action="store_true")

@@ -93,6 +93,7 @@ def evaluate_strategy(
             "vp": strategy.vp_size,
             "mbs": strategy.micro_batch_size,
             "mbc": strategy.micro_batch_num,
+            "zero": strategy.zero_state,
             "recompute": (
                 strategy.recompute.granularity
                 if strategy.recompute.enabled
@@ -235,6 +236,7 @@ def search_best_parallel_strategy(
     pp_list: Sequence[int] = (1, 2, 4),
     ep_list: Sequence[int] = (1,),
     cp_list: Sequence[int] = (1,),
+    zero_list: Sequence[int] = (1,),
     recompute_types: Sequence[str] = ("none", "selective", "full_block"),
     topk: int = 5,
     csv_path: Optional[str] = None,
@@ -248,7 +250,9 @@ def search_best_parallel_strategy(
     cache = {} if cache is None else cache
     rows: List[dict] = []
     world = base_strategy.world_size
-    for tp, cp, ep, pp in itertools.product(tp_list, cp_list, ep_list, pp_list):
+    for tp, cp, ep, pp, zero in itertools.product(
+        tp_list, cp_list, ep_list, pp_list, zero_list
+    ):
         if world % (tp * cp * pp) or world % (ep * pp):
             continue
         if model.model_type != "moe" and ep > 1:
@@ -256,6 +260,11 @@ def search_best_parallel_strategy(
         st = copy.deepcopy(base_strategy)
         st.tp_size, st.cp_size = tp, cp
         st.ep_size, st.pp_size = ep, pp
+        st.zero_state = zero
+        # ZeRO has no effect without data-parallel replicas; keep one
+        # representative level to avoid duplicate candidates
+        if zero > min(zero_list) and st.dp_size * st.cp_size == 1:
+            continue
         st.etp_size = min(st.etp_size, tp) or 1
         if st.dp_size < 1 or global_batch_size % st.dp_size:
             continue
@@ -311,8 +320,8 @@ def search_best_parallel_strategy(
     uniq = []
     for r in rows:
         rl = r["recompute_layers"] if r["recompute"] != "none" else 0
-        key = (r["tp"], r["cp"], r["ep"], r["pp"], r["vp"], r["mbs"],
-               r["mbc"], r["recompute"], rl)
+        key = (r["tp"], r["cp"], r["ep"], r["pp"], r["vp"], r["zero"],
+               r["mbs"], r["mbc"], r["recompute"], rl)
         if key in seen:
             continue
         seen.add(key)

@@ -37,6 +37,8 @@ CASES = {
     "llama3-8b__tp2_dropout__tpu_v5e_256": (
         "tp2_pp1_dp4_mbs1", "llama3-8b", "tpu_v5e_256", None,
         dict(enable_dropout=True)),
+    "llama3-8b__fsdp_dp64_recompute__tpu_v5e_256": (
+        "fsdp_dp64_recompute", "llama3-8b", "tpu_v5e_256", None),
 }
 
 

@@ -111,3 +111,23 @@ class TestSearches:
         s = StrategySearcher(m, sysc, st)
         rows = s.search(global_batch_size=16, tp_list=(1, 2), pp_list=(1,), topk=2)
         assert len(rows) <= 2 and rows[0]["mfu"] >= rows[-1]["mfu"]
+
+
+class TestZeroSweep:
+    def test_fsdp_unlocks_small_chips(self):
+        """On 16 GiB chips nothing fits llama3-8b at zero1 pure-dp; the
+        zero sweep must surface feasible zero3 layouts."""
+        m = get_model_config("llama3-8b")
+        sysc = get_system_config("tpu_v5e_256")
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st.world_size = 64
+        rows1 = search_best_parallel_strategy(
+            st, m, sysc, global_batch_size=128, tp_list=(1,),
+            pp_list=(1,), zero_list=(1,), topk=3,
+        )
+        rows3 = search_best_parallel_strategy(
+            st, m, sysc, global_batch_size=128, tp_list=(1,),
+            pp_list=(1,), zero_list=(1, 3), topk=3,
+        )
+        assert not rows1  # zero1 pure-dp cannot fit 8B on 16 GiB
+        assert rows3 and all(r["zero"] == 3 for r in rows3)
