@@ -48,7 +48,7 @@ def sample_strategy(rng, model):
             mlp_recompute=rng.random() < 0.5,
             fp8=rng.random() < 0.3,
             enable_dropout=rng.random() < 0.3,
-            zero_state=rng.choice([0, 1]),
+            zero_state=rng.choice([0, 1, 2, 3]),
             use_fused_ce=rng.random() < 0.5,
             optimizer_style=rng.choice(["megatron", "functional"]),
         )

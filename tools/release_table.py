@@ -84,6 +84,17 @@ def build_small_cases(system):
         cp_size=4, seq_len=32768, micro_batch_num=4,
         enable_recompute=True, recompute_granularity="full_block",
     ))
+    # FSDP rows: full models on small chips via ZeRO-3
+    cases.append(run_case(
+        "llama3_8b_full_fsdp_dp64_rc", "llama3-8b", 0,
+        "fsdp_dp64_recompute", system,
+    ))
+    cases.append(run_case(
+        "mixtral8x7b_full_fsdp_ep8_rc", "mixtral-8x7b", 0,
+        "ep8_pp1_dp8_mbs1", system, world_size=64, zero_state=3,
+        micro_batch_num=2, enable_recompute=True,
+        recompute_granularity="full_block",
+    ))
     return cases
 
 
