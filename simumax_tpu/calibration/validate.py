@@ -67,3 +67,39 @@ def validate_memory(perf, layer_num: Optional[int] = None) -> Dict[str, float]:
         "predicted_peak_bytes": predicted,
         "ratio": predicted / xla_peak if xla_peak else float("nan"),
     }
+
+
+def hlo_collective_bytes(compiled_text: str) -> Dict[str, float]:
+    """Sum the result-shape bytes of each collective op family in a
+    compiled HLO module text (``compiled.as_text()``) — a hardware-free
+    anchor for the analytical collective-volume accounting."""
+    import re
+
+    dt_bytes = {"bf16": 2, "f16": 2, "f32": 4, "f64": 8, "s32": 4,
+                "u32": 4, "s64": 8, "u64": 8, "s16": 2, "u16": 2,
+                "f8e4m3fn": 1, "f8e5m2": 1, "s8": 1, "u8": 1, "pred": 1}
+    out: Dict[str, float] = {}
+
+    def shape_bytes(shapes: str) -> float:
+        total = 0.0
+        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * dt_bytes.get(dt, 4)
+        return total
+
+    # plain results:  %x = f32[a,b]{...} all-gather(...)
+    # tuple results (combined ops): %x = (f32[..], f32[..]) all-reduce(...)
+    # async pairs (TPU): only the -start op is counted (its -done shares
+    # the shape); tiled layouts like {1,0:T(8,128)} may contain parens,
+    # so the tuple branch matches balanced-bracket shape lists only.
+    pat = re.compile(
+        r"=\s*(\((?:[^()]|\([^()]*\))*\)|\w+\[[\d,]*\][^=\n]*?)\s"
+        r"(all-gather|reduce-scatter|all-reduce|all-to-all|"
+        r"collective-permute)(?:-start)?\("
+    )
+    for m in pat.finditer(compiled_text):
+        out[m.group(2)] = out.get(m.group(2), 0.0) + shape_bytes(m.group(1))
+    return out
