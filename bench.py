@@ -37,17 +37,20 @@ def detect_system():
 
 def build_bench_model():
     """Small-but-real llama: big enough to exercise the MXU, small
-    enough to fit 16 GiB with fp32 Adam state."""
+    enough to fit 16 GiB with fp32 Adam state. SIMU_BENCH_FAST=1 (the
+    supervisor's degraded retry) halves the depth so a flaky tunnel
+    window can still produce a measurement."""
     from simumax_tpu.core.config import ModelConfig
 
+    fast = bool(os.environ.get("SIMU_BENCH_FAST"))
     return ModelConfig(
-        model_name="bench_llama_0p5b",
+        model_name="bench_llama_0p5b" if not fast else "bench_llama_fast",
         hidden_size=2048,
         head_num=16,
         kv_head_num=8,
         head_size=128,
         intermediate_size=5504,
-        layer_num=6,
+        layer_num=6 if not fast else 3,
         vocab_size=32000,
         use_swiglu=True,
     )
@@ -128,7 +131,8 @@ def main():
     # self-calibration: measure exactly the shapes the estimate missed
     from simumax_tpu.calibration import calibrate_for_perf
 
-    calibrated = calibrate_for_perf(perf, max_keys=24)
+    fast = bool(os.environ.get("SIMU_BENCH_FAST"))
+    calibrated = calibrate_for_perf(perf, max_keys=24 if not fast else 10)
     perf.run_estimate()
     perf._cost_result = None
     pred_cal = perf.analysis_cost()["iter_time"]
@@ -150,6 +154,8 @@ def main():
         "predicted_peak_gib": round(mem["max_peak_gib"], 2),
         "device_kind": kind,
         "system_config": system_name,
+        "bench_model": mc.model_name,
+        "degraded": fast,
     }
     if "measured_peak_bytes" in mem_stats:
         result["measured_peak_gib"] = round(
@@ -158,16 +164,19 @@ def main():
     print(json.dumps(result))
 
 
-def supervised_main(attempts=2, timeout_s=560):
+def supervised_main(attempts=3, timeout_s=560):
     """The TPU tunnel can hang indefinitely at backend init; run the
-    real bench in a child process with a timeout and retry so the
-    driver always gets its one JSON line."""
+    real bench in a child process with a timeout and retry (the final
+    retry in a reduced-workload mode) so the driver always gets its
+    one JSON line."""
     import subprocess
 
     env = dict(os.environ)
     env["SIMU_BENCH_CHILD"] = "1"
     last_err = "unknown"
-    for _ in range(attempts):
+    for attempt in range(attempts):
+        if attempt == attempts - 1:
+            env["SIMU_BENCH_FAST"] = "1"  # degraded last try
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

@@ -411,6 +411,11 @@ class StrategyConfig(ConfigBase):
     #: norm/clip, adam, fp32->param copy). "functional": one fused
     #: adam kernel as XLA emits for a functional train step.
     optimizer_style: str = "megatron"
+    #: Megatron-style comm/compute overlap: bucketed grad reduce hides
+    #: under the last microbatch's backward; the ZeRO-1 param
+    #: all-gather hides under the next forward
+    overlap_grad_reduce: bool = False
+    overlap_param_gather: bool = False
     attention_sparse_ratio: float = 0.5  # causal => half the score flops
 
     enable_recompute: bool = False

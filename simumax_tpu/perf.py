@@ -610,6 +610,40 @@ class PerfLLM(PerfBase):
             detail["moe_grad_rs_time"] = rs
             detail["moe_param_ag_time"] = ag
             t += rs + ag
+        # Megatron overlap flags: bucketed grad reduce hides under the
+        # last microbatch's backward; the ZeRO-1 param all-gather hides
+        # under the next iteration's first forward — only the excess is
+        # exposed (keys below are what the simulator replays too)
+        if t > 0 and (st.overlap_grad_reduce or st.overlap_param_gather):
+            phases = self._stage_phase_inputs(0)
+            if st.overlap_grad_reduce:
+                rs = (detail.get("dense_grad_rs_time", 0.0)
+                      + detail.get("moe_grad_rs_time", 0.0))
+                # ZeRO-2 reduce-scatters are issued per microbatch, each
+                # hiding under its own backward; otherwise one bucketed
+                # reduce overlaps only the last microbatch's backward
+                n_windows = (
+                    st.micro_batch_num if st.zero_state == 2 else 1
+                )
+                hidden = min(rs, phases["bwd"] * n_windows)
+                if rs > 0:
+                    scale = (rs - hidden) / rs
+                    for k in ("dense_grad_rs_time", "moe_grad_rs_time"):
+                        if k in detail:
+                            detail[k] *= scale
+                    detail["grad_reduce_hidden_time"] = hidden
+                    t -= hidden
+            if st.overlap_param_gather:
+                ag = (detail.get("dense_param_ag_time", 0.0)
+                      + detail.get("moe_param_ag_time", 0.0))
+                hidden = min(ag, phases["fwd"])
+                if ag > 0:
+                    scale = (ag - hidden) / ag
+                    for k in ("dense_param_ag_time", "moe_param_ag_time"):
+                        if k in detail:
+                            detail[k] *= scale
+                    detail["param_gather_hidden_time"] = hidden
+                    t -= hidden
         detail["total"] = t
         return detail
 

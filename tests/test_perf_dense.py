@@ -375,3 +375,38 @@ class TestZero23:
         m = p.analysis_mem()
         assert m["fits"] and m["max_peak_gib"] < 8
         assert p.analysis_cost()["mfu"] > 0.35
+
+
+class TestCommOverlap:
+    def test_overlap_flags_reduce_dp_cost(self):
+        base = run("tp1_pp2_dp4_mbs1")
+        og = run("tp1_pp2_dp4_mbs1", overlap_grad_reduce=True)
+        both = run("tp1_pp2_dp4_mbs1", overlap_grad_reduce=True,
+                   overlap_param_gather=True)
+        t0 = base.analysis_cost()["iter_time"]
+        t1 = og.analysis_cost()["iter_time"]
+        t2 = both.analysis_cost()["iter_time"]
+        assert t2 < t1 < t0
+        assert both.analysis_cost()["dp_comm"]["grad_reduce_hidden_time"] > 0
+
+    def test_overlap_bounded_by_compute(self):
+        """With a starved interconnect the dp comm exceeds one
+        microbatch of compute; only that much can hide."""
+        from simumax_tpu.core.config import get_system_config
+
+        sysc = get_system_config("tpu_v5e_256")
+        sysc.ici.link_gbps = 0.5
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.overlap_grad_reduce = True
+        st.__post_init__()
+        p = PerfLLM().configure(st, "llama3-8b", sysc)
+        p.run_estimate()
+        dp = p.analysis_cost()["dp_comm"]
+        assert dp["dense_grad_rs_time"] > 0  # excess stays exposed
+
+    def test_sim_agrees_with_overlap(self):
+        p = run("tp1_pp2_dp4_mbs1", overlap_grad_reduce=True,
+                overlap_param_gather=True)
+        c = p.analysis_cost()
+        r = p.simulate(None)
+        assert r["end_time"] == pytest.approx(c["iter_time"], rel=0.01)
