@@ -798,6 +798,15 @@ class PerfLLM(PerfBase):
                 for (stage, chunk_idx), chunk in sorted(self.chunks.items()):
                     f.write(f"===== stage {stage} chunk {chunk_idx} =====\n")
                     f.write(repr(chunk) + "\n")
+            # the exact configs this estimate ran with (reference
+            # *_config.json dumps)
+            for name, cfg in (
+                ("model_config", self.model_config),
+                ("strategy_config", self.strategy),
+                ("system_config", self.system),
+            ):
+                with open(os.path.join(save_path, f"{name}.json"), "w") as f:
+                    f.write(cfg.to_json_string())
         return result
 
     def _print_summary(self, result: dict):
