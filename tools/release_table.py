@@ -134,6 +134,11 @@ def build_cases(system):
             f"{model}_l4_ep4_pp2_mbc8", model, 4, "ep4_pp2_dp4_mbs1",
             system, micro_batch_num=8,
         ))
+    # full-model FSDP on 64 chips (no layer truncation)
+    cases.append(run_case(
+        "llama3_70b_full_fsdp_dp64_rc", "llama3-70b", 0,
+        "fsdp_dp64_recompute", system,
+    ))
     # long-context CP
     for cp, seq in ((4, 32768), (8, 32768), (8, 131072)):
         cases.append(run_case(
