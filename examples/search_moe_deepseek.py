@@ -1,0 +1,44 @@
+"""Larger MoE strategy sweep: DeepSeek-V2 (l8) across ep x pp x ZeRO on
+a 64-chip v5p mesh (the reference's examples/search/llm_search.py
+analog)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.search import search_best_parallel_strategy
+
+
+def main():
+    model = get_model_config("deepseekv2")
+    model.layer_num = 8
+    model.dense_layers = 1
+    system = get_system_config("tpu_v5p_256")
+    base = get_strategy_config("ep8_pp1_dp8_mbs1")
+    base.world_size = 64
+    top = search_best_parallel_strategy(
+        base, model, system, global_batch_size=128,
+        tp_list=(1, 2), pp_list=(1, 2, 4), ep_list=(4, 8, 16),
+        zero_list=(1, 3),
+        recompute_types=("none", "selective", "full_block"),
+        topk=6,
+    )
+    print("top strategies, deepseekv2-l8 @ 64x v5p, gbs 128:")
+    for r in top:
+        print(
+            f"  tp{r['tp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
+            f"z{r['zero']} mbs{r['mbs']} mbc{r['mbc']} "
+            f"{r['recompute']}: MFU {r['mfu']*100:.2f}%  "
+            f"iter {r['iter_ms']:.0f} ms  peak {r['peak_gib']:.1f} GiB"
+        )
+    return top
+
+
+if __name__ == "__main__":
+    main()
