@@ -164,6 +164,28 @@ def main():
     print(json.dumps(result))
 
 
+def _tunnel_alive(timeout_s=150, retries=2):
+    """Cheap health probe: can a child process enumerate a real TPU
+    device? Avoids burning full bench attempts against a hard-down
+    tunnel. (Checks the device kind so a CPU fallback does not count;
+    the timeout is generous vs the ~20-40s healthy init but far below
+    the 560s attempt budget.)"""
+    import subprocess
+
+    for _ in range(retries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].device_kind)"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if proc.returncode == 0 and "tpu" in proc.stdout.lower():
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+    return False
+
+
 def supervised_main(attempts=3, timeout_s=560):
     """The TPU tunnel can hang indefinitely at backend init; run the
     real bench in a child process with a timeout and retry (the final
@@ -174,6 +196,10 @@ def supervised_main(attempts=3, timeout_s=560):
     env = dict(os.environ)
     env["SIMU_BENCH_CHILD"] = "1"
     last_err = "unknown"
+    if not _tunnel_alive():
+        last_err = ("no reachable TPU (tunnel down or CPU-only); "
+                    "see RESULTS.md for the last good measurement")
+        attempts = 0
     for attempt in range(attempts):
         if attempt == attempts - 1:
             env["SIMU_BENCH_FAST"] = "1"  # degraded last try
