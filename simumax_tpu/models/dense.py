@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from simumax_tpu.core.config import _require
 from simumax_tpu.core.module import BuildContext, GemmBase, LeafModule, MetaModule
 from simumax_tpu.core.records import ActivationInfo, CollectiveCall
 from simumax_tpu.core.tensor import TensorSpec
@@ -439,10 +440,14 @@ class CoreAttention(LeafModule):
         dv = v.shape[-1]
         return b, sq, skv, hl, d, dv
 
+    def _causal(self) -> bool:
+        return bool(self.ctx.model.use_causal_attention)
+
     def op_flops(self) -> Dict[str, float]:
         st = _st(self.ctx)
         b, sq, skv, hl, d, dv = self._dims()
-        sparse = st.attention_sparse_ratio  # causal skips this fraction
+        # causal masking skips this fraction; full attention skips none
+        sparse = st.attention_sparse_ratio if self._causal() else 0.0
         qk = 2.0 * b * hl * sq * skv * d
         pv = 2.0 * b * hl * sq * skv * dv
         fwd = (qk + pv) * (1.0 - sparse)
@@ -466,7 +471,7 @@ class CoreAttention(LeafModule):
     def comp_key(self, phase):
         b, sq, skv, hl, d, dv = self._dims()
         kvl = self.inputs[1].shape[2]
-        causal = sq == skv
+        causal = self._causal()
         key = (
             f"b={b}, sq={sq}, skv={skv}, hn={hl}, kv_hn={kvl}, hd={d}, "
             f"hd_v={dv}, causal={causal}, dtype={_st(self.ctx).dtype}"
@@ -510,19 +515,43 @@ class ContextParallelA2A(LeafModule):
         super().__init__(ctx, name)
         self.direction = direction
 
+    def _replication(self, h: int) -> int:
+        """GQA with fewer (kv) heads than cp ranks: real Ulysses
+        replicates the heads to ``cp`` before the a2a so every rank owns
+        one; the a2a then moves the replicated volume. (Without this the
+        k/v shard would round to zero heads and the KV cache/comm would
+        be modeled as free.)"""
+        cp = _st(self.ctx).cp_size
+        if self.direction != "scatter_heads":
+            return 1
+        if h >= cp:
+            _require(
+                h % cp == 0,
+                f"{h} local (kv) heads not divisible by cp_size {cp}",
+            )
+            return 1
+        _require(
+            cp % h == 0,
+            f"cp_size {cp} not a multiple of the {h} local kv heads",
+        )
+        return cp // h
+
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         cp = _st(self.ctx).cp_size
         b, s, h, d = x.shape
         if self.direction == "scatter_heads":
-            return x.with_shape(b, s * cp, h // cp, d)
+            r = self._replication(h)
+            return x.with_shape(b, s * cp, (h * r) // cp, d)
         return x.with_shape(b, s // cp, h * cp, d)
 
     def collectives(self) -> List[CollectiveCall]:
         st = _st(self.ctx)
         if st.cp_size == 1:
             return []
-        # full logical tensor = per-chip shard * cp (net-op contract)
-        nbytes = self.inputs[0].bytes * st.cp_size
+        # full logical tensor = per-chip shard * cp (net-op contract);
+        # kv-head replication inflates the moved volume accordingly
+        r = self._replication(self.inputs[0].shape[2])
+        nbytes = self.inputs[0].bytes * r * st.cp_size
         exposed = st.cp_a2a_mode == "sync_cp"
         return [
             CollectiveCall("fwd", "all2all", "cp", nbytes, "pre", exposed=exposed),
@@ -531,7 +560,8 @@ class ContextParallelA2A(LeafModule):
 
     def activation_info(self) -> ActivationInfo:
         # the re-sharded copy is a transient; source freed after a2a
-        return ActivationInfo(fwd_temp_bytes=self.inputs[0].bytes)
+        r = self._replication(self.inputs[0].shape[2])
+        return ActivationInfo(fwd_temp_bytes=self.inputs[0].bytes * r)
 
 
 

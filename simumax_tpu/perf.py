@@ -24,6 +24,7 @@ from simumax_tpu.core.config import (
     ModelConfig,
     StrategyConfig,
     SystemConfig,
+    _require,
     get_model_config,
     get_strategy_config,
     get_system_config,
@@ -70,30 +71,35 @@ class PerfBase:
     def _cross_sanity_check(self):
         """Reference ``perf_llm.py:1381-1424``."""
         st, m, sysc = self.strategy, self.model_config, self.system
-        assert st.world_size <= sysc.total_chips, (
+        _require(
+            st.world_size <= sysc.total_chips,
             f"strategy world_size {st.world_size} exceeds system "
-            f"{sysc.total_chips} chips"
+            f"{sysc.total_chips} chips",
         )
         head_shard = st.tp_size
         if st.cp_size > 1 and st.cp_comm_type == "a2a":
             head_shard *= st.cp_size  # Ulysses scatters heads over cp too
-        assert m.head_num % head_shard == 0, (
+        _require(
+            m.head_num % head_shard == 0,
             f"head_num {m.head_num} must divide tp"
-            f"{'*cp' if head_shard != st.tp_size else ''} ({head_shard})"
+            f"{'*cp' if head_shard != st.tp_size else ''} ({head_shard})",
         )
         if m.kv_head_num < st.tp_size:
             pass  # kv heads replicated within tp; allowed
         if m.model_type == "moe":
-            assert m.expert_num % st.ep_size == 0, "expert_num % ep != 0"
+            _require(
+                m.expert_num % st.ep_size == 0, "expert_num % ep != 0"
+            )
         if st.fp8:
             needed = [f"{st.quant_dtype}_matmul"]
             if m.model_type == "moe":
                 needed.append(f"{st.quant_dtype}_group_matmul")
             for key in needed:
-                assert key in sysc.accelerator.op, (
+                _require(
+                    key in sysc.accelerator.op,
                     f"system {sysc.sys_name!r} has no {key!r} efficiency "
                     f"table — this chip does not support {st.quant_dtype} "
-                    f"matmuls (available: {sorted(sysc.accelerator.op)})"
+                    f"matmuls (available: {sorted(sysc.accelerator.op)})",
                 )
         total_stages = st.pp_size * st.vp_size
         layers = m.layer_num
@@ -110,8 +116,9 @@ class PerfBase:
         eff = layers + (
             1 if st.account_for_embedding_in_pipeline_split else 0
         ) + (1 if st.account_for_loss_in_pipeline_split else 0)
-        assert eff % max(rem, 1) == 0, (
-            f"{layers} layers do not split evenly over {rem} virtual stages"
+        _require(
+            eff % max(rem, 1) == 0,
+            f"{layers} layers do not split evenly over {rem} virtual stages",
         )
 
 
@@ -342,8 +349,10 @@ class PerfLLM(PerfBase):
             one_f_one_b_order(pp, s, mbc) for s in range(pp)
         ]
 
-        F_end = [[0.0] * mbc for _ in range(pp)]
-        B_end = [[0.0] * mbc for _ in range(pp)]
+        # ``None`` marks "not yet completed"; a legitimate 0.0 completion
+        # time (zero-cost degenerate stage) must not read as unready.
+        F_end = [[None] * mbc for _ in range(pp)]
+        B_end = [[None] * mbc for _ in range(pp)]
         stage_clock = [0.0] * pp
         # iterate op queues round-robin until all done (dependencies always
         # resolvable because 1F1B is deadlock-free)
@@ -360,7 +369,7 @@ class PerfLLM(PerfBase):
                     )
                     if kind == "F":
                         dep = 0.0 if s == 0 else F_end[s - 1][i]
-                        if s > 0 and dep == 0.0:
+                        if dep is None:
                             break  # dependency not ready yet
                         start = max(stage_clock[s], dep + (ph["p2p"] if s > 0 else 0.0))
                         end = start + ph["fwd"]
@@ -369,7 +378,7 @@ class PerfLLM(PerfBase):
                             end += blocking  # blocking isend stalls sender
                     else:
                         dep = 0.0 if s == pp - 1 else B_end[s + 1][i]
-                        if s < pp - 1 and dep == 0.0:
+                        if dep is None:
                             break
                         start = max(
                             stage_clock[s], dep + (ph["p2p"] if s < pp - 1 else 0.0)

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from simumax_tpu.core.config import (
+    ConfigError,
     GiB,
     ModelConfig,
     StrategyConfig,
@@ -109,7 +110,11 @@ def evaluate_strategy(
         }
         if not fits:
             row = {**row, "mfu": 0.0}
-    except (AssertionError, ValueError, ZeroDivisionError):
+    except ConfigError:
+        # genuinely infeasible candidate (divisibility / capability):
+        # rejected silently. Internal invariant failures (AssertionError
+        # from conservation/schedule checks) propagate so sweeps surface
+        # bugs instead of masking them.
         row = None
     if cache is not None:
         cache[key] = row

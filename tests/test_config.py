@@ -3,6 +3,7 @@
 import pytest
 
 from simumax_tpu.core.config import (
+    ConfigError,
     ModelConfig,
     StrategyConfig,
     SystemConfig,
@@ -208,7 +209,7 @@ class TestStrategyConfig:
 
     def test_sanity(self):
         st = StrategyConfig(world_size=7, tp_size=2)
-        with pytest.raises(AssertionError):
+        with pytest.raises(ConfigError):
             st.sanity_check()
 
     def test_registry(self):

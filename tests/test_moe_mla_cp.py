@@ -3,7 +3,7 @@
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.core.config import ConfigError, get_model_config, get_strategy_config
 
 
 def run(strategy, model, system="tpu_v5p_256", model_tweak=None, **overrides):
@@ -173,6 +173,42 @@ class TestContextParallel:
         q = core.inputs[0]
         assert q.shape[1] == 32768  # full sequence
         assert q.shape[2] == m.head_num // 8  # heads sharded by cp
+
+    def test_cp_a2a_gqa_kv_head_replication(self):
+        """GQA with local kv heads < cp: Ulysses replicates kv heads so
+        each cp rank owns >=1 (round-1 ADVICE medium — the k/v shard used
+        to round to 0 heads, modeling KV cache and a2a comm as free)."""
+        m = get_model_config("llama3-70b")  # 8 kv heads
+        m.layer_num = 2
+        st = self._cp_strategy(8)
+        st.tp_size = 2  # kv heads per tp rank = 4 < cp = 8
+        st.world_size = 16
+        st.__post_init__()
+        p = PerfLLM().configure(st, m, "tpu_v5p_256")
+        p.run_estimate()
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        core = attn.core
+        q, k, v = core.inputs
+        assert k.shape[2] == 1 and v.shape[2] == 1  # replicated to 1/rank
+        assert k.shape[1] == 32768  # full sequence
+        # the k a2a must move the replicated volume: full-seq logical k
+        # (4 tp-local kv heads) x replication factor 2 (4 heads -> cp=8)
+        kv_bytes_logical = 1 * 32768 * 4 * 128 * 2  # b*s*kvl_tp*hd*e
+        k_a2a = [c for c in attn.cp_k.collective_calls if c.phase == "fwd"]
+        assert k_a2a and k_a2a[0].size_bytes == pytest.approx(
+            kv_bytes_logical * 2
+        )
+        # KV traffic is no longer modeled as zero
+        assert core.op_accessed()["fwd"] > 1 * 32768 * 2 * 128 * 2
+
+    def test_cp_a2a_gqa_indivisible_rejected(self):
+        m = get_model_config("llama3-70b")
+        m.kv_head_num = 3
+        m.layer_num = 2
+        st = self._cp_strategy(8)
+        with pytest.raises(ConfigError):
+            p = PerfLLM().configure(st, m, "tpu_v5p_256")
+            p.run_estimate()
 
     def test_cp_ring_variant_complete(self):
         """all_gather (ring-family) CP: net + flops + memory all modeled

@@ -4,7 +4,7 @@ invariants + closed-form cross-checks instead of golden GPU numbers)."""
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.core.config import ConfigError, get_model_config, get_strategy_config
 
 
 def run(strategy, model="llama3-8b", system="tpu_v5e_256", **overrides):
@@ -231,7 +231,7 @@ class TestUnevenPP:
         st.pp_size = 4
         st.num_layers_in_first_pipeline_stage = 3  # 29 % 2 != 0... 32-3=29 over 3 stages
         st.__post_init__()
-        with pytest.raises(AssertionError, match="split evenly"):
+        with pytest.raises(ConfigError, match="split evenly"):
             run(st)
 
 
@@ -292,7 +292,7 @@ class TestMathSDP:
 
 class TestQuantDtypeGuard:
     def test_unsupported_quant_dtype_rejected(self):
-        with pytest.raises(AssertionError, match="no 'fp8_matmul'"):
+        with pytest.raises(ConfigError, match="no 'fp8_matmul'"):
             run("tp2_pp1_dp4_mbs1", fp8=True, quant_dtype="fp8")
 
     def test_uneven_with_vpp(self):

@@ -5,12 +5,15 @@ cross-cutting invariants hold on every one — activation conservation
 reconstruction, memory-breakdown consistency.
 """
 
+import copy
+import os
 import random
 
 import pytest
 
 from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import (
+    ConfigError,
     StrategyConfig,
     get_model_config,
 )
@@ -18,12 +21,22 @@ from simumax_tpu.core.config import (
 MODELS = ["llama2-tiny", "llama3-8b", "mixtral-8x1b", "deepseekv2-lite"]
 
 
+def sample_model(rng):
+    model = get_model_config(rng.choice(MODELS))
+    if rng.random() < 0.25:
+        # bidirectional-attention variant (causality is a config
+        # property, not a shape inference)
+        model = copy.deepcopy(model)
+        model.use_causal_attention = False
+    return model
+
+
 def sample_strategy(rng, model):
     for _ in range(50):
         tp = rng.choice([1, 2, 4])
         cp = rng.choice([1, 2]) if model.model_type == "dense" else 1
-        pp = rng.choice([1, 2, 4])
-        dp = rng.choice([1, 2, 4])
+        pp = rng.choice([1, 2, 3, 4])  # incl. non-pow2
+        dp = rng.choice([1, 2, 3, 4])  # incl. non-pow2
         world = tp * cp * pp * dp
         ep = 1
         if model.model_type == "moe":
@@ -32,8 +45,9 @@ def sample_strategy(rng, model):
                 if model.expert_num % e == 0 and (dp * cp * tp) % e == 0
             ]
             ep = rng.choice(choices)
-        mbc = rng.choice([1, 2, 4, 8])
+        mbc = rng.choice([1, 2, 4, 6, 8])
         vp = rng.choice([1, 2]) if pp > 1 and mbc % pp == 0 else 1
+        math_sdp = rng.random() < 0.2
         st = StrategyConfig(
             world_size=world, tp_size=tp, cp_size=cp, pp_size=pp,
             ep_size=ep, micro_batch_num=mbc, interleaving_size=vp,
@@ -50,11 +64,13 @@ def sample_strategy(rng, model):
             enable_dropout=rng.random() < 0.3,
             zero_state=rng.choice([0, 1, 2, 3]),
             use_fused_ce=rng.random() < 0.5,
+            use_math_sdp=math_sdp,
+            use_flash_sdp=not math_sdp,
             optimizer_style=rng.choice(["megatron", "functional"]),
         )
         try:
             st.sanity_check()
-        except AssertionError:
+        except ConfigError:
             continue
         if model.head_num % (tp * cp):
             continue
@@ -67,18 +83,21 @@ def sample_strategy(rng, model):
     return None
 
 
-@pytest.mark.parametrize("seed", range(24))
+_N_SEEDS = int(os.environ.get("SIMU_SWEEP_SEEDS", "24"))
+
+
+@pytest.mark.parametrize("seed", range(_N_SEEDS))
 def test_random_config_invariants(seed):
     rng = random.Random(seed)
-    model_name = rng.choice(MODELS)
-    model = get_model_config(model_name)
+    model = sample_model(rng)
+    model_name = model.model_name
     st = sample_strategy(rng, model)
     if st is None:
         pytest.skip("no valid sample for this seed")
     p = PerfLLM()
     try:
         p.configure(st, model, "tpu_v5p_256")
-    except AssertionError:
+    except ConfigError:
         pytest.skip("cross-sanity rejected sample")
     p.run_estimate()  # asserts activation conservation internally
     cost = p.analysis_cost()
