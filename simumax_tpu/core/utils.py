@@ -2,7 +2,23 @@
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, List
+
+
+def dp_comm_buckets(numel: float, group_size: int) -> List[float]:
+    """Megatron DDP gradient-bucket sizes (elements): buckets of
+    ``max(40M, 1M x group)`` elements, last bucket partial (reference
+    bucketing in ``perf_llm.py:1513-1597``). Shared *sizing* between the
+    analytical path and the event simulator — the overlap/schedule logic
+    on top is deliberately independent in each."""
+    cap = float(max(40_000_000, 1_000_000 * group_size))
+    out: List[float] = []
+    remaining = float(numel)
+    while remaining > 1e-9:
+        take = min(remaining, cap)
+        out.append(take)
+        remaining -= take
+    return out
 
 
 def human_bytes(n: float) -> str:

@@ -30,7 +30,7 @@ from simumax_tpu.core.config import (
     get_system_config,
 )
 from simumax_tpu.core.module import BuildContext
-from simumax_tpu.core.utils import human_time
+from simumax_tpu.core.utils import dp_comm_buckets, human_time
 from simumax_tpu.models.llm import LLMModel
 
 
@@ -134,6 +134,7 @@ class PerfLLM(PerfBase):
         self._mem_result = None
         self._cost_result = None
         self._interleaved_result = None
+        self._dp_time_cache: Dict[int, dict] = {}
 
     # ------------------------------------------------------------------
     # Net placement (reference ``analysis_net`` perf_llm.py:369-474)
@@ -251,6 +252,7 @@ class PerfLLM(PerfBase):
         self._mem_result = None
         self._cost_result = None
         self._interleaved_result = None
+        self._dp_time_cache = {}
         return self
 
     # ------------------------------------------------------------------
@@ -558,32 +560,45 @@ class PerfLLM(PerfBase):
             )
         return stages
 
-    def _compute_dp_time(self) -> dict:
-        """Bucketed DP grad reduce-scatter + param all-gather, dense over
-        dp_cp and MoE over edp (reference ``_compute_dp_time``
-        perf_llm.py:1513-1597)."""
+    def _compute_dp_time(self, stage: int = 0) -> dict:
+        """Bucketed DP grad reduce-scatter + param all-gather for one
+        stage's params, dense over dp_cp and MoE over edp (reference
+        ``_compute_dp_time`` perf_llm.py:1513-1597). Stages can differ
+        (embedding/head placement, leading dense layers in MoE models),
+        so ``analysis_cost`` takes the max path over stages."""
+        if stage in self._dp_time_cache:
+            return self._dp_time_cache[stage]
         st, sysc = self.strategy, self.system
-        # use the busiest stage's parameter set (stage 0)
         dense_numel = moe_numel = 0.0
-        for c in self.stage_chunks(0):
+        for c in self.stage_chunks(stage):
             dense_numel += c.param_info.dense_numel
             moe_numel += c.param_info.moe_numel
         g_el = 2.0 if st.grad_reduce_in_bf16 else 4.0
         p_el = st.element_size
         t = 0.0
         detail = {}
+        last_bucket_times = []  # per stream: its final bucket's rs time
         if st.dp_size * st.cp_size > 1 and dense_numel and st.zero_state < 3:
             # ZeRO-3 grads reduce-scatter per layer inside the backward
             # (leaf collectives) and params gather per layer in the next
             # forward — no step-end bulk comm for dense params
             path = self.ctx.path("dp_cp")
+            group = st.dp_size * st.cp_size
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
-            rs = sysc.compute_net_op_time(op, dense_numel * g_el, path)
+            bt = [
+                sysc.compute_net_op_time(op, nb * g_el, path)
+                for nb in dp_comm_buckets(dense_numel, group)
+            ]
+            rs = sum(bt)
+            last_bucket_times.append(bt[-1])
             if st.zero_state == 2:
                 # grads live sharded: reduce-scatter each microbatch
                 rs *= st.micro_batch_num
             ag = (
-                sysc.compute_net_op_time("all_gather", dense_numel * p_el, path)
+                sum(
+                    sysc.compute_net_op_time("all_gather", nb * p_el, path)
+                    for nb in dp_comm_buckets(dense_numel, group)
+                )
                 if st.zero_state >= 1
                 else 0.0
             )
@@ -593,7 +608,11 @@ class PerfLLM(PerfBase):
         # tied-embedding grad sync between first/last stage replicas
         # (Megatron embedding-group all-reduce), ~a ring of two over the
         # pp path: two p2p transfers of the grad
-        if st.pp_size > 1 and not self.model_config.untie_embeddings:
+        if (
+            st.pp_size > 1
+            and not self.model_config.untie_embeddings
+            and stage in (0, st.pp_size - 1)
+        ):
             emb_grad = (
                 self.model_config.padded_vocab_size
                 * self.model_config.hidden_size
@@ -608,11 +627,19 @@ class PerfLLM(PerfBase):
         if st.edp_size > 1 and moe_numel and st.zero_state < 3:
             path = self.ctx.path("edp")
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
-            rs = sysc.compute_net_op_time(op, moe_numel * g_el, path)
+            bt = [
+                sysc.compute_net_op_time(op, nb * g_el, path)
+                for nb in dp_comm_buckets(moe_numel, st.edp_size)
+            ]
+            rs = sum(bt)
+            last_bucket_times.append(bt[-1])
             if st.zero_state == 2:
                 rs *= st.micro_batch_num
             ag = (
-                sysc.compute_net_op_time("all_gather", moe_numel * p_el, path)
+                sum(
+                    sysc.compute_net_op_time("all_gather", nb * p_el, path)
+                    for nb in dp_comm_buckets(moe_numel, st.edp_size)
+                )
                 if st.zero_state >= 1
                 else 0.0
             )
@@ -624,17 +651,23 @@ class PerfLLM(PerfBase):
         # under the next iteration's first forward — only the excess is
         # exposed (keys below are what the simulator replays too)
         if t > 0 and (st.overlap_grad_reduce or st.overlap_param_gather):
-            phases = self._stage_phase_inputs(0)
+            phases = self._stage_phase_inputs(stage)
             if st.overlap_grad_reduce:
                 rs = (detail.get("dense_grad_rs_time", 0.0)
                       + detail.get("moe_grad_rs_time", 0.0))
                 # ZeRO-2 reduce-scatters are issued per microbatch, each
                 # hiding under its own backward; otherwise one bucketed
-                # reduce overlaps only the last microbatch's backward
+                # reduce overlaps only the last microbatch's backward.
+                # Each stream's FINAL bucket only becomes ready when the
+                # backward finishes, so it is never hideable (the dense
+                # and MoE streams run on parallel channels — the longer
+                # final bucket bounds the tail).
                 n_windows = (
                     st.micro_batch_num if st.zero_state == 2 else 1
                 )
-                hidden = min(rs, phases["bwd"] * n_windows)
+                tail = max(last_bucket_times) if last_bucket_times else 0.0
+                hidden = min(max(rs - tail * n_windows, 0.0),
+                             phases["bwd"] * n_windows)
                 if rs > 0:
                     scale = (rs - hidden) / rs
                     for k in ("dense_grad_rs_time", "moe_grad_rs_time"):
@@ -645,7 +678,10 @@ class PerfLLM(PerfBase):
             if st.overlap_param_gather:
                 ag = (detail.get("dense_param_ag_time", 0.0)
                       + detail.get("moe_param_ag_time", 0.0))
-                hidden = min(ag, phases["fwd"])
+                # the gathers must complete once the first forward has
+                # consumed the params; with VPP that first forward is
+                # one chunk (1/vp of the stage's per-microbatch forward)
+                hidden = min(ag, phases["fwd"] / st.vp_size)
                 if ag > 0:
                     scale = (ag - hidden) / ag
                     for k in ("dense_param_ag_time", "moe_param_ag_time"):
@@ -654,9 +690,19 @@ class PerfLLM(PerfBase):
                     detail["param_gather_hidden_time"] = hidden
                     t -= hidden
         detail["total"] = t
+        detail["exposed_rs"] = (
+            detail.get("dense_grad_rs_time", 0.0)
+            + detail.get("moe_grad_rs_time", 0.0)
+            + detail.get("tied_embedding_grad_ar_time", 0.0)
+        )
+        detail["exposed_ag"] = (
+            detail.get("dense_param_ag_time", 0.0)
+            + detail.get("moe_param_ag_time", 0.0)
+        )
+        self._dp_time_cache[stage] = detail
         return detail
 
-    def _compute_optim_time(self) -> float:
+    def _compute_optim_time(self, stage: int = 0) -> float:
         """Optimizer-step time, memory-bound on HBM.
 
         "megatron" style models the distributed-optimizer phases
@@ -668,7 +714,7 @@ class PerfLLM(PerfBase):
         """
         st, sysc = self.strategy, self.system
         numel = 0.0
-        for c in self.stage_chunks(0):
+        for c in self.stage_chunks(stage):
             numel += c.param_info.dense_numel + c.param_info.moe_numel
         shard = numel / max(1, st.dp_size * st.cp_size) if st.zero_state else numel
         if st.optimizer_style == "functional":
@@ -706,9 +752,33 @@ class PerfLLM(PerfBase):
             pp_res.pop("orders", None)
         else:
             pp_res = self.calculate_1f1b_bubble(phase_inputs)
-        dp_res = self._compute_dp_time()
-        optim = self._compute_optim_time()
-        iter_time = pp_res["total"] + dp_res["total"] + optim
+        # stages differ in params (embedding/head, MoE dense_layers), so
+        # the iteration ends on the *max path*: each stage finishes its
+        # backward, exposes its grad comm, all ranks barrier before the
+        # step, then each runs its optimizer + param gather
+        dp_by_stage = [self._compute_dp_time(s) for s in range(st.pp_size)]
+        optim_by_stage = [
+            self._compute_optim_time(s) for s in range(st.pp_size)
+        ]
+        ends = pp_res["per_stage_end"]
+        s_rs = max(
+            range(st.pp_size),
+            key=lambda s: ends[s] + dp_by_stage[s]["exposed_rs"],
+        )
+        barrier_t = ends[s_rs] + dp_by_stage[s_rs]["exposed_rs"]
+        s_tail = max(
+            range(st.pp_size),
+            key=lambda s: optim_by_stage[s] + dp_by_stage[s]["exposed_ag"],
+        )
+        tail = optim_by_stage[s_tail] + dp_by_stage[s_tail]["exposed_ag"]
+        iter_time = barrier_t + tail
+        # breakdown reports the binding (max-path) stages so the parts
+        # still account for iter_time: iter = end[s_rs] + dp_comm + optim
+        dp_res = dict(dp_by_stage[s_rs])
+        dp_res["total"] = (
+            dp_by_stage[s_rs]["exposed_rs"] + dp_by_stage[s_tail]["exposed_ag"]
+        )
+        optim = optim_by_stage[s_tail]
         ratio = self.straggler_ratio()
         iter_time *= ratio
 

@@ -22,6 +22,14 @@ Request vocabulary (yielded by rank coroutines):
 * ``("advance", t)`` — jump lane clock to at least t
 * ``("trace", duration, name, lane)`` — zero-advance visibility span
   (overlapped comm shown in the trace without consuming rank time)
+* ``("async_collective", stream, duration, name, peers)`` — post a
+  rendezvous on a *comm stream* and continue immediately (NCCL-on-a-
+  side-stream semantics): the op starts when every peer has posted and
+  the stream's previous op finished, runs ``duration``, and records its
+  completion in each peer's ``comm_done`` without advancing main clocks
+* ``("wait_comm",)`` — block until every async collective this rank
+  posted has completed, then advance the main clock to the latest
+  completion (stream join)
 """
 
 from __future__ import annotations
@@ -77,6 +85,13 @@ class SimuEngine:
         self._recv_seq: Dict[tuple, int] = {}
         self._flow_ids: Dict[tuple, int] = {}
         self._next_flow = 0
+        #: async comm-stream state: per-(stream,peers) chained end time,
+        #: per-rank latest completion, per-rank outstanding posts
+        self._async_chain: Dict[tuple, float] = {}
+        self._async_seq: Dict[tuple, int] = {}
+        self._async_rv: Dict[tuple, _Rendezvous] = {}
+        self.comm_done = [0.0] * num_ranks
+        self._async_pending: List[set] = [set() for _ in range(num_ranks)]
         self.mem_hooks: List[Callable[[int, str, float], None]] = []
 
     def add_rank(self, rank: int, proc: Generator):
@@ -165,6 +180,35 @@ class SimuEngine:
             self._coll_seq[(key, rank)] = seq + 1
             self._advance_rank(rank, end)
             return True
+        if kind == "async_collective":
+            _, stream, duration, name, peers = req
+            seq = self._async_seq.get((stream, rank), 0)
+            self._async_seq[(stream, rank)] = seq + 1
+            pset = frozenset(peers)
+            ckey = (stream, pset, seq)
+            rv = self._async_rv.get(ckey)
+            if rv is None:
+                rv = self._async_rv[ckey] = _Rendezvous(
+                    peers=pset, duration=duration
+                )
+            if rv.duration != duration:
+                raise RuntimeError(
+                    f"async collective {stream}#{seq}: mismatched durations "
+                    f"{rv.duration} vs {duration} from rank {rank}"
+                )
+            rv.arrivals[rank] = self.clock[rank]
+            self._async_pending[rank].add(ckey)
+            if rv.complete:
+                self._finish_async(ckey, rv, name)
+            # poster never blocks: continue at the unchanged clock
+            self._advance_rank(rank, self.clock[rank])
+            return True
+        if kind == "wait_comm":
+            if self._async_pending[rank]:
+                return False  # some posted op is waiting on peers
+            self.clock[rank] = max(self.clock[rank], self.comm_done[rank])
+            self._advance_rank(rank, self.clock[rank])
+            return True
         if kind == "send":
             _, dst, tag, duration, name, *rest = req
             lane = rest[0] if rest else "pp_fwd"
@@ -205,6 +249,25 @@ class SimuEngine:
             return True
         raise RuntimeError(f"unknown request {req!r}")
 
+    def _finish_async(self, ckey: tuple, rv: _Rendezvous, name: str):
+        """All peers posted: schedule the op on its comm stream (starts
+        after the stream's previous op and the last arrival) and record
+        completion for every peer."""
+        stream, pset, _seq = ckey
+        chain_key = (stream, pset)
+        start = max(
+            max(rv.arrivals.values()), self._async_chain.get(chain_key, 0.0)
+        )
+        end = start + rv.duration
+        self._async_chain[chain_key] = end
+        for peer in pset:
+            self.comm_done[peer] = max(self.comm_done[peer], end)
+            self._async_pending[peer].discard(ckey)
+            self.events.append(
+                TraceEvent(peer, "comm", name, start, end, kind="comm")
+            )
+        del self._async_rv[ckey]
+
     # -- diagnostics (reference ``base_struct.py:1415-1474``) --------------
     def _deadlock_dump(self):
         lines = ["simulator deadlock — per-rank state:"]
@@ -220,4 +283,9 @@ class SimuEngine:
             lines.append(f"  incomplete collectives: {incomplete}")
         if self._sends:
             lines.append(f"  unmatched sends: {list(self._sends)}")
+        pending_async = {
+            k: dict(v.arrivals) for k, v in self._async_rv.items()
+        }
+        if pending_async:
+            lines.append(f"  incomplete async collectives: {pending_async}")
         raise DeadlockError("\n".join(lines))

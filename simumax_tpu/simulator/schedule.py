@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Generator, List, Optional
 
+from simumax_tpu.core.utils import dp_comm_buckets
 from simumax_tpu.parallel.pipeline import one_f_one_b_order
 from simumax_tpu.simulator.memory import SimuMemoryTracker
 
@@ -71,6 +72,138 @@ class StageProcess:
             if self.pp > 1
             else 0.0
         )
+        # independent DP-comm model (NOT perf._compute_dp_time): bucket
+        # plan from this stage's own params; overlap emerges from the
+        # engine's async comm streams rather than a closed-form min()
+        self._dp = self._dp_plan()
+        self._rs_cursor = {d: 0 for d in self._dp["rs"]}
+        self._grad_acc = {d: 0.0 for d in self._dp["rs"]}
+        self._rs_active = False
+        self._dp_groups: dict = {}
+
+    # -- DP comm plan (independent of the analytical path) -----------------
+    def _dp_plan(self) -> dict:
+        """Per-stream grad reduce / param gather bucket schedules.
+
+        Streams: dense grads over ``dp_cp``, MoE grads over ``edp`` —
+        modeled as parallel comm channels (Megatron uses separate
+        process groups / NCCL streams for the two).
+        """
+        st, sysc, perf = self.st, self.perf.system, self.perf
+        dense = sum(c.param_info.dense_numel for c in self.chunks)
+        moe = sum(c.param_info.moe_numel for c in self.chunks)
+        g_el = 2.0 if st.grad_reduce_in_bf16 else 4.0
+        p_el = st.element_size
+        plan = {"rs": {}, "ag": {}, "bounds": {}, "tied": 0.0}
+        specs = []
+        if st.dp_size * st.cp_size > 1 and dense > 0 and st.zero_state < 3:
+            specs.append(("dp_cp", dense, st.dp_size * st.cp_size))
+        if st.edp_size > 1 and moe > 0 and st.zero_state < 3:
+            specs.append(("edp", moe, st.edp_size))
+        for dim, numel, group in specs:
+            path = perf.ctx.path(dim)
+            op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
+            sizes = dp_comm_buckets(numel, group)
+            plan["rs"][dim] = [
+                sysc.compute_net_op_time(op, nb * g_el, path) for nb in sizes
+            ]
+            bounds, acc = [], 0.0
+            for nb in sizes:
+                acc += nb
+                bounds.append(acc)
+            plan["bounds"][dim] = bounds
+            if st.zero_state >= 1:
+                plan["ag"][dim] = [
+                    sysc.compute_net_op_time("all_gather", nb * p_el, path)
+                    for nb in sizes
+                ]
+        if (
+            st.pp_size > 1
+            and not perf.model_config.untie_embeddings
+            and self.stage in (0, self.pp - 1)
+        ):
+            m = perf.model_config
+            emb_grad = (
+                m.padded_vocab_size * m.hidden_size / st.tp_size
+                * st.grad_element_size
+            )
+            plan["tied"] = 2 * sysc.compute_net_op_time(
+                "p2p", emb_grad, perf.ctx.path("pp")
+            )
+        return plan
+
+    def _dim_group(self, dim: str):
+        """dp_cp / edp rendezvous group of this world rank (None in
+        merged mode: the group's members are represented by one rank).
+        Computed once per StageProcess."""
+        if self.rank is None:
+            return None
+        if dim in self._dp_groups:
+            return self._dp_groups[dim]
+        from simumax_tpu.parallel.mesh import group_of, rank_coords
+
+        st = self.st
+        if dim == "dp_cp":
+            group = self._dp_cp_group
+            if not group:
+                mine = rank_coords(self.rank, st)
+                group = sorted(
+                    r for r in range(st.world_size)
+                    if rank_coords(r, st)["tp"] == mine["tp"]
+                    and rank_coords(r, st)["pp"] == mine["pp"]
+                )
+        else:
+            group = group_of(self.rank, st, dim)
+        self._dp_groups[dim] = group
+        return group
+
+    def _engine_rank(self) -> int:
+        return self.stage if self.rank is None else self.rank
+
+    def _async_bucket(self, dim: str, idx: int, dur: float, tag: str):
+        group = self._dim_group(dim)
+        peers = group if group else [self._engine_rank()]
+        return (
+            "async_collective", f"{tag}:{dim}", dur,
+            f"{tag}_{dim}_b{idx}", list(peers),
+        )
+
+    def _grad_ready(self, leaf) -> Generator:
+        """Post grad-reduce buckets whose parameters have all produced
+        grads (called after each leaf backward while overlap is active)."""
+        if not self._rs_active:
+            return
+        ready = {
+            "dp_cp": leaf.param_info.dense_numel,
+            "edp": leaf.param_info.moe_numel,
+        }
+        for dim, buckets in self._dp["rs"].items():
+            self._grad_acc[dim] += ready.get(dim, 0.0)
+            bounds = self._dp["bounds"][dim]
+            while (
+                self._rs_cursor[dim] < len(buckets)
+                and self._grad_acc[dim] >= bounds[self._rs_cursor[dim]] - 1e-6
+            ):
+                i = self._rs_cursor[dim]
+                self._rs_cursor[dim] = i + 1
+                yield self._async_bucket(dim, i, buckets[i], "grad_rs")
+
+    def _begin_rs_window(self):
+        self._rs_active = True
+        self._rs_cursor = {d: 0 for d in self._dp["rs"]}
+        self._grad_acc = {d: 0.0 for d in self._dp["rs"]}
+
+    def _flush_rs_window(self) -> Generator:
+        """End of an overlapped backward window: post any bucket not yet
+        posted (chunk-granularity walks never post inline)."""
+        if not self._rs_active:
+            return
+        for dim, buckets in self._dp["rs"].items():
+            while self._rs_cursor[dim] < len(buckets):
+                i = self._rs_cursor[dim]
+                self._rs_cursor[dim] = i + 1
+                yield self._async_bucket(dim, i, buckets[i], "grad_rs")
+        self._rs_active = False
 
     def _pp_stride(self) -> int:
         st = self.st
@@ -221,6 +354,9 @@ class StageProcess:
                             self._free(clock[0], token=f"mb{mb}:r{id(sl)}",
                                        tag="recompute")
                         done.add(id(sl))
+                        for ev in self._grad_ready(sl):
+                            t = yield ev
+                            clock[0] = t
                     i -= 1
                     continue
                 comp_a = leaf.cost_info.compute.bwd_act * self.perturb
@@ -245,34 +381,37 @@ class StageProcess:
                     self._free(clock[0], token=f"mb{mb}:{id(leaf)}",
                                tag="act")
                 done.add(id(leaf))
+                for ev in self._grad_ready(leaf):
+                    t = yield ev
+                    clock[0] = t
                 i -= 1
 
     # -- optimizer tail (reference ``OptimizerSimulator``) -----------------
     def _optimizer(self, clock: List[float]) -> Generator:
-        perf = self.perf
-        dp = perf._compute_dp_time()
-        # grad reduce-scatter (dense + moe)
-        rs = dp.get("dense_grad_rs_time", 0.0) + dp.get("moe_grad_rs_time", 0.0)
-        ag = dp.get("dense_param_ag_time", 0.0) + dp.get("moe_param_ag_time", 0.0)
         st = self.st
-        group = self._dp_cp_group
-        if group is None and self.rank is not None and st.dp_size * st.cp_size > 1:
-            from simumax_tpu.parallel.mesh import rank_coords
-
-            mine = rank_coords(self.rank, st)
-            group = sorted(
-                r
-                for r in range(st.world_size)
-                if rank_coords(r, st)["tp"] == mine["tp"]
-                and rank_coords(r, st)["pp"] == mine["pp"]
-            )
-        if self.rank is not None and group:
-            if rs:
-                t = yield ("collective", ("dp_cp_rs", tuple(group)), rs,
-                           "grad_reduce_scatter", group)
-                clock[0] = t
-        elif rs:
-            t = yield ("compute", rs, "grad_reduce_scatter", "comm")
+        if st.overlap_grad_reduce:
+            # buckets were posted asynchronously during the backward;
+            # join the comm streams before touching the grads
+            t = yield ("wait_comm",)
+            clock[0] = t
+        else:
+            repeat = st.micro_batch_num if st.zero_state == 2 else 1
+            for _ in range(repeat):
+                for dim, buckets in self._dp["rs"].items():
+                    group = self._dim_group(dim)
+                    for i, dur in enumerate(buckets):
+                        if group:
+                            t = yield (
+                                "collective", (f"grad_rs:{dim}", tuple(group)),
+                                dur, f"grad_rs_{dim}_b{i}", group,
+                            )
+                        else:
+                            t = yield ("compute", dur, f"grad_rs_{dim}_b{i}",
+                                       "comm")
+                        clock[0] = t
+        if self._dp["tied"]:
+            t = yield ("compute", self._dp["tied"], "tied_embedding_grad",
+                       "comm")
             clock[0] = t
         # world barrier before the step (rerun_state_machine analog)
         n_ranks = self.pp if self.rank is None else st.world_size
@@ -284,16 +423,35 @@ class StageProcess:
             list(range(n_ranks)),
         )
         clock[0] = t
-        t = yield ("compute", perf._compute_optim_time() * self.perturb,
+        t = yield ("compute",
+                   self.perf._compute_optim_time(self.stage) * self.perturb,
                    "adam_step", "comp")
         clock[0] = t
-        if self.rank is not None and group and ag:
-            t = yield ("collective", ("dp_cp_ag", tuple(group)), ag,
-                       "param_all_gather", group)
-            clock[0] = t
-        elif ag:
-            t = yield ("compute", ag, "param_all_gather", "comm")
-            clock[0] = t
+        # param all-gather: when overlapped it belongs to the NEXT
+        # iteration's first forward — in this steady-state model it was
+        # posted at schedule start and joined after the first
+        # microbatch's forward, so nothing is charged here
+        if not st.overlap_param_gather:
+            for dim, buckets in self._dp["ag"].items():
+                group = self._dim_group(dim)
+                for i, dur in enumerate(buckets):
+                    if group:
+                        t = yield (
+                            "collective", (f"param_ag:{dim}", tuple(group)),
+                            dur, f"param_ag_{dim}_b{i}", group,
+                        )
+                    else:
+                        t = yield ("compute", dur, f"param_ag_{dim}_b{i}",
+                                   "comm")
+                    clock[0] = t
+
+    def _post_param_gathers(self) -> Generator:
+        """Steady state with ``overlap_param_gather``: the previous
+        iteration's param all-gathers overlap this iteration's warmup
+        forward — post them on the comm streams at schedule start."""
+        for dim, buckets in self._dp["ag"].items():
+            for i, dur in enumerate(buckets):
+                yield self._async_bucket(dim, i, dur, "param_ag")
 
     # -- full schedule ------------------------------------------------------
     def process(self) -> Generator:
@@ -303,6 +461,11 @@ class StageProcess:
         st, stage, pp = self.st, self.stage, self.pp
         mbc = st.micro_batch_num
         clock = [0.0]
+        ag_join_pending = False
+        if st.overlap_param_gather and self._dp["ag"]:
+            yield from self._post_param_gathers()
+            ag_join_pending = True
+        b_seen = 0
         for kind, mb in one_f_one_b_order(pp, stage, mbc):
             if kind == "F":
                 if stage > 0:
@@ -310,6 +473,12 @@ class StageProcess:
                                f"recv_fwd{mb}", "pp_fwd")
                     clock[0] = t
                 yield from self._fwd(mb, clock)
+                if ag_join_pending:
+                    # params must be resident once the first microbatch's
+                    # forward has consumed them: join the gather streams
+                    t = yield ("wait_comm",)
+                    clock[0] = t
+                    ag_join_pending = False
                 if stage < pp - 1:
                     t = yield (
                         "send", self._neighbor(stage + 1), f"fwd{mb}",
@@ -323,11 +492,17 @@ class StageProcess:
                         # unfused blocking sends deadlock in warmup.
                         yield ("advance", clock[0] + self.p2p_time)
             else:
+                b_seen += 1
+                if st.overlap_grad_reduce and (
+                    st.zero_state == 2 or b_seen == mbc
+                ):
+                    self._begin_rs_window()
                 if stage < pp - 1:
                     t = yield ("recv", self._neighbor(stage + 1), f"bwd{mb}",
                                f"recv_bwd{mb}", "pp_bwd")
                     clock[0] = t
                 yield from self._bwd(mb, clock)
+                yield from self._flush_rs_window()
                 if stage > 0:
                     t = yield (
                         "send", self._neighbor(stage - 1), f"bwd{mb}",
@@ -350,7 +525,15 @@ class StageProcess:
         group = st.vpp_group_size
         by_chunk = {c.chunk_idx: [c] for c in self.chunks}
         clock = [0.0]
-        for kind, c, mb in interleaved_order(pp, stage, mbc, vp, group):
+        order = interleaved_order(pp, stage, mbc, vp, group)
+        n_b = sum(1 for op in order if op[0] == "B")
+        ag_join_pending = False
+        if st.overlap_param_gather and self._dp["ag"]:
+            yield from self._post_param_gathers()
+            ag_join_pending = True
+        b_seen = 0
+        rs_begun: set = set()
+        for kind, c, mb in order:
             if kind == "F":
                 if not (stage == 0 and c == 0):
                     src = self._neighbor(stage - 1 if stage > 0 else pp - 1)
@@ -358,6 +541,10 @@ class StageProcess:
                                f"recv_fwd_c{c}_mb{mb}", "pp_fwd")
                     clock[0] = t
                 yield from self._fwd(mb, clock, by_chunk[c])
+                if ag_join_pending:
+                    t = yield ("wait_comm",)
+                    clock[0] = t
+                    ag_join_pending = False
                 if not (stage == pp - 1 and c == vp - 1):
                     dst = self._neighbor(stage + 1 if stage < pp - 1 else 0)
                     rc = c if stage < pp - 1 else c + 1
@@ -368,12 +555,30 @@ class StageProcess:
                     if not st.pp_comm_async:
                         yield ("advance", clock[0] + self.p2p_time)
             else:
+                b_seen += 1
+                # grad-reduce windows (interleaved): ZeRO-2 reduces each
+                # microbatch's grads — its window spans that mb's chunk
+                # backwards (chunk vp-1 first, chunk 0 last); otherwise
+                # grads are final only on the last microbatch, whose
+                # window spans its B ops until the schedule's final B
+                if st.overlap_grad_reduce:
+                    if st.zero_state == 2:
+                        if mb not in rs_begun:
+                            yield from self._flush_rs_window()
+                            rs_begun.add(mb)
+                            self._begin_rs_window()
+                    elif mb == mbc - 1 and not self._rs_active:
+                        self._begin_rs_window()
                 if not (stage == pp - 1 and c == vp - 1):
                     src = self._neighbor(stage + 1 if stage < pp - 1 else 0)
                     t = yield ("recv", src, f"bwd_c{c}_mb{mb}",
                                f"recv_bwd_c{c}_mb{mb}", "pp_bwd")
                     clock[0] = t
                 yield from self._bwd(mb, clock, by_chunk[c])
+                if st.overlap_grad_reduce and (
+                    (st.zero_state == 2 and c == 0) or b_seen == n_b
+                ):
+                    yield from self._flush_rs_window()
                 if not (stage == 0 and c == 0):
                     dst = self._neighbor(stage - 1 if stage > 0 else pp - 1)
                     rc = c if stage > 0 else c - 1
