@@ -109,7 +109,11 @@ def predict_step(mc, system_name, batch_size=1, seq_len=2048):
         micro_batch_size=batch_size,
         micro_batch_num=1,
         zero_state=0,
-        use_flash_sdp=True,
+        # jax.nn.dot_product_attention lowers to the XLA composite on
+        # this backend (fp32 softmax, scores materialized) — the math
+        # path, not flash (validated: docs/memory_validation.md)
+        use_flash_sdp=False,
+        use_math_sdp=True,
         use_fp32_accum_grad=True,
         optimizer_style="functional",  # matches the fused JAX adam step
     )

@@ -52,26 +52,40 @@ def _peak_tflops(system, op_key: str) -> float:
 _SCAN_K = 16
 
 
-def _chain_scan(op, seed_carry=0.0, length=_SCAN_K):
-    """Run ``op(carry) -> new_carry`` ``length`` times inside one jitted
-    lax.scan — per-dispatch overhead (large through tunnel backends) is
-    paid once for K data-dependent executions, so the measured time is
-    device time. The carry is a tiny float threaded into the inputs to
-    defeat loop-invariant hoisting."""
+def _test_array(shape, dt):
+    """Benchmark operand with non-trivial runtime values.
 
-    def fn():
+    Must be passed to the jitted benchmark as an ARGUMENT: captured
+    ``jnp.ones`` become broadcast-constants that XLA folds right out of
+    the benchmark (``sum(ones + c)`` simplifies to a scalar — this made
+    the bandwidth benchmarks measure nothing)."""
+    n = 1
+    for s in shape:
+        n *= s
+    x = (jnp.arange(n, dtype=jnp.float32) % 251) * 0.01
+    return x.reshape(shape).astype(dt)
+
+
+def _chain_scan(op, length=_SCAN_K):
+    """Jit of ``length`` data-dependent executions of
+    ``op(carry, *arrays) -> new_carry`` via lax.scan — per-dispatch
+    overhead (large through tunnel backends) is paid once. The scalar
+    carry is threaded into the inputs to defeat loop-invariant
+    hoisting; the operand arrays are jit arguments (see _test_array)."""
+
+    def fn(*arrays):
         def body(carry, _):
-            return op(carry), None
+            return op(carry, *arrays), None
 
         carry, _ = jax.lax.scan(
-            body, jnp.float32(seed_carry), None, length=length
+            body, jnp.float32(0.0), None, length=length
         )
         return carry
 
     return jax.jit(fn)
 
 
-def _time_op(op, pilot_length=_SCAN_K, min_duration_factor=8.0,
+def _time_op(op, arrays, pilot_length=_SCAN_K, min_duration_factor=8.0,
              max_length=8192):
     """Per-execution seconds of ``op``, robust to tunnel RTT jitter.
 
@@ -84,7 +98,9 @@ def _time_op(op, pilot_length=_SCAN_K, min_duration_factor=8.0,
     """
     from simumax_tpu.calibration.timing import fetch_rtt
 
-    t = time_fn(_chain_scan(op, length=pilot_length), amortize=1) / pilot_length
+    t = time_fn(
+        _chain_scan(op, length=pilot_length), *arrays, amortize=1
+    ) / pilot_length
     rtt = fetch_rtt()
     target = max(min_duration_factor * rtt, 0.2)
     if t * pilot_length >= target:
@@ -93,7 +109,7 @@ def _time_op(op, pilot_length=_SCAN_K, min_duration_factor=8.0,
     if length <= pilot_length:
         return t
     return time_fn(
-        _chain_scan(op, length=length), amortize=1, iters=5
+        _chain_scan(op, length=length), *arrays, amortize=1, iters=5
     ) / length
 
 
@@ -108,12 +124,16 @@ def measure_gemm_efficiency(
     dt = _DTYPES.get(dtype, jnp.bfloat16)
     odt = _DTYPES.get(out_dtype, dt)
     if groups > 1:
-        a = jnp.ones((groups, max(m // groups, 1), k), dt)
-        b = jnp.ones((groups, k, n), dt)
+        arrays = [
+            _test_array((groups, max(m // groups, 1), k), dt),
+            _test_array((groups, k, n), dt),
+        ]
 
-        def op(carry):
-            y = jax.lax.batch_matmul(
-                a + carry.astype(dt), b, preferred_element_type=odt
+        def op(carry, a, b):
+            y = jax.lax.dot_general(
+                a + carry.astype(dt), b,
+                (((2,), (1,)), ((0,), (0,))),  # batched [g,m,k]x[g,k,n]
+                preferred_element_type=odt,
             )
             # max needs every output element: defeats DCE slicing of the
             # dot while still fusing into its epilogue (no HBM round trip)
@@ -131,17 +151,16 @@ def measure_gemm_efficiency(
         if batch > 1:
             a_shape = (batch,) + a_shape
             dims = ((tuple(d + 1 for d in dims[0][0]), dims[0][1]), ((), ()))
-        a = jnp.ones(a_shape, dt)
-        b = jnp.ones(b_shape, dt)
+        arrays = [_test_array(a_shape, dt), _test_array(b_shape, dt)]
 
-        def op(carry):
+        def op(carry, a, b):
             y = jax.lax.dot_general(
                 a + carry.astype(dt), b, dims, preferred_element_type=odt
             )
             return jnp.max(y).astype(jnp.float32) * 1e-30
 
         flops = 2.0 * batch * m * k * n
-    t = _time_op(op)
+    t = _time_op(op, arrays)
     eff = flops / t / (peak_tflops * 1e12)
     return min(eff, 1.0)
 
@@ -152,33 +171,57 @@ def measure_gemm_efficiency(
 def measure_sdp_efficiency(
     b: int, sq: int, skv: int, hn: int, kv_hn: int, hd: int, hd_v: int,
     causal: bool, dtype: str, peak_tflops: float, backward: bool = False,
-    sparse_ratio: float = 0.5,
-) -> float:
+    sparse_ratio: float = 0.5, backend: str = "xla", flash: bool = True,
+) -> Optional[float]:
+    """Attention efficiency for the given backend: "xla" times
+    ``jax.nn.dot_product_attention`` (what a jitted model runs),
+    "pallas" the fused flash kernel (``jaxref.kernels.flash_attention``,
+    MHA layout — GQA kv heads broadcast upstream, as the kernel
+    requires). Returns None if the backend cannot run the shape."""
     dt = _DTYPES.get(dtype, jnp.bfloat16)
-    q = jnp.ones((b, sq, hn, hd), dt)
-    k = jnp.ones((b, skv, kv_hn, hd), dt)
-    v = jnp.ones((b, skv, kv_hn, hd_v), dt)
+    q = _test_array((b, sq, hn, hd), dt)
+    k = _test_array((b, skv, kv_hn, hd), dt)
+    v = _test_array((b, skv, kv_hn, hd_v), dt)
+    if backend == "pallas":
+        from simumax_tpu.jaxref.kernels import flash_attention
 
-    def fwd_op(carry):
-        o = jax.nn.dot_product_attention(
-            q + carry.astype(dt), k, v, is_causal=causal
-        )
+        if hd != hd_v:
+            return None  # kernel assumes one head dim
+        if kv_hn != hn:
+            k = jnp.repeat(k, hn // kv_hn, axis=2)
+            v = jnp.repeat(v, hn // kv_hn, axis=2)
+
+        def attn(qq, kk, vv):
+            return flash_attention(qq, kk, vv, causal=causal)
+    else:
+        def attn(qq, kk, vv):
+            return jax.nn.dot_product_attention(qq, kk, vv, is_causal=causal)
+
+    def fwd_op(carry, qq, kk, vv):
+        o = attn(qq + carry.astype(dt), kk, vv)
         return jnp.max(o).astype(jnp.float32) * 1e-30
 
-    t_f = _time_op(fwd_op)
+    t_f = _time_op(fwd_op, [q, k, v])
     if backward:
-        def loss(q):
-            o = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
-            return jnp.sum(o.astype(jnp.float32))
+        def bwd_op(carry, qq, kk, vv):
+            def loss(qx, kx, vx):
+                return jnp.sum(attn(qx, kx, vx).astype(jnp.float32))
 
-        def bwd_op(carry):
-            g = jax.grad(loss)(q + carry.astype(dt))
-            return jnp.max(g).astype(jnp.float32) * 1e-30
+            # differentiate wrt q, k AND v — a dQ-only backward would
+            # omit the dK/dV matmuls the bwd-FLOPs convention counts
+            gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
+                qq + carry.astype(dt), kk, vv
+            )
+            return (
+                jnp.max(gq) + jnp.max(gk) + jnp.max(gv)
+            ).astype(jnp.float32) * 1e-30
 
-        t = _time_op(bwd_op)
+        t = _time_op(bwd_op, [q, k, v])
         # grad timing includes the forward pass; subtract it
         t = max(t - t_f, t_f * 0.5)
-        mult = 2.5
+        # MUST match the model's bwd-FLOPs convention for this path
+        # (CoreAttention.op_flops: 2.5x fwd for flash, 2.0x for math)
+        mult = 2.5 if flash else 2.0
     else:
         t = t_f
         mult = 1.0
@@ -211,44 +254,46 @@ def measure_bandwidth_efficiency(
         )
     if kind.startswith("permute"):
         rows = max(int(nbytes // (2 * 1024)), 16)
-        x = jnp.ones((rows, 1024), jnp.bfloat16)
+        x = _test_array((rows, 1024), jnp.bfloat16)
         stride = 104729  # prime: pseudo-random, deterministic row order
         idx = (jnp.arange(rows) * stride) % rows
         if kind == "permute_bwd":
-            def op(carry):
-                y = jnp.zeros_like(x).at[idx].add(x + carry.astype(x.dtype))
+            def op(carry, xx, ii):
+                y = jnp.zeros_like(xx).at[ii].add(xx + carry.astype(xx.dtype))
                 return jnp.sum(y.astype(jnp.float32)) * 1e-30
 
             traffic = 3 * rows * 1024 * 2  # read + scatter write + reduce
         else:
-            def op(carry):
-                y = jnp.take(x + carry.astype(x.dtype), idx, axis=0)
+            def op(carry, xx, ii):
+                y = jnp.take(xx + carry.astype(xx.dtype), ii, axis=0)
                 return jnp.sum(y.astype(jnp.float32)) * 1e-30
 
             traffic = rows * 1024 * 2  # random-order read (reduce fuses)
+        arrays = [x, idx]
     elif kind.startswith("ce"):
         tokens = max(int(nbytes // (vocab * 2)), 8)
-        logits = jnp.ones((tokens, vocab), jnp.bfloat16)
+        logits = _test_array((tokens, vocab), jnp.bfloat16)
         targets = jnp.zeros((tokens,), jnp.int32)
 
-        def op(carry):
+        def op(carry, lg, tg):
             lp = jax.nn.log_softmax(
-                (logits + carry.astype(logits.dtype)).astype(jnp.float32), -1
+                (lg + carry.astype(lg.dtype)).astype(jnp.float32), -1
             )
-            ll = jnp.take_along_axis(lp, targets[:, None], -1)
+            ll = jnp.take_along_axis(lp, tg[:, None], -1)
             return -jnp.mean(ll) * 1e-30
 
         # bf16 logits read + fp32 log-probs materialized for the gather
         traffic = tokens * vocab * (2 + 4)
+        arrays = [logits, targets]
     else:
         elems = max(int(nbytes // 2), 1024)
-        x = jnp.ones((elems,), jnp.bfloat16)
 
-        def op(carry):
-            return jnp.sum((x + carry.astype(x.dtype)).astype(jnp.float32)) * 1e-30
+        def op(carry, xx):
+            return jnp.sum((xx + carry.astype(xx.dtype)).astype(jnp.float32)) * 1e-30
 
         traffic = elems * 2  # streaming read (reduce fuses the write)
-    t = _time_op(op, pilot_length=8)
+        arrays = [_test_array((elems,), jnp.bfloat16)]
+    t = _time_op(op, arrays, pilot_length=8)
     eff = traffic / t / (peak_gbps * 1e9)
     return min(eff, 1.0)
 
@@ -305,6 +350,8 @@ def calibrate_key(op_key: str, shape_key: str, system,
                 causal=kv.get("causal") == "True",
                 dtype=kv.get("dtype", "bf16"), peak_tflops=peak,
                 backward=op_key == "sdp_bwd", sparse_ratio=sparse_ratio,
+                backend=kv.get("backend", "xla"),
+                flash=kv.get("flash", "True") == "True",
             )
     except (KeyError, ValueError):
         return None

@@ -20,10 +20,12 @@ import jax.numpy as jnp
 
 def xla_memory_report(
     model_config, batch_size: int = 1, seq_len: int = 2048,
-    layer_num: Optional[int] = None,
+    layer_num: Optional[int] = None, remat: bool = False,
 ) -> Dict[str, float]:
     """Compile the jaxref train step for this model and return XLA's
-    memory analysis (bytes)."""
+    memory analysis (bytes). This is the hardware anchor: the tunnel
+    backend returns no ``memory_stats()``, but the buffer assignment is
+    exactly what XLA allocates on the real chip."""
     from simumax_tpu.jaxref.model import (
         LlamaConfig,
         init_params,
@@ -34,7 +36,7 @@ def xla_memory_report(
     params = jax.eval_shape(
         lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
     )
-    init_opt, step = make_train_step(cfg, shard=False)
+    init_opt, step = make_train_step(cfg, shard=False, remat=remat)
     opt = jax.eval_shape(init_opt, params)
     ids = jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32)
     lowered = jax.jit(step, donate_argnums=(0, 1)).lower(

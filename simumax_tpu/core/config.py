@@ -438,6 +438,12 @@ class StrategyConfig(ConfigBase):
     use_fused_norm: bool = True
     use_math_sdp: bool = False
     use_flash_sdp: bool = True
+    #: attention kernel backend the modeled framework runs: "xla"
+    #: (jax.nn.dot_product_attention under jit) or "pallas" (the fused
+    #: flash kernel, e.g. simumax_tpu.jaxref.kernels.flash_attention).
+    #: Efficiency-table keys are prefixed for non-default backends so
+    #: both can be calibrated side by side.
+    sdp_backend: str = "xla"
     use_fused_ce: bool = False
     use_fp32_accum_grad: bool = True
     grad_reduce_in_bf16: bool = False
@@ -602,6 +608,17 @@ class StrategyConfig(ConfigBase):
             _require(
                 not self.use_flash_sdp,
                 "use_math_sdp and use_flash_sdp are mutually exclusive",
+            )
+        _require(
+            self.sdp_backend in ("xla", "pallas"),
+            f"unknown sdp_backend {self.sdp_backend!r}",
+        )
+        if self.sdp_backend == "pallas":
+            _require(
+                self.use_flash_sdp,
+                "sdp_backend='pallas' is the fused flash kernel — "
+                "use_flash_sdp must be set (math accounting would time "
+                "one kernel while modeling another)",
             )
 
 

@@ -250,17 +250,30 @@ class MetaModule:
 
     # -- parameter accounting helper ---------------------------------------
     def make_param_info(self, numel: float, is_moe: bool = False) -> ParamInfo:
-        """Standard Megatron mixed-precision Adam accounting:
-        bf16 weight + fp32 main grad (``use_fp32_accum_grad``) + optimizer
-        state (fp32 master + 2 moments) sharded over dp*cp (edp for MoE
-        params) under ZeRO-1 (reference e.g. ``dense_module.py:448-454``).
+        """Parameter-memory accounting, by optimizer style.
+
+        "megatron": bf16 weight + persistent fp32 main grad
+        (``use_fp32_accum_grad``) + fp32 master + 2 moments (reference
+        e.g. ``dense_module.py:448-454``).
+
+        "functional": what XLA emits for a functional JAX train step
+        with donation — no fp32 master copy (params upcast per leaf
+        inside the fused adam), no persistent grad buffer (the per-leaf
+        update is scheduled into the backward, so only one leaf's grad
+        is in flight — validated against ``compiled.memory_analysis()``
+        on TPU v5e, see docs/memory_validation.md); state = 2 fp32
+        moments.
         """
         st = self.ctx.strategy
         if numel <= 0:
             return ParamInfo()
         w = numel * st.element_size
-        g = numel * st.grad_element_size
-        state = numel * 12.0  # fp32 master + exp_avg + exp_avg_sq
+        if st.optimizer_style == "functional":
+            g = 0.0
+            state = numel * 8.0  # fp32 exp_avg + exp_avg_sq
+        else:
+            g = numel * st.grad_element_size
+            state = numel * 12.0  # fp32 master + exp_avg + exp_avg_sq
         shard = st.edp_size if is_moe else st.dp_size * st.cp_size
         if st.zero_state >= 1:
             state = state / max(1, shard)

@@ -171,20 +171,30 @@ def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
 
 
 def forward(params, ids, cfg: LlamaConfig, sp: bool = False,
-            shard: bool = True):
+            shard: bool = True, remat: bool = False):
     """ids [b, s] int32 -> logits [b, s, vocab] (bf16). ``shard=False``
-    skips sharding constraints for single-device use."""
+    skips sharding constraints for single-device use. ``remat=True``
+    checkpoints each block (full-block activation recompute — the
+    counterpart of the analytical ``full_block`` recompute config)."""
     x = params["embedding"][ids]
-    for p in params["layers"]:
-        x = _block(x, p, cfg, sp, shard)
+    blk = _block
+    if remat:
+        blk = jax.checkpoint(
+            lambda x_, p_: _block(x_, p_, cfg, sp, shard)
+        )
+        for p in params["layers"]:
+            x = blk(x, p)
+    else:
+        for p in params["layers"]:
+            x = blk(x, p, cfg, sp, shard)
     x = _rms_norm(x, params["final_norm"])
     return x @ params["lm_head"]
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, sp: bool = False,
-            shard: bool = True):
+            shard: bool = True, remat: bool = False):
     ids, targets = batch
-    logits = forward(params, ids, cfg, sp, shard).astype(jnp.float32)
+    logits = forward(params, ids, cfg, sp, shard, remat).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return -jnp.mean(ll)
@@ -194,7 +204,7 @@ def loss_fn(params, batch, cfg: LlamaConfig, sp: bool = False,
 
 
 def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
-                    shard: bool = True):
+                    shard: bool = True, remat: bool = False):
     """(params, opt_state, batch) -> (params, opt_state, loss). Adam with
     fp32 moments (mirrors the analytical optimizer accounting)."""
 
@@ -208,7 +218,7 @@ def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, sp,
-                                                  shard)
+                                                  shard, remat)
         step = opt_state["step"] + 1
         b1, b2, eps = 0.9, 0.95, 1e-8
 

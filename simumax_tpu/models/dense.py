@@ -464,17 +464,21 @@ class CoreAttention(LeafModule):
         lse = b * hl * sq * 4
         if st.use_flash_sdp:
             return {"fwd": qo + kv + lse, "bwd_act": 2 * (qo + kv) + lse}
-        # math path materializes the score matrix
-        score = b * hl * sq * skv * e
+        # math path materializes the fp32 score/probs matrices (XLA
+        # computes softmax in fp32 — see docs/memory_validation.md)
+        score = b * hl * sq * skv * 4.0
         return {"fwd": qo + kv + 2 * score, "bwd_act": 2 * (qo + kv) + 4 * score}
 
     def comp_key(self, phase):
+        st = _st(self.ctx)
         b, sq, skv, hl, d, dv = self._dims()
         kvl = self.inputs[1].shape[2]
         causal = self._causal()
+        prefix = "" if st.sdp_backend == "xla" else f"backend={st.sdp_backend}, "
         key = (
-            f"b={b}, sq={sq}, skv={skv}, hn={hl}, kv_hn={kvl}, hd={d}, "
-            f"hd_v={dv}, causal={causal}, dtype={_st(self.ctx).dtype}"
+            f"{prefix}b={b}, sq={sq}, skv={skv}, hn={hl}, kv_hn={kvl}, "
+            f"hd={d}, hd_v={dv}, causal={causal}, "
+            f"flash={st.use_flash_sdp}, dtype={st.dtype}"
         )
         return ("sdp_fwd" if phase == "fwd" else "sdp_bwd", key)
 
@@ -493,9 +497,19 @@ class CoreAttention(LeafModule):
                 + lse
             )
             return ActivationInfo(cache_bytes=cache)
-        score = b * hl * sq * skv * e
-        cache = b * sq * hl * d * e + b * skv * kvl * (d + dv) * e + 2 * score
-        return ActivationInfo(cache_bytes=cache, fwd_temp_bytes=score)
+        # math (XLA composite) path: softmax runs in fp32; the fp32
+        # probs are cached for the backward. No additional transient is
+        # charged: the pre-softmax scores fuse into the probs buffer and
+        # the backward's dS reuses it (anchored against TPU
+        # compiled.memory_analysis() across seq/layers/remat,
+        # docs/memory_validation.md)
+        probs_f32 = b * hl * sq * skv * 4.0
+        cache = (
+            b * sq * hl * d * e
+            + b * skv * kvl * (d + dv) * e
+            + probs_f32
+        )
+        return ActivationInfo(cache_bytes=cache)
 
     def bw_key(self, phase):
         return "default"

@@ -17,6 +17,11 @@ Request vocabulary (yielded by rank coroutines):
   ``peers``; completes at ``max(arrival) + duration`` for everyone
 * ``("send", dst, tag, duration, name, lane)`` — non-blocking post
   (async isend semantics: sender's clock does not advance)
+* ``("send_sync", dst, tag, duration, name, lane)`` — blocking
+  rendezvous send: waits until the matching recv is posted, then both
+  sides complete at ``max(send_post, recv_post) + duration`` (used for
+  unpaired warmup/cooldown sends in blocking pipelines, where the peer
+  is in a recv-only phase — Megatron ``batch_isend_irecv`` semantics)
 * ``("recv", src, tag, name, lane)`` — blocks until the matching send's
   data has arrived (``send_post_time + duration``)
 * ``("advance", t)`` — jump lane clock to at least t
@@ -83,6 +88,7 @@ class SimuEngine:
         self._sends: Dict[tuple, Tuple[float, float]] = {}  # (src,dst,tag) -> (post, dur)
         self._send_seq: Dict[tuple, int] = {}
         self._recv_seq: Dict[tuple, int] = {}
+        self._recv_posts: Dict[tuple, float] = {}  # sync-send rendezvous
         self._flow_ids: Dict[tuple, int] = {}
         self._next_flow = 0
         #: async comm-stream state: per-(stream,peers) chained end time,
@@ -228,14 +234,43 @@ class SimuEngine:
             )
             self._advance_rank(rank, post)
             return True
+        if kind == "send_sync":
+            _, dst, tag, duration, name, *rest = req
+            lane = rest[0] if rest else "pp_fwd"
+            seq = self._send_seq.get((rank, dst, tag), 0)
+            skey = (rank, dst, tag, seq)
+            # rendezvous: wait until the peer posts the matching recv
+            recv_post = self._recv_posts.get(skey)
+            if recv_post is None:
+                return False  # peer not at its recv yet: stay blocked
+            self._send_seq[(rank, dst, tag)] = seq + 1
+            start = max(self.clock[rank], recv_post)
+            end = start + duration
+            # publish as a completed transfer for the recv side
+            self._sends[skey] = (start, duration)
+            fid = self._next_flow
+            self._next_flow += 1
+            self._flow_ids[skey] = fid
+            self.events.append(
+                TraceEvent(rank, lane, name, self.clock[rank], end,
+                           kind="p2p", flow_id=fid)
+            )
+            self.clock[rank] = end
+            self._advance_rank(rank, end)
+            return True
         if kind == "recv":
             _, src, tag, name, *rest = req
             lane = rest[0] if rest else "pp_fwd"
             seq = self._recv_seq.get((rank, src, tag), 0)
             skey = (src, rank, tag, seq)
+            if skey not in self._recv_posts:
+                # record when this recv was first posted (sync sends
+                # rendezvous against it)
+                self._recv_posts[skey] = self.clock[rank]
             if skey not in self._sends:
                 return False  # sender hasn't posted yet
             post, duration = self._sends.pop(skey)
+            self._recv_posts.pop(skey, None)
             self._recv_seq[(rank, src, tag)] = seq + 1
             arrive = max(self.clock[rank], post + duration)
             if arrive > self.clock[rank]:

@@ -466,8 +466,17 @@ class StageProcess:
             yield from self._post_param_gathers()
             ag_join_pending = True
         b_seen = 0
+        f_seen = 0
+        # blocking-pipeline send semantics: warmup forward sends and
+        # cooldown backward sends have a peer in a recv-only phase, so a
+        # true rendezvous (send_sync) is cycle-free there; steady-state
+        # sends are issued as Megatron batched isend/irecv pairs, whose
+        # symmetric-schedule effect equals async-send + a sender stall
+        # of the transfer time (see TODO analysis, commit 03ecd04).
+        warmup = pp - 1 - stage
         for kind, mb in one_f_one_b_order(pp, stage, mbc):
             if kind == "F":
+                f_seen += 1
                 if stage > 0:
                     t = yield ("recv", self._neighbor(stage - 1), f"fwd{mb}",
                                f"recv_fwd{mb}", "pp_fwd")
@@ -480,16 +489,14 @@ class StageProcess:
                     clock[0] = t
                     ag_join_pending = False
                 if stage < pp - 1:
+                    sync = not st.pp_comm_async and f_seen <= warmup
                     t = yield (
-                        "send", self._neighbor(stage + 1), f"fwd{mb}",
+                        "send_sync" if sync else "send",
+                        self._neighbor(stage + 1), f"fwd{mb}",
                         self.p2p_time, f"send_fwd{mb}", "pp_fwd",
                     )
                     clock[0] = t
-                    if not st.pp_comm_async:
-                        # blocking isend approximation: sender stalls for
-                        # the transfer. True rendezvous needs fused
-                        # send/recv pairs (Megatron batch_isend_irecv) —
-                        # unfused blocking sends deadlock in warmup.
+                    if not st.pp_comm_async and not sync:
                         yield ("advance", clock[0] + self.p2p_time)
             else:
                 b_seen += 1
@@ -504,12 +511,14 @@ class StageProcess:
                 yield from self._bwd(mb, clock)
                 yield from self._flush_rs_window()
                 if stage > 0:
+                    sync = not st.pp_comm_async and b_seen > mbc - warmup
                     t = yield (
-                        "send", self._neighbor(stage - 1), f"bwd{mb}",
+                        "send_sync" if sync else "send",
+                        self._neighbor(stage - 1), f"bwd{mb}",
                         self.p2p_time, f"send_bwd{mb}", "pp_bwd",
                     )
                     clock[0] = t
-                    if not st.pp_comm_async:
+                    if not st.pp_comm_async and not sync:
                         yield ("advance", clock[0] + self.p2p_time)
         yield from self._optimizer(clock)
 

@@ -8,7 +8,7 @@ import os
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_strategy_config
+from simumax_tpu.core.config import get_model_config, get_strategy_config
 from simumax_tpu.simulator.engine import DeadlockError, SimuEngine
 
 
@@ -142,6 +142,72 @@ class TestPerfVsSimulator:
         chunk = p.simulate(None, granularity="chunk", track_memory=False)
         assert chunk["end_time"] == pytest.approx(leaf["end_time"], rel=0.01)
         assert chunk["num_events"] < leaf["num_events"] / 10
+
+
+class TestBlockingPipeline:
+    """pp_comm_async=False: warmup forward / cooldown backward sends are
+    true rendezvous (engine send_sync) — the round-1 model was a pure
+    sender-stall approximation everywhere. The warmup grid is the
+    deadlock regression the round-1 experiment failed (commit 03ecd04)."""
+
+    @pytest.mark.parametrize("pp,mbc", [
+        (2, 1), (2, 4), (3, 2), (4, 2), (4, 8),
+    ])
+    def test_blocking_1f1b_no_deadlock_and_agrees(self, pp, mbc):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = pp
+        st.world_size = 2 * pp
+        st.micro_batch_num = mbc
+        st.pp_comm_async = False
+        st.__post_init__()
+        m = get_model_config("llama3-8b")
+        m.layer_num = pp * 2
+        p = run(st, m)
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None, granularity="chunk", track_memory=False)
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.02)
+
+    def test_blocking_vpp_agrees(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        st.pp_comm_async = False
+        st.__post_init__()
+        p = run(st)
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None, granularity="chunk", track_memory=False)
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.02)
+
+    def test_blocking_slower_than_async(self):
+        def t(async_):
+            st = get_strategy_config("tp1_pp2_dp4_mbs1")
+            st.pp_size = 4
+            st.world_size = 8
+            st.micro_batch_num = 8
+            st.pp_comm_async = async_
+            st.__post_init__()
+            m = get_model_config("llama3-8b")
+            m.layer_num = 8
+            p = run(st, m)
+            return p.simulate(None, granularity="chunk",
+                              track_memory=False)["end_time"]
+
+        assert t(False) > t(True)
+
+    def test_blocking_world_rank_parity(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 4
+        st.world_size = 8
+        st.micro_batch_num = 4
+        st.pp_comm_async = False
+        st.__post_init__()
+        m = get_model_config("llama3-8b")
+        m.layer_num = 8
+        p = run(st, m)
+        merged = p.simulate(None, granularity="chunk", track_memory=False)
+        world = p.simulate(None, world_ranks=True, granularity="chunk",
+                           track_memory=False)
+        assert world["end_time"] == pytest.approx(
+            merged["end_time"], rel=1e-9
+        )
 
 
 class TestDpOverlapCrossCheck:

@@ -1,0 +1,135 @@
+"""Build a calibrated TPU system config on the local chip.
+
+TPU counterpart of the reference's one-click config builder
+(``tools/b200/build_current_machine_system_config.py:44-60``): collect
+the efficiency-table keys a family of representative estimates miss,
+measure each on the live accelerator (GEMM layouts, grouped GEMM, int8,
+XLA + Pallas attention, HBM bandwidth classes), and write the populated
+config to ``configs/system/<base>_calibrated.json``.
+
+Usage:  python tools/build_tpu_system_config.py [--out PATH] [--max-keys N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+
+def representative_perfs(system_name):
+    """(strategy overrides, model) pairs whose union of shape keys covers
+    the dense/MoE/int8/math-sdp/pallas families at single-chip shapes."""
+    from simumax_tpu.core.config import StrategyConfig, get_model_config
+
+    def st(**kw):
+        base = dict(
+            world_size=1, tp_size=1, pp_size=1, seq_len=2048,
+            micro_batch_size=1, micro_batch_num=1, zero_state=0,
+            # XLA dot_product_attention == math path on TPU backends
+            use_flash_sdp=False, use_math_sdp=True,
+            use_fp32_accum_grad=True,
+            optimizer_style="functional",
+        )
+        base.update(kw)
+        s = StrategyConfig(**base)
+        s.__post_init__()
+        return s
+
+    bench = get_model_config("bench-llama-0p5b")
+    moe = get_model_config("mixtral-8x1b")
+    llama8b = get_model_config("llama3-8b")
+    flash = dict(use_flash_sdp=True, use_math_sdp=False,
+                 sdp_backend="pallas")
+    cases = [
+        (st(), bench),                                  # bf16 dense, math sdp
+        (st(seq_len=4096), bench),                      # longer seq shapes
+        (st(**flash), bench),                           # pallas flash kernel
+        (st(fp8=True, quant_dtype="int8"), bench),      # int8 matmuls
+        (st(), moe),                                    # grouped gemm + permute
+        (st(fp8=True, quant_dtype="int8"), moe),        # int8 grouped gemm
+        (st(), llama8b),                                # 4096-hidden shapes
+    ]
+    return cases
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--max-keys", type=int, default=None)
+    ap.add_argument("--skip-bandwidth", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "lite" in kind or "v5e" in kind:
+        base = "tpu_v5e_256"
+    else:
+        base = "tpu_v5p_256"
+    print(f"[build] device {kind!r} -> base config {base}")
+
+    from simumax_tpu.calibration.autocal import (
+        calibrate_bandwidth_classes,
+        calibrate_key,
+    )
+    from simumax_tpu.core.config import get_system_config
+    from simumax_tpu.perf import PerfLLM
+
+    system = get_system_config(base)
+    # collect the union of missed shape keys across the family
+    # (run_estimate resets the system's miss record, so harvest after
+    # each case)
+    todo, seen = [], set()
+    for st, model in representative_perfs(base):
+        try:
+            p = PerfLLM().configure(st, model, system)
+            p.run_estimate()
+        except Exception as e:  # a family member may not apply
+            print(f"[build] skip {model.model_name}: {e}")
+            continue
+        for op_key, keys in system.miss_efficiency.items():
+            if system.accelerator.op.get(op_key) is None:
+                continue
+            for shape_key in keys:
+                if (op_key, shape_key) not in seen:
+                    seen.add((op_key, shape_key))
+                    todo.append((op_key, shape_key))
+    if args.max_keys:
+        todo = todo[: args.max_keys]
+    print(f"[build] calibrating {len(todo)} shape keys on the chip")
+    measured = 0
+    for i, (op_key, shape_key) in enumerate(todo):
+        eff = calibrate_key(op_key, shape_key, system)
+        if eff is None:
+            print(f"[build] {i+1}/{len(todo)} {op_key}: unsupported "
+                  f"({shape_key})")
+            continue
+        system.accelerator.op[op_key].accurate_efficient_factor[
+            shape_key
+        ] = round(eff, 4)
+        measured += 1
+        print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> {eff:.3f}")
+    if not args.skip_bandwidth:
+        print("[build] measuring HBM bandwidth classes")
+        for kkey, eff in calibrate_bandwidth_classes(system).items():
+            print(f"[build] bandwidth {kkey}: eff {eff:.3f}")
+
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "configs", "system", f"{base.replace('_256', '')}_calibrated.json",
+    )
+    cfg = system.to_dict()
+    cfg["sys_name"] = os.path.splitext(os.path.basename(out))[0]
+    with open(out, "w") as f:
+        json.dump(cfg, f, indent=2, default=lambda o: vars(o))
+    print(f"[build] wrote {out} ({measured} measured keys)")
+
+
+if __name__ == "__main__":
+    main()
