@@ -15,6 +15,7 @@ Redesign notes (TPU-first):
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 from simumax_tpu.core.config import ModelConfig, StrategyConfig, SystemConfig
@@ -50,6 +51,11 @@ class BuildContext:
         self.paths = paths or {}
         self.debug = PathDebugContext()
         self.graph = None  # Optional[GraphBuilder], set by PerfLLM
+        #: identical-layer dedup fast path (SIMU_NO_LAYER_DEDUP=1 to
+        #: force full evaluation, e.g. for an A/B check)
+        self.layer_dedup = os.environ.get(
+            "SIMU_NO_LAYER_DEDUP", ""
+        ).lower() not in ("1", "true", "yes", "on")
 
     def path(self, dim: str):
         if dim not in self.paths:
@@ -161,6 +167,34 @@ class MetaModule:
         for c in self.children():
             x = c(x)
         return x
+
+    def adopt_call_from(self, rep: "MetaModule", *ins: TensorSpec):
+        """Mark this module called with the same symbolic results as
+        ``rep`` — a structurally identical, already-called sibling —
+        without re-evaluating any leaf cost model (the search-loop
+        layer-dedup fast path; reference memoizes chunk/unit profiles
+        the same way, ``perf_llm.py:69-252,837-1379``).
+
+        Info objects are SHARED with ``rep`` (read-only after the call);
+        the module tree itself stays distinct, so replays and the event
+        simulator that key on leaf identity still work.
+        """
+        assert type(self) is type(rep) and len(self._children) == len(
+            rep._children
+        ), f"adopt_call_from: structure mismatch at {self.path_name()}"
+        self.inputs = tuple(i for i in ins if isinstance(i, TensorSpec))
+        self.outputs = rep.outputs
+        self.compute_info = rep.compute_info
+        self.act_info = rep.act_info
+        self.raw_act_info = rep.raw_act_info
+        self.param_info = rep.param_info
+        self.cost_info = rep.cost_info
+        self.collective_calls = rep.collective_calls
+        for (_, mine), (_, theirs) in zip(self._children, rep._children):
+            if theirs._called:
+                mine.adopt_call_from(theirs, *theirs.inputs)
+        self._called = True
+        return self.outputs if len(self.outputs) != 1 else self.outputs[0]
 
     def _post_forward(self):
         """Composite hook running after forward() but before child-info

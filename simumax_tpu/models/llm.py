@@ -195,13 +195,47 @@ class LLMModel(MetaModule):
             x = self.embedding(x)
             if self.ctx.strategy.enable_dropout:
                 x = self.embedding_dropout(x)
+        # layer dedup: blocks with identical construction signature and
+        # input shape produce identical profiles — evaluate one
+        # representative, adopt for the rest (search-loop scalability;
+        # disabled under graph capture, which needs every real edge, and
+        # under the per-path debug probe, which records per-layer rows)
+        dedup = (
+            self.ctx.layer_dedup
+            and self.ctx.graph is None
+            and not self.ctx.debug.enabled
+        )
+        reps = {}
         for blk in self.blocks:
-            x = blk(x)
+            if not dedup:
+                x = blk(x)
+                continue
+            sig = (
+                blk.is_moe_layer,
+                self._block_recompute_sig(blk),
+                x.shape,
+                x.dtype,
+            )
+            rep = reps.get(sig)
+            if rep is not None:
+                x = blk.adopt_call_from(rep, x)
+            else:
+                x = blk(x)
+                reps[sig] = blk
         if self.postprocess:
             x = self.final_norm(x)
             x = self.lm_head(x)
             x = self.ce(x)
         return x
+
+    @staticmethod
+    def _block_recompute_sig(blk: LLMBlock) -> tuple:
+        """Recompute wiring fingerprint: which leaves are checkpointed
+        and how (layer_recomputes(idx) makes leading layers differ)."""
+        return tuple(
+            (l.in_recompute, l.recompute_status.name)
+            for l in blk.leaves()
+        )
 
     def run(self) -> TensorSpec:
         return self(self.input_spec())

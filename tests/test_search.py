@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from simumax_tpu.core.config import (
     get_model_config,
     get_strategy_config,
@@ -131,3 +133,62 @@ class TestZeroSweep:
         )
         assert not rows1  # zero1 pure-dp cannot fit 8B on 16 GiB
         assert rows3 and all(r["zero"] == 3 for r in rows3)
+
+
+class TestLayerDedup:
+    """Identical-layer dedup (adopt_call_from): estimates must be
+    bit-identical with the fast path on and off, and the fast path must
+    actually skip leaf evaluation."""
+
+    CASES = [
+        ("tp2_pp1_dp4_mbs1", "llama3-8b"),
+        ("tp2_pp1_dp4_mbs1_full_recompute", "llama3-8b"),
+        ("ep4_pp2_dp4_mbs1", "deepseekv2"),  # leading dense layer + MLA
+    ]
+
+    @pytest.mark.parametrize("strat,model", CASES)
+    def test_dedup_parity(self, strat, model, monkeypatch):
+        from simumax_tpu import PerfLLM
+
+        def estimate():
+            p = PerfLLM().configure(strat, model, "tpu_v5p_256")
+            p.run_estimate()
+            return p.analysis_cost(), p.analysis_mem()
+
+        monkeypatch.delenv("SIMU_NO_LAYER_DEDUP", raising=False)
+        c_fast, m_fast = estimate()
+        monkeypatch.setenv("SIMU_NO_LAYER_DEDUP", "1")
+        c_full, m_full = estimate()
+        assert c_fast["iter_time"] == pytest.approx(
+            c_full["iter_time"], rel=1e-12
+        )
+        assert m_fast["max_peak_bytes"] == pytest.approx(
+            m_full["max_peak_bytes"], rel=1e-12
+        )
+        for sf, sl in zip(m_fast["stages"], m_full["stages"]):
+            assert sf["model_bytes"] == pytest.approx(
+                sl["model_bytes"], rel=1e-12
+            )
+
+    def test_partial_recompute_layers_not_merged(self):
+        """recompute_layer_num marks only leading layers — those must
+        not adopt from unrecomputed representatives."""
+        from simumax_tpu import PerfLLM
+        from simumax_tpu.core.config import get_strategy_config
+
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "full_block"
+        st.recompute_layer_num = 3
+        st.__post_init__()
+        p = PerfLLM().configure(st, "llama3-8b", "tpu_v5p_256")
+        p.run_estimate()
+        blocks = p.chunks[(0, 0)].blocks
+        first = next(iter(blocks[0].leaves()))
+        later = next(iter(blocks[5].leaves()))
+        assert first.in_recompute and not later.in_recompute
+        # and their cost infos are distinct objects (not adopted)
+        assert blocks[0].cost_info is not blocks[5].cost_info
+        # positive case: same-signature blocks DO share (fast path on)
+        assert blocks[1].cost_info is blocks[2].cost_info
+        assert blocks[4].cost_info is blocks[5].cost_info
