@@ -29,10 +29,19 @@ logging.disable(logging.WARNING)
 def detect_system():
     import jax
 
+    from simumax_tpu.core.config import list_configs
+
     kind = jax.devices()[0].device_kind.lower()
     if "v5p" in kind or kind == "tpu v5":
-        return "tpu_v5p_256", kind
-    return "tpu_v5e_256", kind  # v5e default (also the fallback)
+        base = "tpu_v5p"
+    else:
+        base = "tpu_v5e"  # v5e default (also the fallback)
+    # prefer the shipped measured tables (built by
+    # tools/build_tpu_system_config.py) over first-principles defaults
+    systems = list_configs()["system"]
+    if f"{base}_calibrated" in systems:
+        return f"{base}_calibrated", kind
+    return f"{base}_256", kind
 
 
 def build_bench_model():

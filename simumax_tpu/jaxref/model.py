@@ -203,10 +203,11 @@ def loss_fn(params, batch, cfg: LlamaConfig, sp: bool = False,
 # -- training step ------------------------------------------------------------
 
 
-def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
-                    shard: bool = True, remat: bool = False):
-    """(params, opt_state, batch) -> (params, opt_state, loss). Adam with
-    fp32 moments (mirrors the analytical optimizer accounting)."""
+def make_fused_adam(loss, lr: float = 1e-4):
+    """(init_opt, train_step) for any ``loss(params, batch)``: Adam with
+    fp32 moments, per-leaf fused update (mirrors the analytical
+    "functional" optimizer accounting). Shared by the dense and MoE
+    reference models so their optimizers cannot desynchronize."""
 
     def init_opt(params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
@@ -217,8 +218,7 @@ def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
         }
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, sp,
-                                                  shard, remat)
+        loss_val, grads = jax.value_and_grad(loss)(params, batch)
         step = opt_state["step"] + 1
         b1, b2, eps = 0.9, 0.95, 1e-8
 
@@ -244,10 +244,20 @@ def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
         return (
             new_params,
             {"mu": new_mu, "nu": new_nu, "step": step},
-            loss,
+            loss_val,
         )
 
     return init_opt, train_step
+
+
+def make_train_step(cfg: LlamaConfig, lr: float = 1e-4, sp: bool = False,
+                    shard: bool = True, remat: bool = False):
+    """(params, opt_state, batch) -> (params, opt_state, loss). Adam with
+    fp32 moments (mirrors the analytical optimizer accounting)."""
+    return make_fused_adam(
+        lambda params, batch: loss_fn(params, batch, cfg, sp, shard, remat),
+        lr,
+    )
 
 
 def make_mesh(

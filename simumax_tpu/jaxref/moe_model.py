@@ -1,0 +1,182 @@
+"""Single-chip MoE reference model (capacity-based expert dispatch).
+
+Measurement counterpart of the analytical MoE stack (Router ->
+Permutation -> grouped GEMMs -> UnPermutation, ``models/moe.py``): the
+token dispatch sorts assignments by expert into a fixed per-expert
+capacity buffer (dropping overflow, like ``moe_capacity_factor``), the
+experts run as balanced grouped GEMMs (one ``[e, cap, h] x [e, h, f]``
+batched matmul per projection — what a TPU MoE actually executes), and
+the combine scatter-adds weighted expert outputs. The
+``jaxref.parallel`` pp-module instead computes every expert densely for
+numerical parity testing — fine for correctness, useless for timing.
+
+Reference for behavior (not code): ``moe_module.py:214-530`` dispatch /
+``835-1289`` grouped GEMMs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from simumax_tpu.jaxref.model import _rms_norm, _rope
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 1024
+    head_num: int = 8
+    kv_head_num: int = 8
+    head_size: int = 128
+    layer_num: int = 4
+    expert_num: int = 8
+    topk: int = 2
+    moe_ffn: int = 1792
+    capacity_factor: float = 2.0
+    rope_theta: float = 1e4
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def from_model_config(cls, m, layer_num: Optional[int] = None,
+                          capacity_factor: float = 2.0):
+        return cls(
+            vocab_size=m.padded_vocab_size or m.vocab_size,
+            hidden_size=m.hidden_size,
+            head_num=m.head_num,
+            kv_head_num=m.kv_head_num,
+            head_size=m.head_size,
+            layer_num=layer_num or m.layer_num,
+            expert_num=m.expert_num,
+            topk=m.topk,
+            moe_ffn=m.moe_ffn_hidden_size,
+            capacity_factor=capacity_factor,
+        )
+
+
+def init_params(cfg: MoeConfig, key) -> Dict:
+    h, d, e = cfg.hidden_size, cfg.head_size, cfg.expert_num
+    q_out = cfg.head_num * d
+    kv_out = cfg.kv_head_num * d
+    ks = iter(jax.random.split(key, 4 + 7 * cfg.layer_num))
+
+    def w(shape, scale=0.02):
+        return (jax.random.normal(next(ks), shape, jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    params = {
+        "embedding": w((cfg.vocab_size, h)),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "lm_head": w((h, cfg.vocab_size)),
+        "layers": [],
+    }
+    for _ in range(cfg.layer_num):
+        params["layers"].append({
+            "input_norm": jnp.ones((h,), cfg.dtype),
+            "qkv": w((h, q_out + 2 * kv_out)),
+            "out": w((q_out, h)),
+            "pre_mlp_norm": jnp.ones((h,), cfg.dtype),
+            "gate": w((h, e)),
+            "moe_up": w((e, h, 2 * cfg.moe_ffn)),
+            "moe_down": w((e, cfg.moe_ffn, h)),
+        })
+    return params
+
+
+def _moe_mlp(y, p, cfg: MoeConfig):
+    """Capacity-based top-k MoE MLP on one chip.
+
+    Grouped-GEMM compute: tokens sorted by expert into [e, cap, h],
+    experts as one batched matmul per projection, weighted scatter-add
+    combine. Overflow beyond ``cap`` is dropped (capacity_factor)."""
+    b, s, h = y.shape
+    T = b * s
+    e, k = cfg.expert_num, cfg.topk
+    cap = int(cfg.capacity_factor * T * k / e)
+
+    yf = y.reshape(T, h)
+    logits = yf @ p["gate"].astype(y.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    topv, topi = jax.lax.top_k(probs, k)
+    topw = (topv / (jnp.sum(topv, -1, keepdims=True) + 1e-9)).astype(y.dtype)
+
+    flat_e = topi.reshape(T * k)
+    flat_w = topw.reshape(T * k)
+    flat_tok = jnp.tile(jnp.arange(T)[:, None], (1, k)).reshape(T * k)
+
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    seg_start = jnp.searchsorted(sorted_e, jnp.arange(e))
+    slot = jnp.arange(T * k) - seg_start[sorted_e]
+    keep = slot < cap
+
+    # permute (dispatch): scatter tokens into the capacity buffer;
+    # overflow slots (slot >= cap) are out of bounds and dropped by
+    # JAX's default scatter mode — do NOT remap them to (0, 0), which
+    # would clobber a genuinely dispatched token
+    xin = jnp.zeros((e, cap, h), y.dtype).at[sorted_e, slot].set(
+        yf[flat_tok[order]], mode="drop"
+    )
+    # grouped GEMMs (balanced groups = one batched matmul each)
+    up = jax.lax.dot_general(
+        xin, p["moe_up"], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=y.dtype,
+    )
+    gate_a, val = jnp.split(up, 2, axis=-1)
+    act = jax.nn.silu(gate_a) * val
+    down = jax.lax.dot_general(
+        act, p["moe_down"], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=y.dtype,
+    )
+    # unpermute (combine): weighted gather back to token order (the
+    # gather clamps out-of-bounds overflow slots; their contribution is
+    # zeroed by the keep mask on the weights)
+    vals = down[sorted_e, jnp.minimum(slot, cap - 1)]
+    vals = vals * (flat_w[order] * keep.astype(y.dtype))[:, None]
+    o = jnp.zeros((T, h), y.dtype).at[flat_tok[order]].add(vals)
+    return o.reshape(b, s, h)
+
+
+def _block(x, p, cfg: MoeConfig):
+    h, d = cfg.hidden_size, cfg.head_size
+    q_out = cfg.head_num * d
+    kv_out = cfg.kv_head_num * d
+    res = x
+    y = _rms_norm(x, p["input_norm"])
+    qkv = y @ p["qkv"]
+    q, kk, v = jnp.split(qkv, [q_out, q_out + kv_out], axis=-1)
+    b, s, _ = q.shape
+    q = _rope(q.reshape(b, s, cfg.head_num, d), cfg.rope_theta)
+    kk = _rope(kk.reshape(b, s, cfg.kv_head_num, d), cfg.rope_theta)
+    v = v.reshape(b, s, cfg.kv_head_num, d)
+    o = jax.nn.dot_product_attention(q, kk, v, is_causal=True)
+    x = res + o.reshape(b, s, q_out) @ p["out"]
+    res = x
+    y = _rms_norm(x, p["pre_mlp_norm"])
+    return res + _moe_mlp(y, p, cfg)
+
+
+def loss_fn(params, batch, cfg: MoeConfig):
+    ids, targets = batch
+    x = params["embedding"][ids]
+    for p in params["layers"]:
+        x = _block(x, p, cfg)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: MoeConfig, lr: float = 1e-4):
+    """Same fused functional Adam as the dense reference (shared
+    ``jaxref.model.make_fused_adam``)."""
+    from simumax_tpu.jaxref.model import make_fused_adam
+
+    return make_fused_adam(
+        lambda params, batch: loss_fn(params, batch, cfg), lr
+    )

@@ -84,6 +84,137 @@ class TestHloCrossCheck:
         assert xla_ag / pred_ag == pytest.approx(1.0, abs=0.3), xla
         assert xla_red / pred_red == pytest.approx(1.0, abs=0.3), xla
 
+    def test_cp_a2a_volumes_match_xla(self):
+        """Ulysses CP re-shard: a seq-sharded [b, s, H, d] tensor
+        re-sharded to head-sharded over the same mesh axis must cost
+        exactly one all-to-all of the full logical tensor — the volume
+        ContextParallelA2A declares (round-2 VERDICT item 6: anchor the
+        a2a accounting for cp layouts against XLA's emitted HLO)."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        cp = 8
+        b, s, H, d = 1, 2048, 16, 64
+
+        # analytical side: build a CP-a2a config and read what the
+        # ContextParallelA2A leaves actually declare
+        mc = ModelConfig(
+            model_name="probe", hidden_size=H * d, head_num=H,
+            kv_head_num=H, head_size=d, intermediate_size=2 * H * d,
+            layer_num=1, vocab_size=2048, make_vocab_size_divisible_by=1,
+        )
+        st = StrategyConfig(
+            world_size=cp, tp_size=1, cp_size=cp, pp_size=1, seq_len=s,
+            micro_batch_size=b, micro_batch_num=1,
+            cp_comm_type="a2a", optimizer_style="functional",
+        )
+        p = PerfLLM().configure(st, mc, "tpu_v5e_256")
+        p.run_estimate()
+        attn = p.chunks[(0, 0)].blocks[0].attention
+        pred_q = [
+            c.size_bytes for c in attn.cp_q.collective_calls
+            if c.phase == "fwd"
+        ]
+        assert pred_q, "cp_q declared no fwd a2a"
+
+        mesh = Mesh(jax.devices("cpu")[:cp], ("cp",))
+
+        def reshard(x):
+            # seq-sharded -> head-sharded (the pre-attention a2a)
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, "cp", None, None))
+            )
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P(None, None, "cp", None))
+            )
+            return y * 2  # keep the reshard live
+
+        with mesh:
+            glob = jnp.zeros((b, s, H, d), jnp.bfloat16)
+            txt = (
+                jax.jit(reshard)
+                .lower(
+                    jax.ShapeDtypeStruct(
+                        glob.shape, glob.dtype,
+                        sharding=NamedSharding(mesh, P(None, "cp", None, None)),
+                    )
+                )
+                .compile()
+                .as_text()
+            )
+        xla = hlo_collective_bytes(txt)
+        # HLO records PER-PARTITION shapes and the CPU backend upcasts
+        # bf16 to f32; the analytical ContextParallelA2A declares the
+        # full logical tensor (per-chip shard x cp) in bf16. Relation:
+        # analytical == xla_per_chip * cp * (2 bytes / 4 bytes).
+        assert pred_q[0] == pytest.approx(
+            xla.get("all-to-all", 0) * cp * 2 / 4, rel=0.01
+        ), (xla, pred_q)
+
+    def test_ep_a2a_dispatch_volumes_anchor_xla(self):
+        """EP a2a token dispatch: the jaxref dryrun uses a dropless
+        capacity buffer of T*k rows per destination (worst case), so
+        XLA's all-to-all bytes must equal the analytical dispatch+combine
+        volume scaled by the capacity padding factor ep (plus the small
+        expert-index a2a). Anchors the Permutation/UnPermutation a2a
+        sizing for ep layouts without hardware."""
+        from simumax_tpu.jaxref.parallel import (
+            PPConfig,
+            init_pp_params,
+            make_pp_mesh,
+            make_pp_train_step,
+        )
+
+        ep = 4
+        cfg = PPConfig(ep_dispatch="a2a", moe_every=1, layers_per_stage=1)
+        mesh = make_pp_mesh(8, pp=1, tp=1, ep=ep, backend="cpu")
+        params, specs = init_pp_params(cfg, mesh, jax.random.PRNGKey(0))
+        train_step = make_pp_train_step(cfg, mesh)(specs)
+        dp = mesh.shape["dp"]
+        b, s = 2 * dp, 64
+        ids = jnp.zeros((b, s), jnp.int32)
+        txt = jax.jit(train_step).lower(
+            params, ids, ids
+        ).compile().as_text()
+        xla = hlo_collective_bytes(txt)
+        # analytical side: the Permutation/UnPermutation leaves of an
+        # equivalent tiny-MoE config declare the dropless dispatch +
+        # combine a2a volume (full logical assignments, bf16)
+        mc = ModelConfig(
+            model_name="probe_moe", model_type="moe",
+            hidden_size=cfg.hidden_size, head_num=cfg.head_num,
+            kv_head_num=cfg.head_num, head_size=cfg.head_size,
+            intermediate_size=cfg.intermediate_size,
+            moe_ffn_hidden_size=cfg.moe_ffn, expert_num=cfg.expert_num,
+            topk=cfg.topk, dense_layers=0, layer_num=1, vocab_size=2048,
+            make_vocab_size_divisible_by=1,
+        )
+        st = StrategyConfig(
+            world_size=8, tp_size=1, pp_size=1, ep_size=ep,
+            seq_len=s, micro_batch_size=b // dp, micro_batch_num=1,
+            moe_capacity_factor=1.0, optimizer_style="functional",
+        )
+        p = PerfLLM().configure(st, mc, "tpu_v5e_256")
+        p.run_estimate()
+        chunk = p.chunks[(0, 0)]
+        pred_a2a = sum(
+            c.size_bytes for c in chunk.collective_calls
+            if c.op == "all2all" and c.dim == "ep"
+        )
+        # relation between the two: the analytical calls declare the
+        # full LOGICAL assignment volume (per-chip bytes x ep, net-op
+        # convention); the jaxref dryrun's per-chip buffer is padded to
+        # a dropless worst case of T*k rows per destination — also a
+        # factor ep over per-chip assignments — so the two coincide and
+        # the only remaining factors are the CPU backend's f32 upcast
+        # (2x bf16) and the extra int32 expert-index a2a.
+        T = b // dp * s
+        k = cfg.topk
+        idx_buf = ep * (T * k) * 4
+        expected_xla = pred_a2a * (4 / 2) + 2 * idx_buf
+        assert xla.get("all-to-all", 0) == pytest.approx(
+            expected_xla, rel=0.02
+        ), (xla, pred_a2a, expected_xla)
+
     def test_tp_volumes_lower_bound_xla(self):
         """tp=2 + SP: the analytical model charges the Megatron-minimal
         activation collectives; XLA's sharding propagation for the
