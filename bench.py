@@ -146,8 +146,7 @@ def main():
 
     fast = bool(os.environ.get("SIMU_BENCH_FAST"))
     calibrated = calibrate_for_perf(perf, max_keys=24 if not fast else 10)
-    perf.run_estimate()
-    perf._cost_result = None
+    perf.run_estimate()  # resets the cached cost/mem results
     pred_cal = perf.analysis_cost()["iter_time"]
 
     err_pct = abs(pred_cal - measured_s) / measured_s * 100.0

@@ -1,0 +1,54 @@
+"""512-chip strategy sweep: full DeepSeek-V2 (60 layers, 160 experts)
+across tp x ep x pp x ZeRO x recompute on two 256-chip v5p slices.
+
+Demonstrates search tractability at depth (reference memoizes
+chunk/unit profiles for the same reason, ``perf_llm.py:69-252``): the
+layer-dedup fast path evaluates one representative LLMBlock per unique
+layer kind, so the whole sweep (~200 estimated candidates) completes
+in about a minute on one CPU core. Parallel dims that exhaust a slice
+spill onto DCN; the report marks which (here: pp).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.search import search_best_parallel_strategy
+
+
+def main():
+    model = get_model_config("deepseekv2")
+    system = get_system_config("tpu_v5p_256")
+    system.num_slices = 2  # 512 chips: 2 slices joined by DCN
+    base = get_strategy_config("ep8_pp1_dp8_mbs1")
+    base.world_size = 512
+    t0 = time.time()
+    top = search_best_parallel_strategy(
+        base, model, system, global_batch_size=1024,
+        tp_list=(1, 2, 4), pp_list=(1, 2, 4, 8), ep_list=(8, 16, 32),
+        zero_list=(1, 3),
+        recompute_types=("none", "selective", "full_block"),
+        topk=5,
+    )
+    dt = time.time() - t0
+    print(f"top strategies, deepseekv2 @ 512x v5p (2 slices), gbs 1024 "
+          f"[swept in {dt:.0f}s]:")
+    for r in top:
+        print(
+            f"  tp{r['tp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
+            f"z{r['zero']} mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
+            f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
+            f"peak {r['peak_gib']:.1f} GiB  dcn_dims={r['dcn_dims'] or '-'}"
+        )
+    return top
+
+
+if __name__ == "__main__":
+    main()

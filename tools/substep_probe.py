@@ -1,0 +1,133 @@
+"""Attribute step-time prediction error to fwd / bwd / optimizer.
+
+Measures three jitted programs on the local chip for the bench model —
+forward-only loss, loss+grads, and the full train step — and compares
+each against the analytical split (fwd cost, fwd+bwd cost, full iter).
+The deltas isolate which modeled term (compute fwd, compute bwd, fused
+adam) carries the error, the same decomposition the reference derives
+from its Megatron timer logs (``tools/b200/run_megatron_perf_real_*``).
+
+The prediction uses ``bench.predict_step`` (the exact config bench.py
+reports on) followed by the same miss-driven self-calibration, so the
+attribution decomposes the *calibrated* prediction whose error bench
+reports.
+
+Usage: python tools/substep_probe.py [--seq N] [--iters N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+
+def measure(mc, seq, iters):
+    """fwd-only and fwd+bwd timings (the full-step timing comes from
+    ``bench.measure_step`` so the probe decomposes the same number
+    bench reports)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from simumax_tpu.calibration.timing import time_fn, time_stateful
+    from simumax_tpu.jaxref.model import LlamaConfig, init_params, loss_fn
+
+    cfg = LlamaConfig.from_model_config(mc)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    ids = jnp.array(rs.randint(0, cfg.vocab_size, (1, seq), np.int32))
+    batch = (ids, ids)
+
+    loss = lambda p, b: loss_fn(p, b, cfg, shard=False)
+    fwd = jax.jit(loss)
+    grad = jax.jit(jax.value_and_grad(loss))
+
+    t_fwd = time_fn(fwd, params, batch, iters=iters)
+    # grads arrive as a pytree; block on the loss scalar per call
+    def run_grad():
+        l, g = grad(params, batch)
+        return l
+
+    t_grad = time_stateful(run_grad, warmup=2, iters=iters)
+    return t_fwd, t_grad
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--system", default=None)
+    args = ap.parse_args()
+
+    from bench import (
+        _tunnel_alive,
+        build_bench_model,
+        detect_system,
+        measure_step,
+        predict_step,
+    )
+
+    from simumax_tpu.calibration import calibrate_for_perf
+    from simumax_tpu.calibration.timing import fetch_rtt
+
+    if not _tunnel_alive():
+        print("no reachable TPU (tunnel down or chip held by another "
+              "process); aborting instead of hanging at backend init")
+        sys.exit(1)
+
+    system_name = args.system or detect_system()[0]
+    mc = build_bench_model()
+    mc.maybe_pad_vocab_size(1)
+
+    t_fwd, t_grad = measure(mc, args.seq, args.iters)
+    t_step, _ = measure_step(mc, seq_len=args.seq, iters=args.iters)
+
+    perf = predict_step(mc, system_name, seq_len=args.seq)
+    calibrate_for_perf(perf, max_keys=24)
+    perf.run_estimate()
+    cost = perf.analysis_cost()
+    ph = cost["stage_phase_inputs"][0]
+    pred = {
+        "fwd": ph["fwd"],
+        "fwd_bwd": ph["fwd"] + ph["bwd"],
+        "iter": cost["iter_time"],
+        "optim": cost["optim_time"],
+    }
+
+    # A measurement shorter than the fetch round trip (or a derived
+    # difference swallowed by RTT jitter) carries no signal — flag it
+    # rather than printing an absurd percentage.
+    rtt = fetch_rtt()
+    floor = 0.1 * rtt
+
+    rows = [
+        ("fwd-only", t_fwd, pred["fwd"]),
+        ("fwd+bwd", t_grad, pred["fwd_bwd"]),
+        ("full step", t_step, pred["iter"]),
+        ("optimizer (step-grad)", t_step - t_grad, pred["optim"]),
+        ("bwd (grad-fwd)", t_grad - t_fwd, pred["fwd_bwd"] - pred["fwd"]),
+    ]
+    out = []
+    for label, meas, prd in rows:
+        if meas <= floor:
+            print(f"{label:24s} measured {meas*1e3:8.2f} ms   "
+                  f"UNRELIABLE (below ~{floor*1e3:.1f} ms RTT noise floor)")
+            out.append({"phase": label, "measured_ms": meas * 1e3,
+                        "predicted_ms": prd * 1e3, "err_pct": None})
+            continue
+        err = (prd - meas) / meas * 100.0
+        print(f"{label:24s} measured {meas*1e3:8.2f} ms   predicted "
+              f"{prd*1e3:8.2f} ms   ({err:+6.1f}%)")
+        out.append({"phase": label, "measured_ms": meas * 1e3,
+                    "predicted_ms": prd * 1e3, "err_pct": err})
+    print(json.dumps({"system": system_name, "rows": out}))
+
+
+if __name__ == "__main__":
+    main()
