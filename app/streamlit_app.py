@@ -1,11 +1,13 @@
-"""Interactive web app (L8) — config picker/editor, runs PerfLLM,
-renders results, offers artifact download.
+"""Interactive web app (L8) — structured config editors, estimate +
+memory + simulator + search tabs, artifact download.
 
-Reference: ``app/streamlit_app.py`` (862 LoC). Requires ``streamlit``
-(not part of the baked environment): ``pip install streamlit`` then
-``streamlit run app/streamlit_app.py``. The same workflows are available
-without extra deps through ``python -m simumax_tpu`` (see
-``simumax_tpu/cli.py``).
+Reference: ``app/streamlit_app.py`` (sidebar per-field editors for
+hardware/parallelism/model, result rendering, zip download). Requires
+``streamlit`` (not part of the baked environment): ``pip install
+streamlit`` then ``streamlit run app/streamlit_app.py``. The same
+workflows are available without extra deps through
+``python -m simumax_tpu`` (see ``simumax_tpu/cli.py``); the full render
+path is exercised headlessly by ``tests/test_app.py``.
 """
 
 import io
@@ -24,6 +26,7 @@ except ImportError:  # pragma: no cover
 
 from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import (
+    ConfigError,
     ModelConfig,
     StrategyConfig,
     get_model_config,
@@ -36,24 +39,73 @@ st.set_page_config(page_title="simumax-tpu", layout="wide")
 st.title("simumax-tpu — analytical LLM training simulator for TPU")
 
 cfgs = list_configs()
-col1, col2, col3 = st.columns(3)
-with col1:
+
+# -- sidebar: structured editors ------------------------------------------
+
+
+def _num(label, value, min_value=1, step=1):
+    return int(st.sidebar.number_input(
+        label, value=int(value), min_value=min_value, step=step
+    ))
+
+
+with st.sidebar:
+    st.subheader("configs")
     model_name = st.selectbox("model", cfgs["models"], index=max(
         cfgs["models"].index("llama3-8b") if "llama3-8b" in cfgs["models"] else 0, 0))
-with col2:
     strategy_name = st.selectbox("strategy", cfgs["strategy"])
-with col3:
     system_name = st.selectbox("system", cfgs["system"])
 
 model = get_model_config(model_name)
 strategy = get_strategy_config(strategy_name)
 
-with st.expander("edit model config"):
+st.sidebar.subheader("parallelism")
+strategy.world_size = _num("world_size", strategy.world_size)
+strategy.tp_size = _num("tp", strategy.tp_size)
+strategy.cp_size = _num("cp", strategy.cp_size)
+strategy.ep_size = _num("ep", strategy.ep_size)
+strategy.pp_size = _num("pp", strategy.pp_size)
+strategy.interleaving_size = _num("vpp chunks", strategy.interleaving_size)
+strategy.zero_state = _num("ZeRO state", strategy.zero_state, min_value=0)
+
+st.sidebar.subheader("batch / sequence")
+strategy.seq_len = _num("seq_len", strategy.seq_len, step=1024)
+strategy.micro_batch_size = _num("micro_batch_size", strategy.micro_batch_size)
+strategy.micro_batch_num = _num("micro_batch_num", strategy.micro_batch_num)
+
+st.sidebar.subheader("recompute")
+_grans = ["none", "full_block", "selective", "attn_only", "mlp_only"]
+_cur_gran = (
+    strategy.recompute_granularity if strategy.enable_recompute else "none"
+)
+gran = st.sidebar.selectbox(
+    "granularity", _grans,
+    index=_grans.index(_cur_gran) if _cur_gran in _grans else 0,
+)
+strategy.enable_recompute = gran != "none"
+if strategy.enable_recompute:
+    strategy.recompute_granularity = gran
+    strategy.recompute_layer_num = _num(
+        "recompute layers (-1 = all)", strategy.recompute_layer_num,
+        min_value=-1,
+    )
+
+st.sidebar.subheader("model overrides")
+model.layer_num = _num("layers", model.layer_num)
+model.hidden_size = _num("hidden_size", model.hidden_size, step=128)
+model.intermediate_size = _num("ffn size", model.intermediate_size, step=128)
+model.head_num = _num("heads", model.head_num)
+model.kv_head_num = _num("kv heads", model.kv_head_num)
+if model.model_type == "moe":
+    model.expert_num = _num("experts", model.expert_num)
+    model.topk = _num("topk", model.topk)
+
+with st.expander("edit raw model json (advanced)"):
     model_json = st.text_area(
         "model json", json.dumps(model.to_dict(), indent=2), height=240
     )
     model = ModelConfig.init_from_dict(json.loads(model_json))
-with st.expander("edit strategy config"):
+with st.expander("edit raw strategy json (advanced)"):
     strategy_json = st.text_area(
         "strategy json", json.dumps(strategy.to_dict(), indent=2, default=str),
         height=240,
@@ -62,35 +114,63 @@ with st.expander("edit strategy config"):
     data.pop("recompute", None)
     strategy = StrategyConfig.init_from_dict(data)
 
+strategy.__post_init__()  # re-derive dp_size/recompute from the edits
+
 run_sim = st.checkbox("also run the event simulator (Chrome trace)")
 
+tab_est, tab_mem, tab_sim, tab_search = st.tabs(
+    ["estimate", "memory", "simulator", "search"]
+)
+
 if st.button("estimate"):
-    perf = PerfLLM().configure(strategy, model, system_name)
+    try:
+        perf = PerfLLM().configure(strategy, model, system_name)
+    except ConfigError as e:
+        st.error(f"infeasible config: {e}")
+        st.stop()
     perf.run_estimate()
     result = perf.analysis(verbose=False)
     cost, mem = result["compute_result"], result["mem_result"]
 
-    c1, c2, c3, c4 = st.columns(4)
-    c1.metric("iteration", f"{cost['iter_time_ms']:.1f} ms")
-    c2.metric("MFU", f"{cost['mfu']*100:.2f} %")
-    c3.metric("TFLOPS/chip", f"{cost['tflops_per_chip']:.1f}")
-    c4.metric(
-        "peak HBM",
-        f"{mem['max_peak_gib']:.2f} GiB",
-        delta="fits" if mem["fits"] else "DOES NOT FIT",
-        delta_color="normal" if mem["fits"] else "inverse",
-    )
-    st.subheader("per-stage memory")
-    st.dataframe(mem["stages"])
-    st.subheader("mesh placement")
-    st.json(result["net_info"])
-    misses = result["efficiency_misses"]
-    if misses:
-        st.info(
-            f"{sum(len(v) for v in misses.values())} efficiency-table "
-            "misses — run `python -m simumax_tpu calibrate` on a TPU to "
-            "refine the prediction."
+    with tab_est:
+        c1, c2, c3, c4 = st.columns(4)
+        c1.metric("iteration", f"{cost['iter_time_ms']:.1f} ms")
+        c2.metric("MFU", f"{cost['mfu']*100:.2f} %")
+        c3.metric("TFLOPS/chip", f"{cost['tflops_per_chip']:.1f}")
+        c4.metric(
+            "peak HBM",
+            f"{mem['max_peak_gib']:.2f} GiB",
+            delta="fits" if mem["fits"] else "DOES NOT FIT",
+            delta_color="normal" if mem["fits"] else "inverse",
         )
+        st.subheader("time breakdown")
+        tb = cost.get("time_breakdown", {})
+        # *_per_microbatch entries are one microbatch; scale them so
+        # every row is per-iteration and the rows sum meaningfully
+        mbc = max(strategy.micro_batch_num, 1)
+        st.dataframe([
+            {
+                "phase": k.replace("_per_microbatch", ""),
+                "ms": round(
+                    v * 1e3 * (mbc if k.endswith("_per_microbatch") else 1),
+                    3,
+                ),
+            }
+            for k, v in tb.items()
+        ])
+        st.subheader("mesh placement")
+        st.json(result["net_info"])
+        misses = result["efficiency_misses"]
+        if misses:
+            st.info(
+                f"{sum(len(v) for v in misses.values())} efficiency-table "
+                "misses — run `python -m simumax_tpu calibrate` on a TPU "
+                "to refine the prediction."
+            )
+
+    with tab_mem:
+        st.subheader("per-stage memory")
+        st.dataframe(mem["stages"])
 
     artifacts = {
         "base_info.json": result["base_info"],
@@ -100,11 +180,34 @@ if st.button("estimate"):
     }
     if run_sim:
         sim = perf.simulate("tmp/app_sim")
-        st.subheader("simulator")
-        st.write(
-            f"event-simulated iteration: {sim['end_time_ms']:.2f} ms "
-            f"({sim['num_events']} events)"
-        )
+        with tab_sim:
+            st.subheader("event simulator")
+            st.write(
+                f"event-simulated iteration: {sim['end_time_ms']:.2f} ms "
+                f"({sim['num_events']} events)"
+            )
+            for m in sim["memory"]:
+                st.write(
+                    f"stage {m['rank']}: simulated peak "
+                    f"{m['peak_gib']:.2f} GiB at {m['peak_time_ms']:.1f} ms"
+                )
+                cats = m.get("peak_by_category") or {}
+                if cats:
+                    st.subheader(f"stage {m['rank']} — who holds the peak")
+                    st.dataframe([
+                        {"holder": k, "GiB": round(v / 2**30, 3)}
+                        for k, v in cats.items()
+                    ])
+            # memory timeline chart from the snapshot artifact
+            snap_path = os.path.join("tmp/app_sim", "simu_memory_snapshot.json")
+            if os.path.exists(snap_path):
+                with open(snap_path) as f:
+                    snaps = json.load(f)
+                for snap in snaps[:1]:
+                    st.line_chart(
+                        {"GiB": [s["bytes"] / 2**30
+                                 for s in snap["timeline"]]},
+                    )
         with open(sim["trace_path"]) as f:
             artifacts["trace.json"] = json.load(f)
 
@@ -114,3 +217,32 @@ if st.button("estimate"):
             z.writestr(name, json.dumps(data, indent=1, default=str))
     st.download_button("download artifacts (.zip)", buf.getvalue(),
                        "simumax_tpu_results.zip")
+
+with tab_search:
+    st.subheader("batch-split search at this layout")
+    gbs = int(st.number_input(
+        "global batch size", value=max(
+            strategy.micro_batch_size * strategy.micro_batch_num
+            * max(strategy.dp_size, 1), 1,
+        ), min_value=1,
+    ))
+    if st.button("search batch split"):
+        from simumax_tpu.search import search_micro_batch_config
+
+        system = get_system_config(system_name)
+        dp = max(strategy.dp_size, 1)
+        if gbs % dp:
+            gbs = max(gbs // dp, 1) * dp
+            st.info(f"global batch size rounded to {gbs} "
+                    f"(must divide by dp={dp})")
+        best = search_micro_batch_config(
+            strategy, model, system, global_batch_size=gbs
+        )
+        if best is None:
+            st.error("no feasible (mbs, mbc) split at this layout")
+        else:
+            st.dataframe([{
+                k: best[k] for k in (
+                    "mbs", "mbc", "mfu", "iter_ms", "peak_gib", "fits"
+                )
+            }])

@@ -123,7 +123,10 @@ def predict_step(mc, system_name, batch_size=1, seq_len=2048):
         # path, not flash (validated: docs/memory_validation.md)
         use_flash_sdp=False,
         use_math_sdp=True,
-        use_fp32_accum_grad=True,
+        # jax.grad of bf16 params yields bf16 cotangents (cast to fp32
+        # only inside the fused adam): bf16 wgrad outputs + 22 B/param
+        # optimizer traffic, unlike Megatron's fp32 main grads
+        use_fp32_accum_grad=False,
         optimizer_style="functional",  # matches the fused JAX adam step
     )
     perf = PerfLLM().configure(st, mc, system_name)

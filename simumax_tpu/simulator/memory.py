@@ -33,6 +33,12 @@ class SimuMemoryTracker:
         self.peak_time = 0.0
         self.timeline: List[MemSample] = [MemSample(0.0, static_bytes, "static")]
         self._tokens: Dict[str, List[float]] = {}
+        #: anonymous (token-less) live bytes by tag, e.g. fwd temps
+        self._anon: Dict[str, float] = {}
+        #: live set captured whenever a new peak is reached — the
+        #: per-token attribution the reference's memory-viz pickle
+        #: carries (``simu_memory.py:212-556``), as plain data
+        self.peak_holders: Dict[str, float] = {}
 
     def alloc(self, t: float, nbytes: float, token: Optional[str] = None,
               tag: str = ""):
@@ -41,10 +47,19 @@ class SimuMemoryTracker:
         assert nbytes > 0, f"negative alloc {nbytes}"
         if token is not None:
             self._tokens.setdefault(token, []).append(nbytes)
+        else:
+            key = f"<{tag or 'anon'}>"
+            self._anon[key] = self._anon.get(key, 0.0) + nbytes
         self.cur += nbytes
         if self.cur > self.peak:
             self.peak = self.cur
             self.peak_time = t
+            self.peak_holders = {
+                k: sum(v) for k, v in self._tokens.items() if v
+            }
+            self.peak_holders.update(
+                {k: v for k, v in self._anon.items() if v}
+            )
         self.timeline.append(MemSample(t, self.cur, tag))
 
     def free(self, t: float, nbytes: float = 0.0,
@@ -62,6 +77,9 @@ class SimuMemoryTracker:
                     f"allocated {expect}, freeing {nbytes}"
                 )
             nbytes = expect
+        else:
+            key = f"<{tag or 'anon'}>"
+            self._anon[key] = max(self._anon.get(key, 0.0) - nbytes, 0.0)
         if nbytes == 0:
             return
         self.cur -= nbytes
@@ -75,6 +93,30 @@ class SimuMemoryTracker:
     def outstanding_tokens(self) -> Dict[str, int]:
         return {k: len(v) for k, v in self._tokens.items() if v}
 
+    @staticmethod
+    def _category(token: str) -> str:
+        """Collapse a live token to its op category: drop the
+        ``mb<N>:`` microbatch prefix and the ``#<id>`` uniquifier, so
+        the same leaf across microbatches aggregates into one row."""
+        cat = token.split(":", 1)[-1] if token.startswith("mb") else token
+        return cat.split("#", 1)[0]
+
+    def peak_by_category(self, top: int = 0) -> Dict[str, float]:
+        """Who holds the memory at the recorded peak, rolled up by op
+        category (plus ``<static>``); sorted descending, optionally
+        truncated to the ``top`` largest with a ``<rest>`` remainder."""
+        cats: Dict[str, float] = {}
+        if self.static_bytes:
+            cats["<static>"] = self.static_bytes
+        for token, nbytes in self.peak_holders.items():
+            key = self._category(token)
+            cats[key] = cats.get(key, 0.0) + nbytes
+        items = sorted(cats.items(), key=lambda kv: -kv[1])
+        if top and len(items) > top:
+            rest = sum(v for _, v in items[top:])
+            items = items[:top] + [("<rest>", rest)]
+        return dict(items)
+
     def summary(self) -> dict:
         return {
             "rank": self.rank,
@@ -84,6 +126,7 @@ class SimuMemoryTracker:
             "peak_time_ms": self.peak_time * 1e3,
             "end_bytes": self.cur,
             "samples": len(self.timeline),
+            "peak_by_category": self.peak_by_category(top=8),
         }
 
     def snapshot(self) -> dict:
@@ -91,6 +134,10 @@ class SimuMemoryTracker:
             "schema": "simumax_tpu_memory_snapshot_v1",
             "rank": self.rank,
             "static_bytes": self.static_bytes,
+            "peak_by_category": self.peak_by_category(),
+            "peak_holders": dict(
+                sorted(self.peak_holders.items(), key=lambda kv: -kv[1])
+            ),
             "timeline": [
                 {"t_ms": s.t * 1e3, "bytes": s.bytes, "tag": s.tag}
                 for s in self.timeline

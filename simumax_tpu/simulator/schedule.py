@@ -249,6 +249,15 @@ class StageProcess:
             )
 
     # -- memory helpers ----------------------------------------------------
+    @staticmethod
+    def _token(mb, leaf, prefix=""):
+        """Cache-token id: readable leaf path for peak attribution plus
+        the object id for uniqueness (two leaves may share a path name,
+        and backward frees in reverse order — a shared FIFO would pop
+        the wrong size)."""
+        name = leaf.path_name().split(".", 1)[-1]
+        return f"mb{mb}:{prefix}{name}#{id(leaf)}"
+
     def _alloc(self, t, nbytes, token=None, tag=""):
         if self.tracker is not None and nbytes:
             self.tracker.alloc(t, nbytes, token, tag)
@@ -285,7 +294,7 @@ class StageProcess:
                 if leaf.act_info.cache_bytes:
                     self._alloc(
                         clock[0], leaf.act_info.cache_bytes,
-                        f"mb{mb}:{id(leaf)}", "act",
+                        self._token(mb, leaf), "act",
                     )
                 for ev in self._comm_events(leaf, "fwd", "post"):
                     t = yield ev
@@ -331,9 +340,9 @@ class StageProcess:
                     for sl in seg_leaves:
                         if sl.raw_act_info.cache_bytes:
                             self._alloc(t, sl.raw_act_info.cache_bytes,
-                                        f"mb{mb}:r{id(sl)}", "recompute")
+                                        self._token(mb, sl, "r:"), "recompute")
                     if saved:
-                        self._free(t, token=f"mb{mb}:{id(seg_leaves[0])}",
+                        self._free(t, token=self._token(mb, seg_leaves[0]),
                                    tag="act")
                     for sl in reversed(seg_leaves):
                         dur = (
@@ -351,7 +360,7 @@ class StageProcess:
                             clock[0] = t
                         self._free(clock[0], flight, tag="temp")
                         if sl.raw_act_info.cache_bytes:
-                            self._free(clock[0], token=f"mb{mb}:r{id(sl)}",
+                            self._free(clock[0], token=self._token(mb, sl, "r:"),
                                        tag="recompute")
                         done.add(id(sl))
                         for ev in self._grad_ready(sl):
@@ -378,7 +387,7 @@ class StageProcess:
                     clock[0] = t
                 self._free(clock[0], flight, tag="temp")
                 if leaf.act_info.cache_bytes:
-                    self._free(clock[0], token=f"mb{mb}:{id(leaf)}",
+                    self._free(clock[0], token=self._token(mb, leaf),
                                tag="act")
                 done.add(id(leaf))
                 for ev in self._grad_ready(leaf):

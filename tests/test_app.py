@@ -29,6 +29,14 @@ class _Recorder:
         self.dataframes = []
         self.jsons = []
         self.infos = []
+        self.errors = []
+        self.charts = []
+
+    @property
+    def sidebar(self):
+        # same recorder: `with st.sidebar:` and `st.sidebar.widget(...)`
+        # both land on the shared assertion surface
+        return self
 
     def _rec(self, name, *a, **k):
         self.calls.append((name, a, k))
@@ -60,8 +68,10 @@ class _Recorder:
 
     def _child(self):
         child = _Recorder()
-        child.metrics = self.metrics  # share the assertion surface
-        child.calls = self.calls
+        # share the whole assertion surface with nested containers
+        for name in ("metrics", "calls", "downloads", "dataframes",
+                     "jsons", "infos", "errors", "charts"):
+            setattr(child, name, getattr(self, name))
         return child
 
     def __enter__(self):
@@ -70,11 +80,23 @@ class _Recorder:
     def __exit__(self, *exc):
         return False
 
+    def tabs(self, labels):
+        self._rec("tabs", tuple(labels))
+        return [self._child() for _ in labels]
+
     # widgets -------------------------------------------------------------
     def selectbox(self, label, options, index=0):
         self._rec("selectbox", label)
         options = list(options)
+        # pick the 95-GiB v5p system so the default llama3-8b layout
+        # fits and the search tab can find a feasible batch split
+        if label == "system" and "tpu_v5p_256" in options:
+            return "tpu_v5p_256"
         return options[index] if options else None
+
+    def number_input(self, label, value=0, min_value=None, step=None):
+        self._rec("number_input", label)
+        return value
 
     def text_area(self, label, value="", height=None):
         self._rec("text_area", label)
@@ -101,6 +123,15 @@ class _Recorder:
     def info(self, msg):
         self.infos.append(msg)
 
+    def error(self, msg):
+        self.errors.append(msg)
+
+    def stop(self):
+        raise AssertionError("st.stop() reached — config was infeasible")
+
+    def line_chart(self, data, **k):
+        self.charts.append(data)
+
     def write(self, *a, **k):
         self._rec("write", *a)
 
@@ -125,14 +156,29 @@ def test_app_renders_estimate_and_simulation(stub_streamlit, tmp_path,
     runpy.run_path("/".join(__file__.split("/")[:-2]) + "/app/streamlit_app.py",
                    run_name="__main__")
     rec = stub_streamlit
+    assert not rec.errors, rec.errors
     # the four headline metrics rendered with plausible values
     assert set(rec.metrics) == {"iteration", "MFU", "TFLOPS/chip", "peak HBM"}
     mfu = float(rec.metrics["MFU"][0].split()[0])
     assert 0.0 < mfu < 100.0
     assert rec.metrics["peak HBM"][1] in ("fits", "DOES NOT FIT")
     # per-stage memory table + mesh placement
-    assert rec.dataframes and isinstance(rec.dataframes[0], list)
+    assert rec.dataframes and all(isinstance(d, list) for d in rec.dataframes)
     assert rec.jsons
+    # simulator tab rendered the peak-attribution table ("who holds the
+    # peak") and the memory timeline chart
+    holder_tables = [
+        d for d in rec.dataframes
+        if d and isinstance(d[0], dict) and "holder" in d[0]
+    ]
+    assert holder_tables, [d[:1] for d in rec.dataframes]
+    assert rec.charts and rec.charts[0]["GiB"]
+    # the search tab found a feasible batch split at the default layout
+    split_tables = [
+        d for d in rec.dataframes
+        if d and isinstance(d[0], dict) and "mbs" in d[0]
+    ]
+    assert split_tables and split_tables[0][0]["fits"]
     # artifact zip contains the result files and the simulator trace
     assert rec.downloads
     _, payload, fname = rec.downloads[0]

@@ -215,6 +215,72 @@ class TestHloCrossCheck:
             expected_xla, rel=0.02
         ), (xla, pred_a2a, expected_xla)
 
+    def test_pp_p2p_volumes_match_xla(self):
+        """Pipeline p2p: each hop of the jaxref manual-SPMD pipeline
+        shifts the stage-boundary activation with ``lax.ppermute``; the
+        per-hop logical volume XLA emits as collective-permute must
+        equal the analytical ``boundary_bytes`` (the tensor every p2p
+        send/recv is costed on). Completes the hardware-free NET_OP
+        anchor set: all_reduce/all_gather/reduce_scatter (FSDP/TP),
+        all2all (CP/EP), and p2p here."""
+        import re
+
+        from simumax_tpu.jaxref.parallel import (
+            PPConfig,
+            init_pp_params,
+            make_pp_mesh,
+            make_pp_train_step,
+        )
+
+        pp, tp = 2, 2
+        cfg = PPConfig(moe_every=0)  # dense stages: pure p2p, no ep a2a
+        mesh = make_pp_mesh(8, pp=pp, tp=tp, ep=1, backend="cpu")
+        params, specs = init_pp_params(cfg, mesh, jax.random.PRNGKey(0))
+        train_step = make_pp_train_step(cfg, mesh)(specs)
+        dp = mesh.shape["dp"]
+        b, s = 2 * dp, 64
+        ids = jnp.zeros((b, s), jnp.int32)
+        txt = jax.jit(train_step).lower(
+            params, ids, ids
+        ).compile().as_text()
+
+        # per-hop element count from the HLO (the CPU backend upcasts
+        # the bf16 payload to f32, so compare elements, not bytes)
+        shapes = re.findall(
+            r"=\s*\w+\[([\d,]+)\][^=\n]*?collective-permute\(", txt
+        )
+        # forward: pp hops (incl. the wrap back to stage 0); backward:
+        # their grad mirrors
+        assert len(shapes) == 2 * pp, shapes
+        elems = set()
+        for dims in shapes:
+            n = 1
+            for d in dims.split(","):
+                n *= int(d)
+            elems.add(n)
+        assert len(elems) == 1, shapes  # every hop moves the same tensor
+
+        # analytical boundary tensor for the equivalent config
+        mc = ModelConfig(
+            model_name="probe_pp", hidden_size=cfg.hidden_size,
+            head_num=cfg.head_num, kv_head_num=cfg.head_num,
+            head_size=cfg.head_size,
+            intermediate_size=cfg.intermediate_size,
+            layer_num=pp * cfg.layers_per_stage, vocab_size=2048,
+            make_vocab_size_divisible_by=1,
+        )
+        st = StrategyConfig(
+            world_size=8, tp_size=tp, pp_size=pp, seq_len=s,
+            micro_batch_size=b // dp, micro_batch_num=1,
+            enable_sequence_parallel=True, optimizer_style="functional",
+        )
+        p = PerfLLM().configure(st, mc, "tpu_v5e_256")
+        p.run_estimate()
+        pred = p.chunks[(0, 0)].boundary_bytes()
+        assert pred == pytest.approx(elems.pop() * 2, rel=0.01), (
+            shapes, pred
+        )
+
     def test_tp_volumes_lower_bound_xla(self):
         """tp=2 + SP: the analytical model charges the Megatron-minimal
         activation collectives; XLA's sharding propagation for the

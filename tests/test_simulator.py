@@ -127,6 +127,26 @@ class TestPerfVsSimulator:
                 s["peak_bytes"], rel=0.08
             )
 
+    def test_peak_attribution_accounts_for_peak(self):
+        """The live-set capture at peak (peak_holders / peak_by_category)
+        must sum to exactly the recorded dynamic peak — per-token
+        attribution of who holds HBM at the worst moment (the
+        reference's memory-viz capability, as plain data)."""
+        p = run("tp1_pp2_dp4_mbs1")
+        sim = p.simulate(None)
+        for m in sim["memory"]:
+            cats = m["peak_by_category"]
+            assert cats, m
+            total = sum(cats.values())
+            assert total == pytest.approx(m["peak_bytes"], rel=1e-6), (
+                total, m["peak_bytes"], cats
+            )
+            # categories are readable op paths, not raw object ids
+            assert any(
+                not k.startswith("<") and not k.split(".")[-1].isdigit()
+                for k in cats
+            ), cats
+
     def test_pp4_runs(self):
         st = get_strategy_config("tp1_pp2_dp4_mbs1")
         st.pp_size = 4

@@ -49,6 +49,7 @@ def representative_perfs(system_name):
     cases = [
         (st(), bench),                                  # bf16 dense, math sdp
         (st(seq_len=4096), bench),                      # longer seq shapes
+        (st(use_fp32_accum_grad=False), bench),         # bf16-grad wgrad keys
         (st(**flash), bench),                           # pallas flash kernel
         (st(fp8=True, quant_dtype="int8"), bench),      # int8 matmuls
         (st(), moe),                                    # grouped gemm + permute
@@ -58,11 +59,45 @@ def representative_perfs(system_name):
     return cases
 
 
+def parse_measured_log(path):
+    """Recover ``(op_key, shape_key) -> eff`` from a previous run's log
+    lines (``[build] i/N op: key -> eff``), so a run interrupted by a
+    tunnel hang resumes instead of re-measuring."""
+    import re
+
+    pat = re.compile(r"^\[build\] \d+/\d+ (\w+): (.+) -> ([\d.]+)$")
+    start_pat = re.compile(r"^\[build\] start (\w+): (.+)$")
+    out, starts = {}, {}
+    try:
+        with open(path) as f:
+            for line in f:
+                m = pat.match(line.strip())
+                if m:
+                    out[(m.group(1), m.group(2))] = float(m.group(3))
+                    continue
+                m = start_pat.match(line.strip())
+                if m:
+                    k = (m.group(1), m.group(2))
+                    starts[k] = starts.get(k, 0) + 1
+    except FileNotFoundError:
+        pass
+    # a key started >=2 times but never completed hung the tunnel both
+    # times: poison it (kept out of the table; its default eff applies)
+    poisoned = {k for k, n in starts.items() if n >= 2 and k not in out}
+    return out, poisoned
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--max-keys", type=int, default=None)
     ap.add_argument("--skip-bandwidth", action="store_true")
+    ap.add_argument(
+        "--resume-log", default=None,
+        help="previous run's stdout log; measured keys found in it are "
+        "applied without re-measuring (run under `timeout` in a retry "
+        "loop to survive tunnel hangs)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -102,9 +137,30 @@ def main():
                     todo.append((op_key, shape_key))
     if args.max_keys:
         todo = todo[: args.max_keys]
-    print(f"[build] calibrating {len(todo)} shape keys on the chip")
+    prior, poisoned = (
+        parse_measured_log(args.resume_log) if args.resume_log else ({}, set())
+    )
+    print(f"[build] calibrating {len(todo)} shape keys on the chip"
+          + (f" ({len(prior)} recovered from log)" if prior else ""))
     measured = 0
     for i, (op_key, shape_key) in enumerate(todo):
+        if (op_key, shape_key) in prior:
+            eff = prior[(op_key, shape_key)]
+            system.accelerator.op[op_key].accurate_efficient_factor[
+                shape_key
+            ] = round(eff, 4)
+            measured += 1
+            # re-emit in the completed-line format so THIS run's log is
+            # also a complete resume source (chained resumes work
+            # without sharing one append-log)
+            print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> "
+                  f"{eff:.3f}", flush=True)
+            continue
+        if (op_key, shape_key) in poisoned:
+            print(f"[build] {i+1}/{len(todo)} {op_key}: skipped "
+                  f"(hung twice) ({shape_key})", flush=True)
+            continue
+        print(f"[build] start {op_key}: {shape_key}", flush=True)
         eff = calibrate_key(op_key, shape_key, system)
         if eff is None:
             print(f"[build] {i+1}/{len(todo)} {op_key}: unsupported "
@@ -114,7 +170,8 @@ def main():
             shape_key
         ] = round(eff, 4)
         measured += 1
-        print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> {eff:.3f}")
+        print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> {eff:.3f}",
+              flush=True)
     if not args.skip_bandwidth:
         print("[build] measuring HBM bandwidth classes")
         for kkey, eff in calibrate_bandwidth_classes(system).items():

@@ -1,0 +1,69 @@
+#!/bin/bash
+# Self-resuming TPU validation pipeline. Waits for the tunnel, then:
+#   1. finishes the calibrated-system-config build (resume + hang skip)
+#   2. peak-HBM validation table  -> docs/memory_validation.md
+#   3. step-time accuracy table   -> docs/accuracy_validation.md
+#   4. sub-step error attribution -> /tmp/substep.json
+# Each stage runs under `timeout` and retries, so a tunnel hang costs
+# one attempt, not the pipeline. Progress to /tmp/tpu_queue.log.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_queue.log
+BUILDLOG=/tmp/build_cfg.log   # cumulative across retries (resume-log)
+
+probe() {
+    timeout 100 python -c "import jax; assert 'tpu' in jax.devices()[0].device_kind.lower()" 2>/dev/null
+}
+
+wait_tunnel() {
+    local n=0
+    until probe; do
+        n=$((n+1))
+        echo "[queue] tunnel down (probe $n); sleeping 120s" >> "$LOG"
+        sleep 120
+        if [ "$n" -ge 40 ]; then
+            echo "[queue] giving up after $n probes" >> "$LOG"
+            exit 1
+        fi
+    done
+    echo "[queue] tunnel alive" >> "$LOG"
+}
+
+echo "[queue] start $(date -u +%H:%M:%S)" > "$LOG"
+
+# -- 1. calibrated system config (resumable) --
+for attempt in 1 2 3 4 5 6; do
+    wait_tunnel
+    echo "[queue] build attempt $attempt" >> "$LOG"
+    timeout 1500 python tools/build_tpu_system_config.py \
+        --resume-log "$BUILDLOG" >> "$BUILDLOG" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        echo "[queue] build done" >> "$LOG"
+        break
+    fi
+    echo "[queue] build rc=$rc; retrying" >> "$LOG"
+done
+
+# -- 2. memory validation table --
+for attempt in 1 2 3; do
+    wait_tunnel
+    echo "[queue] memory table attempt $attempt" >> "$LOG"
+    timeout 1800 python tools/validate_memory_table.py >> "$LOG" 2>&1 && break
+done
+
+# -- 3. accuracy table --
+for attempt in 1 2 3; do
+    wait_tunnel
+    echo "[queue] accuracy table attempt $attempt" >> "$LOG"
+    timeout 2400 python tools/accuracy_table.py >> "$LOG" 2>&1 && break
+done
+
+# -- 4. substep probe --
+for attempt in 1 2; do
+    wait_tunnel
+    echo "[queue] substep probe attempt $attempt" >> "$LOG"
+    timeout 1200 python tools/substep_probe.py > /tmp/substep.json 2>>"$LOG" && break
+done
+
+echo "[queue] ALL DONE $(date -u +%H:%M:%S)" >> "$LOG"
