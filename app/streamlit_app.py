@@ -230,7 +230,14 @@ with tab_search:
         from simumax_tpu.search import search_micro_batch_config
 
         system = get_system_config(system_name)
-        dp = max(strategy.dp_size, 1)
+        dp = strategy.dp_size
+        if dp < 1:
+            st.error(
+                f"infeasible layout: world_size {strategy.world_size} < "
+                f"tp*cp*pp = "
+                f"{strategy.tp_size * strategy.cp_size * strategy.pp_size}"
+            )
+            st.stop()
         if gbs % dp:
             gbs = max(gbs // dp, 1) * dp
             st.info(f"global batch size rounded to {gbs} "

@@ -356,6 +356,12 @@ def attention(q, k, v, causal: bool = True, use_pallas=None):
     mesh on a TPU host)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    if use_pallas and k.shape[2] == q.shape[2]:
+    # production gate: the Pallas kernel tiles (block, d) VMEM blocks —
+    # off-lane shapes (seq not a multiple of the 128-lane tile, head
+    # dim not lane-aligned) would make _fit_block degrade to slivers;
+    # XLA's fused attention handles those shapes better
+    sq, skv, d = q.shape[1], k.shape[1], q.shape[3]
+    aligned = sq % 128 == 0 and skv % 128 == 0 and d % 128 == 0
+    if use_pallas and aligned and k.shape[2] == q.shape[2]:
         return flash_attention(q, k, v, causal)
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)

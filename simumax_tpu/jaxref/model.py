@@ -38,9 +38,14 @@ class LlamaConfig:
     layer_num: int = 4
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    #: route core attention through the Pallas flash kernel (TPU only,
+    #: lane-aligned shapes; GQA kv heads broadcast upstream — the
+    #: layout the ``sdp_backend="pallas"`` analytical keys cost)
+    use_pallas_attn: bool = False
 
     @classmethod
-    def from_model_config(cls, m, layer_num: Optional[int] = None):
+    def from_model_config(cls, m, layer_num: Optional[int] = None,
+                          use_pallas_attn: bool = False):
         """Build from a simumax_tpu ModelConfig (analytical <-> measured
         parity)."""
         return cls(
@@ -51,6 +56,7 @@ class LlamaConfig:
             head_size=m.head_size,
             intermediate_size=m.intermediate_size,
             layer_num=layer_num or m.layer_num,
+            use_pallas_attn=use_pallas_attn,
         )
 
 
@@ -150,7 +156,17 @@ def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
     v = v.reshape(b, s, cfg.kv_head_num, d)
     if shard:
         q = jax.lax.with_sharding_constraint(q, P("dp", None, "tp", None))
-    o = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    if cfg.use_pallas_attn and not shard:
+        from simumax_tpu.jaxref.kernels import attention as _pallas_attn
+
+        kk, vv = k, v
+        if cfg.kv_head_num < cfg.head_num:  # kernel wants MHA layout
+            rep = cfg.head_num // cfg.kv_head_num
+            kk = jnp.repeat(k, rep, axis=2)
+            vv = jnp.repeat(v, rep, axis=2)
+        o = _pallas_attn(q, kk, vv, causal=True)
+    else:
+        o = jax.nn.dot_product_attention(q, k, v, is_causal=True)
     x = res + o.reshape(b, s, q_out) @ p["out"]
     res = x
     y = _rms_norm(x, p["pre_mlp_norm"])

@@ -33,37 +33,47 @@ class SimuMemoryTracker:
         self.peak_time = 0.0
         self.timeline: List[MemSample] = [MemSample(0.0, static_bytes, "static")]
         self._tokens: Dict[str, List[float]] = {}
-        #: anonymous (token-less) live bytes by tag, e.g. fwd temps
-        self._anon: Dict[str, float] = {}
-        #: live set captured whenever a new peak is reached — the
-        #: per-token attribution the reference's memory-viz pickle
-        #: carries (``simu_memory.py:212-556``), as plain data
+        #: running live-bytes total per token / anon-tag (kept
+        #: incrementally so peak capture is not quadratic)
+        self._live: Dict[str, float] = {}
+        #: live set captured at the recorded peak — the per-token
+        #: attribution the reference's memory-viz pickle carries
+        #: (``simu_memory.py:212-556``), as plain data. Copied lazily:
+        #: while the peak keeps rising only a flag flips; the O(live)
+        #: copy happens once, when the plateau ends.
         self.peak_holders: Dict[str, float] = {}
+        self._peak_pending = False
+
+    def _flush_peak(self):
+        self.peak_holders = {k: v for k, v in self._live.items() if v}
+        self._peak_pending = False
 
     def alloc(self, t: float, nbytes: float, token: Optional[str] = None,
               tag: str = ""):
         if nbytes == 0:
             return
         assert nbytes > 0, f"negative alloc {nbytes}"
+        if self._peak_pending and self.cur + nbytes <= self.peak:
+            # this alloc does not extend the peak: _live still holds
+            # exactly the peak-time set, capture it before mutating
+            self._flush_peak()
         if token is not None:
             self._tokens.setdefault(token, []).append(nbytes)
+            key = token
         else:
             key = f"<{tag or 'anon'}>"
-            self._anon[key] = self._anon.get(key, 0.0) + nbytes
+        self._live[key] = self._live.get(key, 0.0) + nbytes
         self.cur += nbytes
         if self.cur > self.peak:
             self.peak = self.cur
             self.peak_time = t
-            self.peak_holders = {
-                k: sum(v) for k, v in self._tokens.items() if v
-            }
-            self.peak_holders.update(
-                {k: v for k, v in self._anon.items() if v}
-            )
+            self._peak_pending = True
         self.timeline.append(MemSample(t, self.cur, tag))
 
     def free(self, t: float, nbytes: float = 0.0,
              token: Optional[str] = None, tag: str = ""):
+        if self._peak_pending:
+            self._flush_peak()  # the live set still equals the peak set
         if token is not None:
             fifo = self._tokens.get(token)
             if not fifo:
@@ -77,9 +87,10 @@ class SimuMemoryTracker:
                     f"allocated {expect}, freeing {nbytes}"
                 )
             nbytes = expect
+            key = token
         else:
             key = f"<{tag or 'anon'}>"
-            self._anon[key] = max(self._anon.get(key, 0.0) - nbytes, 0.0)
+        self._live[key] = max(self._live.get(key, 0.0) - nbytes, 0.0)
         if nbytes == 0:
             return
         self.cur -= nbytes
@@ -105,6 +116,8 @@ class SimuMemoryTracker:
         """Who holds the memory at the recorded peak, rolled up by op
         category (plus ``<static>``); sorted descending, optionally
         truncated to the ``top`` largest with a ``<rest>`` remainder."""
+        if self._peak_pending:
+            self._flush_peak()
         cats: Dict[str, float] = {}
         if self.static_bytes:
             cats["<static>"] = self.static_bytes
@@ -130,6 +143,8 @@ class SimuMemoryTracker:
         }
 
     def snapshot(self) -> dict:
+        if self._peak_pending:
+            self._flush_peak()
         return {
             "schema": "simumax_tpu_memory_snapshot_v1",
             "rank": self.rank,

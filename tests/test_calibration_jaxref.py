@@ -146,6 +146,28 @@ class TestJaxRef:
             losses[tp] = float(loss)
         assert losses[1] == pytest.approx(losses[4], rel=2e-2)
 
+    def test_pallas_attn_option_matches_default(self):
+        """``use_pallas_attn`` routes through the kernel dispatcher (on
+        CPU it falls back to XLA after the GQA broadcast) — the loss
+        must match the plain path exactly, proving the broadcast
+        plumbing is numerically transparent."""
+        from simumax_tpu.jaxref.model import (
+            LlamaConfig,
+            init_params,
+            loss_fn,
+        )
+
+        kw = dict(vocab_size=512, hidden_size=256, head_num=2,
+                  kv_head_num=1, head_size=128, intermediate_size=512,
+                  layer_num=2)
+        cfg0 = LlamaConfig(**kw)
+        cfg1 = LlamaConfig(use_pallas_attn=True, **kw)
+        params = init_params(cfg0, jax.random.PRNGKey(0))
+        ids = jnp.zeros((1, 128), jnp.int32)
+        l0 = float(loss_fn(params, (ids, ids), cfg0, shard=False))
+        l1 = float(loss_fn(params, (ids, ids), cfg1, shard=False))
+        assert l0 == pytest.approx(l1, rel=1e-5)
+
     def test_graft_entry(self):
         import __graft_entry__ as g
 

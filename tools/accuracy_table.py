@@ -62,6 +62,7 @@ ROWS = [
     ("llama-0.5B seq4096", "dense", 4096, 1, 6, False),
     ("llama-0.5B remat", "dense", 2048, 1, 6, True),
     ("llama-0.5B mbs2", "dense", 1024, 2, 6, False),
+    ("llama-0.5B flash(pallas)", "flash", 2048, 1, 6, False),
     ("moe-8e-top2 bf16", "moe", 2048, 1, 4, False),
 ]
 
@@ -93,7 +94,9 @@ def measure(kind, mc, seq, mbs, layers, remat, iters=8):
             make_train_step,
         )
 
-        cfg = LlamaConfig.from_model_config(mc, layer_num=layers)
+        cfg = LlamaConfig.from_model_config(
+            mc, layer_num=layers, use_pallas_attn=(kind == "flash")
+        )
         params = init_params(cfg, jax.random.PRNGKey(0))
         init_opt, train_step = make_train_step(
             cfg, shard=False, remat=remat
@@ -110,16 +113,19 @@ def measure(kind, mc, seq, mbs, layers, remat, iters=8):
     return time_stateful(run, warmup=2, iters=iters)
 
 
-def predict(mc, seq, mbs, layers, remat, system):
+def predict(mc, seq, mbs, layers, remat, system, kind="dense"):
     from simumax_tpu.core.config import StrategyConfig
     from simumax_tpu.perf import PerfLLM
 
     mc.layer_num = layers
+    flash = kind == "flash"
     st = StrategyConfig(
         world_size=1, tp_size=1, pp_size=1, seq_len=seq,
         micro_batch_size=mbs, micro_batch_num=1, zero_state=0,
-        use_flash_sdp=False, use_math_sdp=True,
-        use_fp32_accum_grad=True, optimizer_style="functional",
+        use_flash_sdp=flash, use_math_sdp=not flash,
+        sdp_backend="pallas" if flash else "xla",
+        # jax.grad of bf16 params yields bf16 cotangents (see bench.py)
+        use_fp32_accum_grad=False, optimizer_style="functional",
         enable_recompute=remat, recompute_granularity="full_block",
         moe_capacity_factor=2.0,
     )
@@ -152,7 +158,7 @@ def main():
     for label, kind, seq, mbs, layers, remat in rows:
         mc = moe_model() if kind == "moe" else dense_model()
         measured = measure(kind, mc, seq, mbs, layers, remat)
-        p = predict(mc, seq, mbs, layers, remat, system)
+        p = predict(mc, seq, mbs, layers, remat, system, kind)
         pred_shipped = p.analysis_cost()["iter_time"]
         n_cal = sum(
             len(v) for v in calibrate_for_perf(p, max_keys=24).values()
