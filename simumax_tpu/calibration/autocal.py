@@ -183,10 +183,13 @@ def measure_sdp_efficiency(
     k = _test_array((b, skv, kv_hn, hd), dt)
     v = _test_array((b, skv, kv_hn, hd_v), dt)
     if backend == "pallas":
+        from simumax_tpu.core.utils import pallas_attention_supported
         from simumax_tpu.jaxref.kernels import flash_attention
 
         if hd != hd_v:
             return None  # kernel assumes one head dim
+        if not pallas_attention_supported(sq, skv, hd):
+            return None  # runtime would fall back to XLA (shared gate)
         if kv_hn != hn:
             k = jnp.repeat(k, hn // kv_hn, axis=2)
             v = jnp.repeat(v, hn // kv_hn, axis=2)

@@ -354,7 +354,12 @@ class MetaModule:
             op_key, shape_key = self.comp_key(phase)
             comp_t = sysc.compute_op_accuracy_time(op_key, f, shape_key)
             mem_t = sysc.compute_mem_access_time(b, self.bw_key(phase)) if b > 0 else 0.0
-            cost.compute.add(phase, sysc.compute_end2end_time(comp_t, mem_t))
+            t = sysc.compute_end2end_time(comp_t, mem_t)
+            cost.compute.add(phase, t)
+            # HBM is busy for mem_t within the rooflined time (capped:
+            # compute_only mode drops the mem term from t entirely);
+            # compute - mem_bound per phase is the HBM-idle slack
+            cost.mem_bound.add(phase, min(mem_t, t))
         for call in self.collective_calls:
             path = self.ctx.path(call.dim)
             call.time = sysc.compute_net_op_time(call.op, call.size_bytes, path)

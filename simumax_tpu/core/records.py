@@ -171,6 +171,11 @@ class CostInfo:
     compute: _PhaseTimes = field(default_factory=_PhaseTimes)
     net_exposed: _PhaseTimes = field(default_factory=_PhaseTimes)
     net_hidden: _PhaseTimes = field(default_factory=_PhaseTimes)
+    #: HBM-access component of each rooflined phase (mem_t before the
+    #: max(comp, mem) combiner). ``compute - mem_bound`` per phase is
+    #: the MXU-bound slack an async HBM stream (e.g. a fused optimizer
+    #: update under a single jit) can hide inside.
+    mem_bound: _PhaseTimes = field(default_factory=_PhaseTimes)
     recompute_time: float = 0.0  # extra fwd replay before bwd_act
 
     def __add__(self, other):
@@ -180,6 +185,7 @@ class CostInfo:
             compute=self.compute + other.compute,
             net_exposed=self.net_exposed + other.net_exposed,
             net_hidden=self.net_hidden + other.net_hidden,
+            mem_bound=self.mem_bound + other.mem_bound,
             recompute_time=self.recompute_time + other.recompute_time,
         )
 

@@ -53,3 +53,15 @@ def humanize_result(d: Any) -> Any:
     if isinstance(d, list):
         return [humanize_result(x) for x in d]
     return d
+
+
+def pallas_attention_supported(sq: int, skv: int, d: int) -> bool:
+    """Production shape gate for the Pallas flash kernel, shared by the
+    runtime dispatcher (``jaxref.kernels.attention``), the calibration
+    sweep, and the analytical ``sdp_backend="pallas"`` sanity check —
+    one predicate so prediction and measurement cannot silently pick
+    different backends. The kernel tiles (block, d) VMEM blocks;
+    off-lane shapes (seq or head dim not multiples of the 128-lane
+    tile) would degrade to sliver blocks, and XLA's fused attention
+    handles them better."""
+    return sq % 128 == 0 and skv % 128 == 0 and d % 128 == 0

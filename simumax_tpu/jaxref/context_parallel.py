@@ -1,0 +1,210 @@
+"""Context-parallel attention references (long-context, multi-chip).
+
+Two mechanisms, both as manual-SPMD ``shard_map`` bodies over a ``cp``
+mesh axis, matching what the analytical model costs:
+
+* :func:`ulysses_attention` — a2a head-scatter (reference
+  ``dense_module.py:1158-1232``): seq-sharded activations are
+  re-sharded to head-sharded with one ``all_to_all`` before attention
+  (full seq, ``H/cp`` local heads) and back after. The analytical
+  ``ContextParallelA2A`` charges exactly these transfers.
+* :func:`ring_attention` — blockwise ring with online-softmax
+  accumulation: KV blocks rotate around the cp ring via ``ppermute``
+  while every chip keeps its own queries; causal masking uses global
+  positions so the result is exact. This is the mechanism the
+  analytical ``KVAllGather`` CP mode costs (the reference repo leaves
+  its FLOPs path ``NotImplementedError``; here the real kernel exists
+  too).
+
+Both are numerically anchored against single-device full attention in
+``tests/test_context_parallel.py`` on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# -- Ulysses (a2a head-scatter) ---------------------------------------------
+
+
+def ulysses_attention(q, k, v, axis: str = "cp", causal: bool = True):
+    """Inside shard_map: q [b, s/cp, H, d], k/v [b, s/cp, Hkv, d]
+    seq-sharded over ``axis``. Requires H % cp == 0 (and Hkv % cp == 0
+    — replicate kv heads upstream otherwise, the cost the analytical
+    model charges for GQA under Ulysses)."""
+    cp = jax.lax.axis_size(axis)
+
+    def scatter_heads(x):
+        # [b, s_loc, H, d] -> [b, s, H/cp, d]: split heads across the
+        # axis, gather the seq dim
+        return jax.lax.all_to_all(
+            x, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    if cp == 1:
+        return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+    o = jax.nn.dot_product_attention(
+        scatter_heads(q), scatter_heads(k), scatter_heads(v),
+        is_causal=causal,
+    )
+    return gather_heads(o)
+
+
+# -- ring attention (blockwise, online softmax) ------------------------------
+
+
+def ring_attention(q, k, v, axis: str = "cp", causal: bool = True):
+    """Inside shard_map: q/k/v [b, s/cp, H, d] seq-sharded over
+    ``axis`` (contiguous blocks, block i = ranks i's tokens). KV blocks
+    rotate around the ring; each step accumulates the partial softmax
+    (flash-style m/l carry) with exact global-position causal masking.
+
+    GQA: kv heads are broadcast to q heads locally (H == Hkv * g).
+    """
+    cp = jax.lax.axis_size(axis)
+    b, s_loc, H, d = q.shape
+    if k.shape[2] != H:
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if cp == 1:
+        return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+
+    idx = jax.lax.axis_index(axis)
+    scale = 1.0 / np.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    # global positions of my queries; kv positions depend on the block
+    # currently held (its origin rank)
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+
+    # accumulate in [b, H, s_loc, d] layout
+    acc = jnp.zeros((b, H, s_loc, d), jnp.float32)
+    m = jnp.full((b, H, s_loc), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, H, s_loc), jnp.float32)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, j):
+        acc, m, l, kc, vc = carry
+        # block currently held started at rank (idx - j) mod cp
+        src = (idx - j) % cp
+        kv_pos = src * s_loc + jnp.arange(s_loc)
+        # scores [b, H, s_q, s_kv]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)
+        )
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        m_new = jnp.maximum(m, scores.max(-1))
+        # fully-masked rows keep m=-inf; guard the exp shift
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - shift), 0.0
+        )
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+        )
+        # rotate kv to the next rank for the following step
+        kc = jax.lax.ppermute(kc, axis, perm)
+        vc = jax.lax.ppermute(vc, axis, perm)
+        return (acc, m_new, l, kc, vc), None
+
+    carry = (acc, m, l, k, v)
+    # static unroll: cp is a mesh constant, and each step carries a
+    # ppermute (scan would also work; unroll keeps the HLO inspectable)
+    for j in range(cp):
+        carry, _ = step(carry, j)
+    acc, m, l, _, _ = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+# -- a tiny attention-block training step over a (dp, cp) mesh ---------------
+
+
+def make_cp_mesh(n_devices: int, cp: int, backend: Optional[str] = None):
+    devices = jax.devices(backend) if backend else jax.devices()
+    if len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    devices = devices[:n_devices]
+    dp = n_devices // cp
+    assert dp * cp == n_devices, (n_devices, cp)
+    return Mesh(np.array(devices).reshape(dp, cp), ("dp", "cp"))
+
+
+def run_cp_dryrun(
+    n_devices: int, cp: int = 2, mechanism: str = "ring",
+    seq: int = 256, hidden: int = 256, heads: int = 8,
+    backend: Optional[str] = None,
+) -> float:
+    """One fwd+bwd+SGD step of a single attention block with seq
+    sharded over cp (long-context layout): loss on the attention
+    output, gradients flow back through the a2a / ring collectives.
+    Returns the loss (finite => compiled and executed)."""
+    mesh = make_cp_mesh(n_devices, cp, backend=backend)
+    d = hidden // heads
+    key = jax.random.PRNGKey(0)
+    kq, kw, kx = jax.random.split(key, 3)
+    params = {
+        "qkv": (jax.random.normal(kq, (hidden, 3 * hidden), jnp.float32)
+                * 0.05).astype(jnp.bfloat16),
+        "out": (jax.random.normal(kw, (hidden, hidden), jnp.float32)
+                * 0.05).astype(jnp.bfloat16),
+    }
+    dp = mesh.shape["dp"]
+    x = (jax.random.normal(kx, (2 * dp, seq, hidden), jnp.float32)
+         * 0.1).astype(jnp.bfloat16)
+
+    attn = ring_attention if mechanism == "ring" else ulysses_attention
+
+    def spmd_loss(p, xx):
+        b, s_loc, h = xx.shape
+        qkv = xx @ p["qkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s_loc, heads, d)
+        k = k.reshape(b, s_loc, heads, d)
+        v = v.reshape(b, s_loc, heads, d)
+        o = attn(q, k, v, axis="cp", causal=True)
+        y = o.reshape(b, o.shape[1], h) @ p["out"]
+        return jax.lax.pmean(
+            jax.lax.pmean(jnp.mean(jnp.square(y.astype(jnp.float32))), "cp"),
+            "dp",
+        )
+
+    loss_sharded = jax.shard_map(
+        spmd_loss,
+        mesh=mesh,
+        in_specs=(P(), P("dp", "cp", None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def train_step(p, xx):
+        loss, grads = jax.value_and_grad(
+            lambda pp: loss_sharded(pp, xx)
+        )(p)
+        p = jax.tree.map(lambda w, g: w - 1e-3 * g.astype(w.dtype), p, grads)
+        return p, loss
+
+    with mesh:
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp", "cp", None)))
+        _, loss = train_step(params, xs)
+        loss = float(loss)
+    assert np.isfinite(loss), loss
+    return loss

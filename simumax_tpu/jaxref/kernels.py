@@ -347,6 +347,13 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# single source of truth for the flash-kernel shape gate, shared with
+# the analytical config check (jax-free module) and the calibration
+# sweep so prediction and measurement cannot silently pick different
+# backends
+from simumax_tpu.core.utils import pallas_attention_supported  # noqa: E402
+
+
 def attention(q, k, v, causal: bool = True, use_pallas=None):
     """Attention with backend dispatch: the differentiable Pallas flash
     kernel on TPU (MHA layout — broadcast GQA kv heads upstream), XLA's
@@ -356,12 +363,10 @@ def attention(q, k, v, causal: bool = True, use_pallas=None):
     mesh on a TPU host)."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
-    # production gate: the Pallas kernel tiles (block, d) VMEM blocks —
-    # off-lane shapes (seq not a multiple of the 128-lane tile, head
-    # dim not lane-aligned) would make _fit_block degrade to slivers;
-    # XLA's fused attention handles those shapes better
-    sq, skv, d = q.shape[1], k.shape[1], q.shape[3]
-    aligned = sq % 128 == 0 and skv % 128 == 0 and d % 128 == 0
-    if use_pallas and aligned and k.shape[2] == q.shape[2]:
+    if (
+        use_pallas
+        and pallas_attention_supported(q.shape[1], k.shape[1], q.shape[3])
+        and k.shape[2] == q.shape[2]
+    ):
         return flash_attention(q, k, v, causal)
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)

@@ -90,6 +90,22 @@ class PerfBase:
             _require(
                 m.expert_num % st.ep_size == 0, "expert_num % ep != 0"
             )
+        if st.use_flash_sdp and st.sdp_backend == "pallas":
+            # same predicate the runtime dispatcher applies — reject
+            # configs whose measurement would silently fall back to XLA
+            # while the estimate charged Pallas rates
+            from simumax_tpu.core.utils import pallas_attention_supported
+
+            s_attn = st.seq_len // (
+                st.cp_size if st.cp_comm_type == "all_gather" else 1
+            )
+            _require(
+                pallas_attention_supported(s_attn, s_attn, m.head_size),
+                f"sdp_backend='pallas' needs lane-aligned attention "
+                f"shapes (seq {s_attn}, head_size {m.head_size} must be "
+                f"multiples of 128) — the runtime kernel would fall "
+                f"back to XLA; use sdp_backend='xla'",
+            )
         if st.fp8:
             needed = [f"{st.quant_dtype}_matmul"]
             if m.model_type == "moe":
@@ -803,10 +819,14 @@ class PerfLLM(PerfBase):
         net_exposed = sum(c.cost_info.total_net_exposed for c in chunks0)
         compute_mb = sum(c.cost_info.compute.total for c in chunks0)
         recompute_mb = sum(c.cost_info.recompute_time for c in chunks0)
+        # HBM-busy share of the rooflined compute (diagnostic: the
+        # remainder is MXU-bound slack an async HBM stream could hide in)
+        hbm_busy_mb = sum(c.cost_info.mem_bound.total for c in chunks0)
         breakdown = {
             "compute_per_microbatch": compute_mb,
             "exposed_comm_per_microbatch": net_exposed,
             "recompute_per_microbatch": recompute_mb,
+            "hbm_busy_per_microbatch": hbm_busy_mb,
             "bubble": pp_res["bubble"],
             "dp_comm": dp_res["total"],
             "optimizer": optim,

@@ -53,10 +53,6 @@ class SimuMemoryTracker:
         if nbytes == 0:
             return
         assert nbytes > 0, f"negative alloc {nbytes}"
-        if self._peak_pending and self.cur + nbytes <= self.peak:
-            # this alloc does not extend the peak: _live still holds
-            # exactly the peak-time set, capture it before mutating
-            self._flush_peak()
         if token is not None:
             self._tokens.setdefault(token, []).append(nbytes)
             key = token

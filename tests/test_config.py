@@ -218,3 +218,28 @@ class TestStrategyConfig:
         assert "tpu_v5e_256" in cfgs["system"]
         st = get_strategy_config("tp1_pp2_dp4_mbs1")
         assert st.pp_size == 2
+
+    def test_pallas_backend_rejects_misaligned_shapes(self):
+        """sdp_backend='pallas' with a head size the kernel's shape
+        gate rejects must fail configure: the runtime dispatcher would
+        silently fall back to XLA while the estimate charged Pallas
+        rates (one shared predicate, core/utils.py)."""
+        from simumax_tpu.core.config import ModelConfig
+        from simumax_tpu.perf import PerfLLM
+
+        mc = ModelConfig(
+            model_name="probe", hidden_size=256, head_num=4,
+            kv_head_num=4, head_size=64, intermediate_size=512,
+            layer_num=2, vocab_size=2048,
+        )
+        st = StrategyConfig(
+            world_size=1, tp_size=1, pp_size=1, seq_len=2048,
+            micro_batch_size=1, micro_batch_num=1,
+            use_flash_sdp=True, use_math_sdp=False, sdp_backend="pallas",
+        )
+        with pytest.raises(ConfigError, match="lane-aligned"):
+            PerfLLM().configure(st, mc, "tpu_v5e_256")
+        # aligned head size passes
+        mc.head_size = 128
+        mc.hidden_size = 512
+        PerfLLM().configure(st, mc, "tpu_v5e_256")

@@ -1,0 +1,98 @@
+"""Context-parallel attention references vs single-device ground truth.
+
+Ring attention (ppermute blockwise online-softmax) and Ulysses (a2a
+head-scatter) must reproduce full causal attention exactly when the
+sequence is sharded over a cp mesh axis — the numerical anchor for the
+two analytical CP cost modes (cp_comm_type="all_gather" / "a2a").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from simumax_tpu.jaxref.context_parallel import (
+    make_cp_mesh,
+    ring_attention,
+    run_cp_dryrun,
+    ulysses_attention,
+)
+
+B, S, H, D = 2, 256, 8, 32
+
+
+def _qkv(kv_heads=H):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.3
+    k = jnp.asarray(rs.randn(B, S, kv_heads, D), jnp.float32) * 0.3
+    v = jnp.asarray(rs.randn(B, S, kv_heads, D), jnp.float32)
+    return q, k, v
+
+
+def _reference(q, k, v):
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return jax.nn.dot_product_attention(q, k, v, is_causal=True)
+
+
+def _run_sharded(attn, q, k, v, cp):
+    mesh = make_cp_mesh(cp, cp, backend="cpu")
+
+    def body(qq, kk, vv):
+        return attn(qq, kk, vv, axis="cp", causal=True)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp")),
+        out_specs=P(None, "cp"),
+        check_vma=False,
+    )
+    with mesh:
+        spec = NamedSharding(mesh, P(None, "cp"))
+        out = jax.jit(fn)(
+            jax.device_put(q, spec), jax.device_put(k, spec),
+            jax.device_put(v, spec),
+        )
+    return np.asarray(out)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("cp", [2, 4, 8])
+    def test_matches_full_attention(self, cp):
+        q, k, v = _qkv()
+        ref = np.asarray(_reference(q, k, v))
+        out = _run_sharded(ring_attention, q, k, v, cp)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-4)
+
+    def test_gqa_broadcast(self):
+        q, k, v = _qkv(kv_heads=2)
+        ref = np.asarray(_reference(q, k, v))
+        out = _run_sharded(ring_attention, q, k, v, 4)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-4)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("cp", [2, 4, 8])
+    def test_matches_full_attention(self, cp):
+        q, k, v = _qkv()
+        ref = np.asarray(_reference(q, k, v))
+        out = _run_sharded(ulysses_attention, q, k, v, cp)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-4)
+
+
+class TestCpDryrun:
+    @pytest.mark.parametrize("mechanism", ["ring", "ulysses"])
+    def test_train_step_runs(self, mechanism):
+        loss = run_cp_dryrun(8, cp=4, mechanism=mechanism, backend="cpu")
+        assert np.isfinite(loss)
+
+    def test_mechanisms_agree(self):
+        """Same data/params: ring and ulysses losses must coincide
+        (they compute the same attention by different collectives)."""
+        l_ring = run_cp_dryrun(8, cp=4, mechanism="ring", backend="cpu")
+        l_a2a = run_cp_dryrun(8, cp=4, mechanism="ulysses", backend="cpu")
+        assert l_ring == pytest.approx(l_a2a, rel=1e-2)
