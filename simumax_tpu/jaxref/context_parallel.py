@@ -74,11 +74,14 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True):
     """
     cp = jax.lax.axis_size(axis)
     b, s_loc, H, d = q.shape
-    if k.shape[2] != H:
-        rep = H // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA: rotate the COMPACT kv blocks (kv_head_num heads — the volume
+    # the analytical KVAllGather mode charges) and broadcast to q heads
+    # only locally, inside each step
+    rep = H // k.shape[2]
     if cp == 1:
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
 
     idx = jax.lax.axis_index(axis)
@@ -99,9 +102,11 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True):
         # block currently held started at rank (idx - j) mod cp
         src = (idx - j) % cp
         kv_pos = src * s_loc + jnp.arange(s_loc)
+        kcb = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+        vcb = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
         # scores [b, H, s_q, s_kv]
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)
+            "bqhd,bkhd->bhqk", qf, kcb.astype(jnp.float32)
         )
         if causal:
             mask = q_pos[:, None] >= kv_pos[None, :]
@@ -117,11 +122,12 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True):
         )
         l = l * corr + p.sum(-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p, vcb.astype(jnp.float32)
         )
-        # rotate kv to the next rank for the following step
-        kc = jax.lax.ppermute(kc, axis, perm)
-        vc = jax.lax.ppermute(vc, axis, perm)
+        if j < cp - 1:  # no rotation after the last block (cp-1 hops
+            # total — the volume the analytical KVAllGather mode costs)
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
         return (acc, m_new, l, kc, vc), None
 
     carry = (acc, m, l, k, v)
@@ -140,7 +146,13 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True):
 def make_cp_mesh(n_devices: int, cp: int, backend: Optional[str] = None):
     devices = jax.devices(backend) if backend else jax.devices()
     if len(devices) < n_devices:
-        devices = jax.devices("cpu")
+        devices = jax.devices("cpu")  # virtual-device dry runs
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"need {n_devices} devices for a dp x cp mesh, have "
+            f"{len(devices)} ({devices[0].platform}); set "
+            f"--xla_force_host_platform_device_count for CPU dry runs"
+        )
     devices = devices[:n_devices]
     dp = n_devices // cp
     assert dp * cp == n_devices, (n_devices, cp)

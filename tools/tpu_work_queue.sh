@@ -21,7 +21,7 @@ wait_tunnel() {
         n=$((n+1))
         echo "[queue] tunnel down (probe $n); sleeping 120s" >> "$LOG"
         sleep 120
-        if [ "$n" -ge 40 ]; then
+        if [ "$n" -ge 200 ]; then
             echo "[queue] giving up after $n probes" >> "$LOG"
             exit 1
         fi
