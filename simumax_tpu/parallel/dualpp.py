@@ -40,14 +40,18 @@ class DualPPPhase:
         return max(comp, self.comm_exposed * 2)
 
 
-def duration_dualpp(pp: int, mbc: int, phase: DualPPPhase) -> Dict[str, float]:
+def duration_dualpp(pp: int, mbc: int, phase: DualPPPhase,
+                    fb_duration: "float | None" = None) -> Dict[str, float]:
     """Closed-form DualPipe iteration duration for ``mbc`` microbatches
     over ``pp`` stages (pp even; each rank hosts two chunks, one per
-    direction)."""
+    direction). ``fb_duration`` overrides the F&B cell length with the
+    list-scheduled overlap (``schedule_fb_cell``) when available
+    (``None`` = closed-form fallback; an explicit 0.0 is honored)."""
     assert pp % 2 == 0, "DualPipe requires an even number of stages"
     f, b, w = phase.fwd, phase.bwd, phase.bwd_w
     steady = mbc * (f + b) / 1.0  # per-rank total compute work
-    bubble = (pp / 2 - 1) * (phase.fb_overlap + b - 3 * w)
+    fb = phase.fb_overlap if fb_duration is None else fb_duration
+    bubble = (pp / 2 - 1) * (fb + b - 3 * w)
     bubble = max(bubble, 0.0)
     total = steady + bubble + phase.comm_exposed * pp
     return {"total": total, "bubble": bubble, "steady": steady}
@@ -69,13 +73,180 @@ def cal_cost(perf, stage: int = 0) -> DualPPPhase:
                        comm_exposed=comm)
 
 
-def perf_dualpp(perf, stage: int = 0) -> Dict[str, float]:
+@dataclass
+class ComponentTimes:
+    """Per-microbatch component times for one F&B cell (seconds)."""
+
+    attn_f: float
+    mlp_f: float
+    attn_bd: float  # attention dgrad
+    attn_w: float
+    mlp_bd: float
+    mlp_w: float
+    dispatch: float = 0.0  # MoE a2a (per direction)
+    combine: float = 0.0
+    #: exposed non-a2a comm (tp ag/rs, cp, ...) per direction — kept on
+    #: the comm lane so comm-bound configs still expose it
+    other_f: float = 0.0
+    other_b: float = 0.0
+
+
+def schedule_fb_cell(ct: ComponentTimes) -> Dict[str, object]:
+    """Overlapped F&B cell: a dependency-driven two-lane list schedule
+    (compute serialized on the MXU lane, a2a serialized on the ICI
+    lane), the mechanism DualPipe uses to hide MoE dispatch/combine of
+    one direction under the other direction's compute (reference
+    ``pp_simu/utils.py::cal_FandB``; here a generic scheduler instead
+    of a hand-rolled interval list).
+
+    Chains: F = attn_f -> dispatch_f -> mlp_f -> combine_f;
+    B = combine_b -> mlp_bd -> dispatch_b -> attn_bd -> {attn_w, mlp_w}.
+    Returns total duration + per-task (start, end) intervals.
+    """
+    dur = {
+        "attn_F": ct.attn_f, "mlp_F": ct.mlp_f,
+        "attn_B": ct.attn_bd, "mlp_B": ct.mlp_bd,
+        "attn_W": ct.attn_w, "mlp_W": ct.mlp_w,
+        "dispatch_F": ct.dispatch, "combine_F": ct.combine,
+        "dispatch_B": ct.dispatch, "combine_B": ct.combine,
+        "other_F": ct.other_f, "other_B": ct.other_b,
+    }
+    deps = {
+        "attn_F": [], "dispatch_F": ["attn_F"],
+        "mlp_F": ["dispatch_F"], "combine_F": ["mlp_F"],
+        "combine_B": [], "mlp_B": ["combine_B"],
+        "dispatch_B": ["mlp_B"], "attn_B": ["dispatch_B"],
+        "attn_W": ["attn_B"], "mlp_W": ["mlp_B"],
+        "other_F": ["attn_F"], "other_B": ["combine_B"],
+    }
+    lane_of = {
+        t: ("comp" if t.startswith(("attn", "mlp")) else "comm")
+        for t in dur
+    }
+    # priority interleaves the two directions so each lane always has
+    # work from the opposite chain to hide under
+    prio = ["attn_F", "combine_B", "dispatch_F", "other_B", "mlp_B",
+            "mlp_F", "other_F", "dispatch_B", "combine_F", "attn_B",
+            "mlp_W", "attn_W"]
+    end: Dict[str, float] = {}
+    start: Dict[str, float] = {}
+    lane_free = {"comp": 0.0, "comm": 0.0}
+    # zero-duration tasks are scheduled too: they cost nothing but keep
+    # transitive dependencies intact (a zero a2a still orders mlp_F
+    # after attn_F)
+    pending = list(prio)
+    while pending:
+        progressed = False
+        for t in list(pending):
+            if any(d not in end for d in deps[t]):
+                continue
+            lane = lane_of[t]
+            dep_ready = max(
+                (end[d] for d in deps[t]), default=0.0
+            )
+            start[t] = max(lane_free[lane], dep_ready)
+            end[t] = start[t] + dur[t]
+            lane_free[lane] = end[t]
+            pending.remove(t)
+            progressed = True
+        assert progressed, f"cyclic deps in fb cell: {pending}"
+    total = max(end.values(), default=0.0)
+    return {
+        "total": total,
+        "intervals": {t: (start[t], end[t]) for t in end},
+        "lanes": lane_of,
+    }
+
+
+def cell_components(perf, stage: int = 0) -> ComponentTimes:
+    """Extract per-microbatch component times from an estimated
+    ``PerfLLM``: attention vs MLP/expert compute per phase, MoE
+    dispatch/combine a2a from the Permutation collective calls."""
+    attn = [0.0, 0.0, 0.0]  # fwd, bwd_act(+recompute), bwd_w
+    mlp = [0.0, 0.0, 0.0]
+    a2a = [0.0, 0.0]  # dispatch, combine (fwd direction)
+    a2a_bwd = 0.0
+    net = [0.0, 0.0]  # exposed net: fwd, bwd(act+w)
+    for chunk in perf.stage_chunks(stage):
+        for leaf in chunk.called_leaves():
+            path = leaf.path_name()
+            ci = leaf.cost_info
+            dst = (
+                attn
+                if "attention" in path or path.endswith(("rope", "rotary"))
+                else mlp
+            )
+            dst[0] += ci.compute.fwd
+            # recompute_time = replayed fwd compute + fwd net; keep
+            # only the compute part on the comp lane (the replayed a2a
+            # is already a comm-lane task)
+            dst[1] += ci.compute.bwd_act + max(
+                ci.recompute_time - ci.net_exposed.fwd, 0.0
+            )
+            dst[2] += ci.compute.bwd_w
+            net[0] += ci.net_exposed.fwd
+            net[1] += ci.net_exposed.bwd_act + ci.net_exposed.bwd_w
+            tail = path.rsplit(".", 1)[-1]
+            for call in leaf.collective_calls:
+                if call.op == "all2all" and call.dim in ("ep", "etp"):
+                    if call.phase == "fwd":
+                        idx = 1 if tail in ("combine", "unpermutation") else 0
+                        a2a[idx] += call.exposed_time
+                    else:
+                        a2a_bwd += call.exposed_time
+    return ComponentTimes(
+        attn_f=attn[0], mlp_f=mlp[0], attn_bd=attn[1], attn_w=attn[2],
+        mlp_bd=mlp[1], mlp_w=mlp[2], dispatch=a2a[0], combine=a2a[1],
+        other_f=max(net[0] - a2a[0] - a2a[1], 0.0),
+        other_b=max(net[1] - a2a_bwd, 0.0),
+    )
+
+
+def plot_fb_cell(cell: Dict[str, object], save_path: str) -> str:
+    """Render the overlapped F&B cell as a two-lane interval chart
+    (reference ``show_overlap_all2all``); needs matplotlib."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    intervals: Dict[str, tuple] = cell["intervals"]  # type: ignore
+    lanes: Dict[str, str] = cell["lanes"]  # type: ignore
+    fig, ax = plt.subplots(figsize=(10, 2.2))
+    y = {"comp": 1.0, "comm": 0.0}
+    for t, (s, e) in intervals.items():
+        if e - s <= 0:
+            continue  # zero-duration placeholder tasks
+        lane = lanes[t]
+        color = "#4878a8" if lane == "comp" else "#c44e52"
+        ax.barh(y[lane], e - s, left=s, height=0.6, color=color,
+                edgecolor="white")
+        ax.text((s + e) / 2, y[lane], t, ha="center", va="center",
+                fontsize=7, color="white")
+    ax.set_yticks([0.0, 1.0])
+    ax.set_yticklabels(["ICI a2a", "compute"])
+    ax.set_xlabel("time (s)")
+    ax.set_title("DualPipe F&B cell overlap")
+    fig.tight_layout()
+    fig.savefig(save_path, dpi=150)
+    plt.close(fig)
+    return save_path
+
+
+def perf_dualpp(perf, stage: int = 0,
+                save_path: str = None) -> Dict[str, float]:
     """Compare a DualPipe schedule against the estimated 1F1B result
-    for the same model/strategy; returns durations + projected MFU."""
+    for the same model/strategy; returns durations + projected MFU.
+    ``save_path`` renders the overlapped F&B cell timeline to PNG
+    (reference's overlap plot)."""
     st = perf.strategy
     assert st.pp_size % 2 == 0, "DualPipe needs even pp"
     phase = cal_cost(perf, stage)
-    dual = duration_dualpp(st.pp_size, st.micro_batch_num, phase)
+    cell = schedule_fb_cell(cell_components(perf, stage))
+    if save_path:
+        plot_fb_cell(cell, save_path)
+    dual = duration_dualpp(st.pp_size, st.micro_batch_num, phase,
+                           fb_duration=cell["total"])
     base = perf.analysis_cost()
     extra = base["dp_comm"]["total"] + base["optim_time"]
     dual_iter = dual["total"] + extra

@@ -9,7 +9,7 @@ import sys
 import pytest
 
 from simumax_tpu import PerfLLM
-from simumax_tpu.core.config import get_strategy_config
+from simumax_tpu.core.config import get_model_config, get_strategy_config
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -62,6 +62,64 @@ class TestDualPP:
         res = perf_dualpp(p)
         assert res["dualpp_bubble"] < res["baseline_bubble"]
         assert res["speedup"] > 0
+
+    def test_fb_cell_hides_a2a_under_compute(self):
+        """The two-lane list schedule must fully hide dispatch/combine
+        when opposite-direction compute covers them, and expose the
+        excess when comm dominates; per-lane intervals never overlap."""
+        from simumax_tpu.parallel.dualpp import (
+            ComponentTimes,
+            schedule_fb_cell,
+        )
+
+        ct = ComponentTimes(attn_f=10, mlp_f=10, attn_bd=10, attn_w=5,
+                            mlp_bd=10, mlp_w=5, dispatch=3, combine=3)
+        cell = schedule_fb_cell(ct)
+        assert cell["total"] == pytest.approx(50)  # pure compute; a2a hidden
+        for lane in ("comp", "comm"):
+            spans = sorted(
+                iv for t, iv in cell["intervals"].items()
+                if cell["lanes"][t] == lane
+            )
+            for (s1, e1), (s2, e2) in zip(spans, spans[1:]):
+                assert e1 <= s2 + 1e-12, (lane, spans)
+
+        heavy = ComponentTimes(attn_f=1, mlp_f=1, attn_bd=1, attn_w=1,
+                               mlp_bd=1, mlp_w=1, dispatch=50, combine=50)
+        cell2 = schedule_fb_cell(heavy)
+        assert cell2["total"] > 100  # serialized a2a dominates
+
+    def test_fb_cell_moe_extraction(self, tmp_path):
+        """deepseek ep config: components split attention vs expert,
+        dispatch+combine a2a both found, and the cell hides the a2a
+        fully under opposite-direction compute; the overlap plot
+        renders."""
+        from simumax_tpu.parallel.dualpp import (
+            cell_components,
+            perf_dualpp,
+            schedule_fb_cell,
+        )
+
+        m = get_model_config("deepseekv2")
+        m.layer_num = 4
+        m.dense_layers = 0
+        st = get_strategy_config("ep8_pp1_dp8_mbs1")
+        st.world_size = 64
+        st.pp_size = 2
+        st.__post_init__()
+        p = PerfLLM().configure(st, m, "tpu_v5p_256")
+        p.run_estimate()
+        ct = cell_components(p)
+        assert ct.attn_f > 0 and ct.mlp_f > 0
+        assert ct.attn_w > 0 and ct.mlp_w > 0
+        assert ct.dispatch > 0 and ct.combine > 0
+        cell = schedule_fb_cell(ct)
+        comp = (ct.attn_f + ct.mlp_f + ct.attn_bd + ct.attn_w
+                + ct.mlp_bd + ct.mlp_w)
+        assert cell["total"] == pytest.approx(comp, rel=1e-6)
+        out = tmp_path / "fb.png"
+        perf_dualpp(p, save_path=str(out))
+        assert out.exists()
 
     def test_requires_even_pp(self):
         from simumax_tpu.parallel.dualpp import perf_dualpp
