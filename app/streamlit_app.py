@@ -101,18 +101,23 @@ if model.model_type == "moe":
     model.topk = _num("topk", model.topk)
 
 with st.expander("edit raw model json (advanced)"):
+    # streamlit retains edited widget text across reruns, which would
+    # silently discard later sidebar edits if the raw JSON always won;
+    # apply it only while the checkbox is on
     model_json = st.text_area(
         "model json", json.dumps(model.to_dict(), indent=2), height=240
     )
-    model = ModelConfig.init_from_dict(json.loads(model_json))
+    if st.checkbox("apply raw model json (overrides sidebar)"):
+        model = ModelConfig.init_from_dict(json.loads(model_json))
 with st.expander("edit raw strategy json (advanced)"):
     strategy_json = st.text_area(
         "strategy json", json.dumps(strategy.to_dict(), indent=2, default=str),
         height=240,
     )
-    data = json.loads(strategy_json)
-    data.pop("recompute", None)
-    strategy = StrategyConfig.init_from_dict(data)
+    if st.checkbox("apply raw strategy json (overrides sidebar)"):
+        data = json.loads(strategy_json)
+        data.pop("recompute", None)
+        strategy = StrategyConfig.init_from_dict(data)
 
 strategy.__post_init__()  # re-derive dp_size/recompute from the edits
 

@@ -599,6 +599,13 @@ class StrategyConfig(ConfigBase):
                 f"({self.micro_batch_num}) divisible by the vp microbatch "
                 f"group size ({self.vpp_group_size})",
             )
+            _require(
+                self.vpp_group_size >= self.pp_size,
+                f"vp microbatch group size ({self.vpp_group_size}) must be "
+                f">= pp_size ({self.pp_size}): a smaller group starves the "
+                f"downstream stages and the interleaved schedule deadlocks "
+                f"(Megatron enforces the same bound)",
+            )
         if self.enable_sequence_parallel:
             _require(
                 self.seq_len % (self.tp_size * self.cp_size) == 0,

@@ -498,18 +498,25 @@ class CoreAttention(LeafModule):
             )
             return ActivationInfo(cache_bytes=cache)
         # math (XLA composite) path: softmax runs in fp32; the fp32
-        # probs are cached for the backward. No additional transient is
-        # charged: the pre-softmax scores fuse into the probs buffer and
-        # the backward's dS reuses it (anchored against TPU
+        # probs are cached for the backward (the pre-softmax scores fuse
+        # into the probs buffer). The backward ADDITIONALLY materializes
+        # dP = dO @ V^T — a matmul output in the model dtype — while the
+        # cached probs are still live; the fp32 dS chain then fuses into
+        # the dq/dk/dv matmul operand reads, so exactly one extra score
+        # matrix is transient (anchored against TPU
         # compiled.memory_analysis() across seq/layers/remat,
-        # docs/memory_validation.md)
+        # docs/memory_validation.md: omitting it underpredicted the
+        # 8192-seq remat case by 18%)
         probs_f32 = b * hl * sq * skv * 4.0
         cache = (
             b * sq * hl * d * e
             + b * skv * kvl * (d + dv) * e
             + probs_f32
         )
-        return ActivationInfo(cache_bytes=cache)
+        return ActivationInfo(
+            cache_bytes=cache,
+            bwd_temp_bytes=b * hl * sq * skv * e,
+        )
 
     def bw_key(self, phase):
         return "default"
@@ -688,21 +695,24 @@ class ParallelCE(LeafModule):
     def op_accessed(self) -> Dict[str, float]:
         st = _st(self.ctx)
         lg = self.inputs[0].bytes
-        if st.use_fused_ce:
-            return {"fwd": 2 * lg, "bwd_act": 2 * lg}
-        probs = self.inputs[0].numel() * 4
-        return {"fwd": 2 * lg + probs, "bwd_act": 2 * probs}
+        # Under jit, XLA fuses the log-softmax + target-gather: the fp32
+        # log-probs are never materialized (the elementwise x - lse
+        # fuses into the gather), so both variants stream the bf16
+        # logits — fwd two reduction passes, bwd read-logits +
+        # write-dlogits (anchored: the fp32-probs model overpredicted
+        # the CE-peaked rows of docs/memory_validation.md by ~10%).
+        return {"fwd": 2 * lg, "bwd_act": 2 * lg}
 
     def bw_key(self, phase):
         return "ce_fusion" if _st(self.ctx).use_fused_ce else "ce"
 
     def activation_info(self) -> ActivationInfo:
         st = _st(self.ctx)
-        if st.use_fused_ce:
-            return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+        b, s, _ = self.inputs[0].shape
+        # bf16 logits + the fp32 log-sum-exp row vector; no fp32 probs
+        # materialization on the XLA path (see op_accessed)
         return ActivationInfo(
-            cache_bytes=self.inputs[0].numel() * 4,  # fp32 softmax probs
-            fwd_temp_bytes=self.inputs[0].numel() * 4,
+            cache_bytes=self.inputs[0].bytes + b * s * 4.0
         )
 
     def collectives(self) -> List[CollectiveCall]:

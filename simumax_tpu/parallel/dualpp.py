@@ -178,14 +178,16 @@ def cell_components(perf, stage: int = 0) -> ComponentTimes:
             )
             dst[0] += ci.compute.fwd
             # recompute_time = replayed fwd compute + fwd net; keep
-            # only the compute part on the comp lane (the replayed a2a
-            # is already a comm-lane task)
+            # only the compute part on the comp lane and put the
+            # replayed fwd collectives on the comm lane with the other
+            # backward-phase traffic (they run during the backward)
+            replay_net = min(ci.recompute_time, ci.net_exposed.fwd)
             dst[1] += ci.compute.bwd_act + max(
                 ci.recompute_time - ci.net_exposed.fwd, 0.0
             )
             dst[2] += ci.compute.bwd_w
             net[0] += ci.net_exposed.fwd
-            net[1] += ci.net_exposed.bwd_act + ci.net_exposed.bwd_w
+            net[1] += ci.net_exposed.bwd_act + ci.net_exposed.bwd_w + replay_net
             tail = path.rsplit(".", 1)[-1]
             for call in leaf.collective_calls:
                 if call.op == "all2all" and call.dim in ("ep", "etp"):

@@ -96,15 +96,20 @@ class PerfBase:
             # while the estimate charged Pallas rates
             from simumax_tpu.core.utils import pallas_attention_supported
 
-            s_attn = st.seq_len // (
-                st.cp_size if st.cp_comm_type == "all_gather" else 1
-            )
+            # post-collective shapes the kernel actually sees: under
+            # cp=all_gather each rank runs its seq/cp query shard
+            # against the FULL gathered KV; under a2a (and cp=1) both
+            # are the full sequence
+            if st.cp_size > 1 and st.cp_comm_type == "all_gather":
+                sq_attn, skv_attn = st.seq_len // st.cp_size, st.seq_len
+            else:
+                sq_attn = skv_attn = st.seq_len
             _require(
-                pallas_attention_supported(s_attn, s_attn, m.head_size),
+                pallas_attention_supported(sq_attn, skv_attn, m.head_size),
                 f"sdp_backend='pallas' needs lane-aligned attention "
-                f"shapes (seq {s_attn}, head_size {m.head_size} must be "
-                f"multiples of 128) — the runtime kernel would fall "
-                f"back to XLA; use sdp_backend='xla'",
+                f"shapes (sq {sq_attn}, skv {skv_attn}, head_size "
+                f"{m.head_size} must be multiples of 128) — the runtime "
+                f"kernel would fall back to XLA; use sdp_backend='xla'",
             )
         if st.fp8:
             needed = [f"{st.quant_dtype}_matmul"]
@@ -747,9 +752,11 @@ class PerfLLM(PerfBase):
         shard = numel / max(1, st.dp_size * st.cp_size) if st.zero_state else numel
         if st.optimizer_style == "functional":
             e = st.element_size
-            # grad read + param read/write + two fp32 moments read/write
+            # grad read + param read/write + two fp32 moments read/write;
+            # the multi-stream fused update gets its own measured
+            # bandwidth class when calibrated (falls back to default)
             traffic = shard * (st.grad_element_size + 2 * e + 16)
-            return sysc.compute_mem_access_time(traffic)
+            return sysc.compute_mem_access_time(traffic, bw_key="fused_adam")
         t = 0.0
         t += sysc.compute_mem_access_time(numel * st.grad_element_size)  # zero grad
         t += sysc.compute_mem_access_time(shard * 4)  # l2 norm read

@@ -24,6 +24,14 @@ Request vocabulary (yielded by rank coroutines):
   is in a recv-only phase — Megatron ``batch_isend_irecv`` semantics)
 * ``("recv", src, tag, name, lane)`` — blocks until the matching send's
   data has arrived (``send_post_time + duration``)
+* ``("sendrecv", dst, stag, sdur, src, rtag, name, lane)`` — one
+  batched ``isend/irecv`` pair (Megatron ``batch_isend_irecv``): the
+  send is PUBLISHED on the first service attempt (so rings of mutual
+  sendrecvs cannot deadlock), then the rank blocks until (a) the
+  inbound matching send has arrived and (b) the peer has posted the
+  recv matching our send; completes at the max of both transfer ends.
+  ``dst=None`` degrades to a plain blocking recv, ``src=None`` to a
+  blocking rendezvous send (same semantics as ``send_sync``)
 * ``("advance", t)`` — jump lane clock to at least t
 * ``("trace", duration, name, lane)`` — zero-advance visibility span
   (overlapped comm shown in the trace without consuming rank time)
@@ -89,6 +97,13 @@ class SimuEngine:
         self._send_seq: Dict[tuple, int] = {}
         self._recv_seq: Dict[tuple, int] = {}
         self._recv_posts: Dict[tuple, float] = {}  # sync-send rendezvous
+        #: sendrecv: publish time of the outbound send of an in-flight
+        #: batched pair (keyed like _sends; removed on completion)
+        self._sr_done: Dict[tuple, float] = {}
+        #: bumped when a BLOCKED request mutates shared state (publishes
+        #: a send, records a recv post): another pass may now succeed,
+        #: so the run loop must not declare deadlock on this pass
+        self._state_version = 0
         self._flow_ids: Dict[tuple, int] = {}
         self._next_flow = 0
         #: async comm-stream state: per-(stream,peers) chained end time,
@@ -110,6 +125,7 @@ class SimuEngine:
             self._advance_rank(r, None)
         while not all(self._done):
             progressed = False
+            v0 = self._state_version
             # serve ranks in clock order for determinism
             order = sorted(range(self.num_ranks), key=lambda r: self.clock[r])
             for r in order:
@@ -117,7 +133,10 @@ class SimuEngine:
                     continue
                 if self._try_serve(r):
                     progressed = True
-            if not progressed:
+            if not progressed and self._state_version == v0:
+                # no rank ran AND no blocked request published new state
+                # (a send publish / recv post can unblock a rank already
+                # visited this pass)
                 self._deadlock_dump()
         return max(self.clock)
 
@@ -267,9 +286,17 @@ class SimuEngine:
                 # record when this recv was first posted (sync sends
                 # rendezvous against it)
                 self._recv_posts[skey] = self.clock[rank]
+                self._state_version += 1
             if skey not in self._sends:
                 return False  # sender hasn't posted yet
             post, duration = self._sends.pop(skey)
+            if skey in self._sr_done:
+                # the sender is a blocked send-only sendrecv: preserve
+                # the rendezvous time so its completion reflects when
+                # this recv actually arrived (not just its publish time)
+                self._sr_done[skey] = max(
+                    self._sr_done[skey], self._recv_posts.get(skey, post)
+                )
             self._recv_posts.pop(skey, None)
             self._recv_seq[(rank, src, tag)] = seq + 1
             arrive = max(self.clock[rank], post + duration)
@@ -281,6 +308,80 @@ class SimuEngine:
                 )
             self.clock[rank] = arrive
             self._advance_rank(rank, arrive)
+            return True
+        if kind == "sendrecv":
+            _, dst, stag, sdur, src, rtag, name, *rest = req
+            lane = rest[0] if rest else "pp_fwd"
+            post_t = self.clock[rank]
+            out_key = None
+            if dst is not None:
+                # publish the outbound send exactly once per pending
+                # request (the request is re-served while blocked)
+                seq = self._send_seq.get((rank, dst, stag), 0)
+                if (rank, dst, stag, seq - 1) in self._sr_done:
+                    out_key = (rank, dst, stag, seq - 1)  # re-serve attempt
+                else:
+                    out_key = (rank, dst, stag, seq)
+                if out_key not in self._sends and out_key not in self._sr_done:
+                    self._send_seq[(rank, dst, stag)] = seq + 1
+                    self._sends[out_key] = (post_t, sdur)
+                    self._sr_done[out_key] = post_t
+                    self._state_version += 1
+                    fid = self._next_flow
+                    self._next_flow += 1
+                    self._flow_ids[out_key] = fid
+                    self.events.append(
+                        TraceEvent(rank, lane, f"send_{name}", post_t,
+                                   post_t + sdur, kind="p2p", flow_id=fid)
+                    )
+                post_t = self._sr_done[out_key]
+            in_key = None
+            if src is not None:
+                seq = self._recv_seq.get((rank, src, rtag), 0)
+                in_key = (src, rank, rtag, seq)
+                if in_key not in self._recv_posts:
+                    self._recv_posts[in_key] = self.clock[rank]
+                    self._state_version += 1
+                if in_key not in self._sends:
+                    return False  # inbound not posted yet
+            if out_key is not None and in_key is None:
+                # send-only batched call: true rendezvous — completes
+                # only once the peer has posted (or consumed) the
+                # matching recv. Paired calls instead complete when the
+                # inbound data arrives (the outbound is eager wire
+                # time): requiring the peer's recv-post for paired
+                # sends would chain op-granular pairs into cycles the
+                # real schedule's wider batch_isend_irecv calls (4-way
+                # at 1F1B phase boundaries) do not have.
+                peer_post = self._recv_posts.get(out_key)
+                if peer_post is None and out_key in self._sends:
+                    return False  # peer's recv not posted yet
+            end = self.clock[rank]
+            if in_key is not None:
+                post, duration = self._sends.pop(in_key)
+                if in_key in self._sr_done:
+                    self._sr_done[in_key] = max(
+                        self._sr_done[in_key],
+                        self._recv_posts.get(in_key, post),
+                    )
+                self._recv_posts.pop(in_key, None)
+                self._recv_seq[(rank, src, rtag)] = seq + 1
+                end = max(end, post + duration)
+            if out_key is not None:
+                peer_post = self._recv_posts.get(out_key)
+                if in_key is None and peer_post is not None:
+                    send_end = max(self._sr_done[out_key], peer_post) + sdur
+                else:
+                    send_end = self._sr_done[out_key] + sdur
+                end = max(end, send_end)
+                del self._sr_done[out_key]
+            if end > self.clock[rank]:
+                self.events.append(
+                    TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
+                               end, kind="wait")
+                )
+            self.clock[rank] = end
+            self._advance_rank(rank, end)
             return True
         raise RuntimeError(f"unknown request {req!r}")
 

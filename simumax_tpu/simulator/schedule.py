@@ -551,28 +551,42 @@ class StageProcess:
             ag_join_pending = True
         b_seen = 0
         rs_begun: set = set()
-        for kind, c, mb in order:
+
+        def specs(op):
+            """(recv, send) p2p specs of one schedule op; each is
+            ``(peer, tag, name, lane)`` or None."""
+            kind, c, mb = op
             if kind == "F":
+                recv = None
                 if not (stage == 0 and c == 0):
                     src = self._neighbor(stage - 1 if stage > 0 else pp - 1)
-                    t = yield ("recv", src, f"fwd_c{c}_mb{mb}",
-                               f"recv_fwd_c{c}_mb{mb}", "pp_fwd")
-                    clock[0] = t
-                yield from self._fwd(mb, clock, by_chunk[c])
-                if ag_join_pending:
-                    t = yield ("wait_comm",)
-                    clock[0] = t
-                    ag_join_pending = False
+                    recv = (src, f"fwd_c{c}_mb{mb}",
+                            f"recv_fwd_c{c}_mb{mb}", "pp_fwd")
+                send = None
                 if not (stage == pp - 1 and c == vp - 1):
                     dst = self._neighbor(stage + 1 if stage < pp - 1 else 0)
                     rc = c if stage < pp - 1 else c + 1
-                    t = yield ("send", dst, f"fwd_c{rc}_mb{mb}",
-                               self.p2p_time, f"send_fwd_c{rc}_mb{mb}",
-                               "pp_fwd")
-                    clock[0] = t
-                    if not st.pp_comm_async:
-                        yield ("advance", clock[0] + self.p2p_time)
-            else:
+                    send = (dst, f"fwd_c{rc}_mb{mb}",
+                            f"send_fwd_c{rc}_mb{mb}", "pp_fwd")
+                return recv, send
+            recv = None
+            if not (stage == pp - 1 and c == vp - 1):
+                src = self._neighbor(stage + 1 if stage < pp - 1 else 0)
+                recv = (src, f"bwd_c{c}_mb{mb}",
+                        f"recv_bwd_c{c}_mb{mb}", "pp_bwd")
+            send = None
+            if not (stage == 0 and c == 0):
+                dst = self._neighbor(stage - 1 if stage > 0 else pp - 1)
+                rc = c if stage > 0 else c - 1
+                send = (dst, f"bwd_c{rc}_mb{mb}",
+                        f"send_bwd_c{rc}_mb{mb}", "pp_bwd")
+            return recv, send
+
+        recv_batched = False  # next op's input already received by a pair
+        for i, op in enumerate(order):
+            kind, c, mb = op
+            recv, send = specs(op)
+            if kind == "B":
                 b_seen += 1
                 # grad-reduce windows (interleaved): ZeRO-2 reduces each
                 # microbatch's grads — its window spans that mb's chunk
@@ -587,23 +601,43 @@ class StageProcess:
                             self._begin_rs_window()
                     elif mb == mbc - 1 and not self._rs_active:
                         self._begin_rs_window()
-                if not (stage == pp - 1 and c == vp - 1):
-                    src = self._neighbor(stage + 1 if stage < pp - 1 else 0)
-                    t = yield ("recv", src, f"bwd_c{c}_mb{mb}",
-                               f"recv_bwd_c{c}_mb{mb}", "pp_bwd")
+            if recv is not None and not recv_batched:
+                t = yield ("recv", recv[0], recv[1], recv[2], recv[3])
+                clock[0] = t
+            recv_batched = False
+            if kind == "F":
+                yield from self._fwd(mb, clock, by_chunk[c])
+                if ag_join_pending:
+                    t = yield ("wait_comm",)
                     clock[0] = t
+                    ag_join_pending = False
+            else:
                 yield from self._bwd(mb, clock, by_chunk[c])
                 if st.overlap_grad_reduce and (
                     (st.zero_state == 2 and c == 0) or b_seen == n_b
                 ):
                     yield from self._flush_rs_window()
-                if not (stage == 0 and c == 0):
-                    dst = self._neighbor(stage - 1 if stage > 0 else pp - 1)
-                    rc = c if stage > 0 else c - 1
-                    t = yield ("send", dst, f"bwd_c{rc}_mb{mb}",
-                               self.p2p_time, f"send_bwd_c{rc}_mb{mb}",
-                               "pp_bwd")
+            if send is not None:
+                if st.pp_comm_async:
+                    t = yield ("send", send[0], send[1], self.p2p_time,
+                               send[2], send[3])
                     clock[0] = t
-                    if not st.pp_comm_async:
-                        yield ("advance", clock[0] + self.p2p_time)
+                else:
+                    # Megatron blocking interleaved: the send is batched
+                    # with the NEXT op's recv in one batch_isend_irecv
+                    # call (reference pipeline_schedule.py:344-592) —
+                    # publish-then-pair semantics, so warmup rings of
+                    # mutual sends cannot deadlock (engine "sendrecv")
+                    nxt = specs(order[i + 1])[0] if i + 1 < len(order) else None
+                    if nxt is not None:
+                        t = yield ("sendrecv", send[0], send[1],
+                                   self.p2p_time, nxt[0], nxt[1],
+                                   f"{send[2]}+{nxt[2]}", send[3])
+                        clock[0] = t
+                        recv_batched = True
+                    else:
+                        t = yield ("sendrecv", send[0], send[1],
+                                   self.p2p_time, None, None, send[2],
+                                   send[3])
+                        clock[0] = t
         yield from self._optimizer(clock)

