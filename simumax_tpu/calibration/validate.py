@@ -105,3 +105,54 @@ def hlo_collective_bytes(compiled_text: str) -> Dict[str, float]:
     for m in pat.finditer(compiled_text):
         out[m.group(2)] = out.get(m.group(2), 0.0) + shape_bytes(m.group(1))
     return out
+
+
+def hlo_replica_groups(compiled_text: str) -> Dict[str, list]:
+    """Extract the device-group structure of every collective in a
+    compiled HLO module: ``{op_family: [[group, ...], ...]}`` — one list
+    of groups per op instance. For ``collective-permute`` the
+    source-target pairs are returned as 2-lists.
+
+    Together with :func:`hlo_collective_bytes` this anchors not just the
+    *volume* but the *placement input* of the analytical collective
+    model: the (stride, size, contiguity) of each replica group is
+    exactly the ``(inner_size, group_size)`` the model feeds to
+    ``SystemConfig.place_group``.
+    """
+    import re
+
+    out: Dict[str, list] = {}
+    pat = re.compile(
+        r"(all-gather|reduce-scatter|all-reduce|all-to-all|"
+        r"collective-permute)(?:-start)?\([^\n]*?"
+        r"(?:replica_groups=\{(.*?)\}\}|"
+        r"source_target_pairs=\{(.*?)\}\})"
+    )
+    for m in pat.finditer(compiled_text):
+        fam, rg, stp = m.group(1), m.group(2), m.group(3)
+        body = rg if rg is not None else stp
+        groups = [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in re.findall(r"\{([\d,]*)", "{" + body + "}")
+            if g.strip()
+        ]
+        out.setdefault(fam, []).append(groups)
+    return out
+
+
+def group_structure(groups: list) -> Dict[str, object]:
+    """(size, stride, contiguous) of a replica-group list — the
+    placement signature ``place_group`` consumes. Requires all groups in
+    the list to share one structure (true for XLA mesh collectives)."""
+    sizes = {len(g) for g in groups}
+    assert len(sizes) == 1, f"ragged replica groups: {groups}"
+    size = sizes.pop()
+    strides = set()
+    for g in groups:
+        if len(g) >= 2:
+            ds = {b - a for a, b in zip(g, g[1:])}
+            assert len(ds) == 1, f"non-uniform stride in group {g}"
+            strides.add(ds.pop())
+    stride = strides.pop() if strides else 1
+    assert not strides, f"mixed strides across groups: {groups}"
+    return {"size": size, "stride": stride, "contiguous": stride == 1}

@@ -42,10 +42,15 @@ class LlamaConfig:
     #: lane-aligned shapes; GQA kv heads broadcast upstream — the
     #: layout the ``sdp_backend="pallas"`` analytical keys cost)
     use_pallas_attn: bool = False
+    #: run the block/head linear layers as REAL int8 GEMMs (fwd NN,
+    #: dgrad NT, wgrad TN — jaxref.quantized), the measured counterpart
+    #: of the analytical ``fp8=True, quant_dtype="int8"`` path
+    use_int8: bool = False
 
     @classmethod
     def from_model_config(cls, m, layer_num: Optional[int] = None,
-                          use_pallas_attn: bool = False):
+                          use_pallas_attn: bool = False,
+                          use_int8: bool = False):
         """Build from a simumax_tpu ModelConfig (analytical <-> measured
         parity)."""
         return cls(
@@ -57,6 +62,7 @@ class LlamaConfig:
             intermediate_size=m.intermediate_size,
             layer_num=layer_num or m.layer_num,
             use_pallas_attn=use_pallas_attn,
+            use_int8=use_int8,
         )
 
 
@@ -142,13 +148,22 @@ def _rope(x, theta: float):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
 
 
+def _linear(cfg: LlamaConfig):
+    if cfg.use_int8:
+        from simumax_tpu.jaxref.quantized import int8_matmul
+
+        return int8_matmul
+    return lambda x, w: x @ w
+
+
 def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
     h, d = cfg.hidden_size, cfg.head_size
     q_out = cfg.head_num * d
     kv_out = cfg.kv_head_num * d
+    mm = _linear(cfg)
     res = x
     y = _rms_norm(x, p["input_norm"])
-    qkv = y @ p["qkv"]
+    qkv = mm(y, p["qkv"])
     q, k, v = jnp.split(qkv, [q_out, q_out + kv_out], axis=-1)
     b, s, _ = q.shape
     q = _rope(q.reshape(b, s, cfg.head_num, d), cfg.rope_theta)
@@ -167,16 +182,16 @@ def _block(x, p, cfg: LlamaConfig, sp: bool, shard: bool):
         o = _pallas_attn(q, kk, vv, causal=True)
     else:
         o = jax.nn.dot_product_attention(q, k, v, is_causal=True)
-    x = res + o.reshape(b, s, q_out) @ p["out"]
+    x = res + mm(o.reshape(b, s, q_out), p["out"])
     res = x
     y = _rms_norm(x, p["pre_mlp_norm"])
-    up = y @ p["up"]
+    up = mm(y, p["up"])
     # NB: plain jnp here (not the pallas kernel): under sharded jit the
     # [.., 2f] tensor is tp-column-sharded and pallas_call has no GSPMD
     # partitioning rule; the kernel is used where shapes are shard-local
     # (jaxref.parallel's shard_map body).
     gate, val = jnp.split(up, 2, axis=-1)
-    y = (jax.nn.silu(gate) * val) @ p["down"]
+    y = mm(jax.nn.silu(gate) * val, p["down"])
     x = res + y
     if not shard:
         return x
@@ -204,7 +219,7 @@ def forward(params, ids, cfg: LlamaConfig, sp: bool = False,
         for p in params["layers"]:
             x = blk(x, p, cfg, sp, shard)
     x = _rms_norm(x, params["final_norm"])
-    return x @ params["lm_head"]
+    return _linear(cfg)(x, params["lm_head"])
 
 
 def loss_fn(params, batch, cfg: LlamaConfig, sp: bool = False,

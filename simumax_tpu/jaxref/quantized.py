@@ -1,0 +1,77 @@
+"""Int8 quantized matmul for the measured reference models.
+
+TPU-native counterpart of the reference's FP8/TransformerEngine path
+(``dense_module.py:2365-2453``): on TPU the MXU's low-precision mode is
+int8 with int32 accumulation, so the quantized analytical tables key on
+``int8_matmul``. This module runs REAL int8 GEMMs for all three
+backprop stages (fwd NN, dgrad NT, wgrad TN) with per-tensor symmetric
+scales, so an int8 accuracy-table row measures the same kernel mix the
+analytical ``fp8=True, quant_dtype="int8"`` path costs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _q8(x):
+    """Per-tensor symmetric int8 quantization -> (q, scale)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32))) + 1e-6
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _mm(a, b, ta=False, tb=False):
+    """int8 x int8 -> int32 matmul of 2D operands with optional
+    transposes expressed via contraction dims (NOT materialized
+    transposes — the MXU sees the NN/NT/TN layouts the efficiency
+    tables key on)."""
+    ca = 0 if ta else 1
+    cb = 1 if tb else 0
+    return jax.lax.dot_general(
+        a, b, (((ca,), (cb,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+@jax.custom_vjp
+def int8_matmul(x, w):
+    """``x [..., k] @ w [k, n]`` with int8 operands in every backprop
+    stage; returns bf16."""
+    return _int8_fwd_only(x, w)
+
+
+def _int8_fwd_only(x, w):
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    qx, sx = _q8(x2)
+    qw, sw = _q8(w)
+    y = _mm(qx, qw).astype(jnp.float32) * (sx * sw)
+    return y.astype(jnp.bfloat16).reshape(*shape[:-1], w.shape[-1])
+
+
+def _int8_fwd(x, w):
+    return _int8_fwd_only(x, w), (x, w)
+
+
+def _int8_bwd(res, g):
+    x, w = res
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    g2 = g.reshape(-1, g.shape[-1])
+    qg, sg = _q8(g2)
+    qw, sw = _q8(w)
+    qx, sx = _q8(x2)
+    # dgrad: g [m, n] @ w^T -> NT layout
+    dx = _mm(qg, qw, tb=True).astype(jnp.float32) * (sg * sw)
+    # wgrad: x^T [k, m] @ g [m, n] -> TN layout
+    dw = _mm(qx, qg, ta=True).astype(jnp.float32) * (sx * sg)
+    return (
+        dx.astype(x.dtype).reshape(shape),
+        dw.astype(w.dtype),
+    )
+
+
+int8_matmul.defvjp(_int8_fwd, _int8_bwd)

@@ -2,9 +2,14 @@
 
 Reference: ``simumax/core/simu_memory.py`` (``SimuMemoryTracker``: token
 lifetimes with strict size checking, Chrome counter events, snapshot
-records). The torch ``memory_viz`` pickle export is GPU-tooling-specific
-and is replaced by a plain JSON snapshot (schema
-``simumax_tpu_memory_snapshot_v1``) consumable by any plotting tool.
+records, and a ``torch.cuda.memory._snapshot()``-compatible pickle for
+the memory-viz web tool, ``simu_memory.py:212-556``). Both exports ship
+here: a plain JSON snapshot (schema ``simumax_tpu_memory_snapshot_v1``)
+for any plotting tool, and :func:`memory_viz_snapshot` producing the
+torch memory-viz trace format (load the pickle at pytorch.org/memory_viz
+— each simulated token appears as an alloc/free pair whose stack frame
+carries the op path, so the "Active Memory Timeline" view shows
+per-op attribution over virtual time).
 """
 
 from __future__ import annotations
@@ -25,9 +30,14 @@ class SimuMemoryTracker:
     ``simu_memory.py:65-127``): every cache allocation is a token that
     must be freed exactly once with the same size."""
 
-    def __init__(self, rank: int, static_bytes: float = 0.0):
+    def __init__(self, rank: int, static_bytes: float = 0.0,
+                 record_events: bool = True):
         self.rank = rank
         self.static_bytes = static_bytes
+        #: keep the per-event alloc/free trace for the memory-viz
+        #: export; runs that will never export (no save_path) disable
+        #: it to skip the dead per-event work
+        self.record_events = record_events
         self.cur = static_bytes
         self.peak = static_bytes
         self.peak_time = 0.0
@@ -43,6 +53,14 @@ class SimuMemoryTracker:
         #: copy happens once, when the plateau ends.
         self.peak_holders: Dict[str, float] = {}
         self._peak_pending = False
+        #: per-event trace for the memory-viz export: ("alloc"|"free",
+        #: t, nbytes, key, addr). Addresses come from a virtual bump
+        #: allocator so the viz tool can pair alloc/free events.
+        self.events: List[tuple] = []
+        self._next_addr = 1 << 20
+        self._addr_fifo: Dict[str, List[tuple]] = {}
+        if static_bytes and record_events:
+            self.events.append(("alloc", 0.0, static_bytes, "<static>", 0))
 
     def _flush_peak(self):
         self.peak_holders = {k: v for k, v in self._live.items() if v}
@@ -59,6 +77,11 @@ class SimuMemoryTracker:
         else:
             key = f"<{tag or 'anon'}>"
         self._live[key] = self._live.get(key, 0.0) + nbytes
+        if self.record_events:
+            addr = self._next_addr
+            self._next_addr += int(nbytes)
+            self._addr_fifo.setdefault(key, []).append((addr, nbytes))
+            self.events.append(("alloc", t, nbytes, key, addr))
         self.cur += nbytes
         if self.cur > self.peak:
             self.peak = self.cur
@@ -89,6 +112,10 @@ class SimuMemoryTracker:
         self._live[key] = max(self._live.get(key, 0.0) - nbytes, 0.0)
         if nbytes == 0:
             return
+        if self.record_events:
+            fifo = self._addr_fifo.get(key)
+            addr = fifo.pop(0)[0] if fifo else 0
+            self.events.append(("free", t, nbytes, key, addr))
         self.cur -= nbytes
         if self.cur < self.static_bytes - 1:
             raise RuntimeError(
@@ -154,3 +181,45 @@ class SimuMemoryTracker:
                 for s in self.timeline
             ],
         }
+
+
+def memory_viz_snapshot(tracker: SimuMemoryTracker) -> dict:
+    """Convert a tracker's event trace into the
+    ``torch.cuda.memory._snapshot()`` structure the PyTorch memory-viz
+    web tool loads (reference parity: ``simu_memory.py:212-556``).
+
+    Each simulated allocation becomes an ``alloc`` /``free_completed``
+    pair; the op path (token category) is encoded as the top stack
+    frame, phase (fwd/bwd/recompute tags come through the token text)
+    as ``filename``, so the Active Memory Timeline colors by op.
+    Virtual time (seconds) is exported as integer microseconds.
+    """
+    trace = []
+    for action, t, nbytes, key, addr in tracker.events:
+        cat = SimuMemoryTracker._category(key)
+        trace.append({
+            "action": "alloc" if action == "alloc" else "free_completed",
+            "addr": int(addr),
+            "size": int(nbytes),
+            "stream": 0,
+            "time_us": int(t * 1e6),
+            "frames": [{
+                "name": cat,
+                "filename": key,
+                "line": 0,
+            }],
+        })
+    return {
+        "segments": [],
+        "device_traces": [trace],
+    }
+
+
+def export_memory_viz(tracker: SimuMemoryTracker, path: str) -> str:
+    """Write the memory-viz pickle (open at pytorch.org/memory_viz)."""
+    import pickle
+
+    snap = memory_viz_snapshot(tracker)
+    with open(path, "wb") as f:
+        pickle.dump(snap, f)
+    return path

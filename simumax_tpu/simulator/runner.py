@@ -90,7 +90,8 @@ def run_simulation(
                 c.param_info.total_bytes for c in perf.stage_chunks(s)
             )
             tracker = (
-                SimuMemoryTracker(s, static_bytes=static)
+                SimuMemoryTracker(s, static_bytes=static,
+                                  record_events=save_path is not None)
                 if track_memory
                 else None
             )
@@ -132,6 +133,15 @@ def run_simulation(
                 os.path.join(save_path, "simu_memory_snapshot.json"), "w"
             ) as f:
                 json.dump(snaps, f)
+            # torch memory-viz parity artifact (pytorch.org/memory_viz):
+            # rank 0's per-op alloc/free trace (reference
+            # simu_memory.py:212-556 pickle analog)
+            from simumax_tpu.simulator.memory import export_memory_viz
+
+            result["memory_viz_path"] = export_memory_viz(
+                trackers[0],
+                os.path.join(save_path, "memory_viz_snapshot.pickle"),
+            )
             try:
                 from simumax_tpu.simulator.plot import plot_memory_timeline
 

@@ -448,3 +448,55 @@ class TestWorldRanks:
         p = run("tp2_pp1_dp4_mbs1")
         r = analyze_stragglers(p, {})
         assert r["inflation"] == pytest.approx(1.0)
+
+
+class TestMemoryVizExport:
+    """torch memory-viz parity artifact (VERDICT r2 #8): the simulator
+    exports a ``torch.cuda.memory._snapshot()``-shaped pickle whose
+    alloc/free trace carries per-op attribution."""
+
+    def _tracker(self):
+        from simumax_tpu.simulator.memory import SimuMemoryTracker
+
+        tr = SimuMemoryTracker(0, static_bytes=1024)
+        tr.alloc(0.001, 512, token="mb0:layer0.attention#1")
+        tr.alloc(0.002, 256, token="mb0:layer0.mlp#2")
+        tr.free(0.003, token="mb0:layer0.mlp#2")
+        tr.free(0.004, token="mb0:layer0.attention#1")
+        return tr
+
+    def test_snapshot_structure_and_pairing(self):
+        from simumax_tpu.simulator.memory import memory_viz_snapshot
+
+        snap = memory_viz_snapshot(self._tracker())
+        assert set(snap) == {"segments", "device_traces"}
+        trace = snap["device_traces"][0]
+        allocs = {e["addr"]: e for e in trace if e["action"] == "alloc"}
+        frees = [e for e in trace if e["action"] == "free_completed"]
+        for e in frees:  # every free pairs an alloc at the same addr/size
+            assert e["addr"] in allocs
+            assert allocs[e["addr"]]["size"] == e["size"]
+        # attribution: op path in the frame, category collapsed
+        names = {e["frames"][0]["name"] for e in trace}
+        assert "layer0.attention" in names and "layer0.mlp" in names
+
+    def test_loadable_by_torch_memory_viz(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from torch.cuda import _memory_viz as mv
+
+        from simumax_tpu.simulator.memory import export_memory_viz
+
+        path = export_memory_viz(self._tracker(), str(tmp_path / "mv.pickle"))
+        import pickle
+
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        html = mv.trace_plot(snap)  # torch's own viewer accepts it
+        # the viewer embeds the trace base64-pickled; success == it
+        # produced the timeline page without raising on our structure
+        assert "Active Memory Timeline" in html and len(html) > 500
+
+    def test_runner_emits_pickle(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1")
+        res = p.simulate(str(tmp_path), granularity="leaf")
+        assert os.path.exists(res["memory_viz_path"])

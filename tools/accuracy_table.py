@@ -63,6 +63,7 @@ ROWS = [
     ("llama-0.5B remat", "dense", 2048, 1, 6, True),
     ("llama-0.5B mbs2", "dense", 1024, 2, 6, False),
     ("llama-0.5B flash(pallas)", "flash", 2048, 1, 6, False),
+    ("llama-0.5B int8", "int8", 2048, 1, 6, False),
     ("moe-8e-top2 bf16", "moe", 2048, 1, 4, False),
 ]
 
@@ -95,7 +96,8 @@ def measure(kind, mc, seq, mbs, layers, remat, iters=8):
         )
 
         cfg = LlamaConfig.from_model_config(
-            mc, layer_num=layers, use_pallas_attn=(kind == "flash")
+            mc, layer_num=layers, use_pallas_attn=(kind == "flash"),
+            use_int8=(kind == "int8"),
         )
         params = init_params(cfg, jax.random.PRNGKey(0))
         init_opt, train_step = make_train_step(
@@ -124,6 +126,7 @@ def predict(mc, seq, mbs, layers, remat, system, kind="dense"):
         micro_batch_size=mbs, micro_batch_num=1, zero_state=0,
         use_flash_sdp=flash, use_math_sdp=not flash,
         sdp_backend="pallas" if flash else "xla",
+        fp8=(kind == "int8"), quant_dtype="int8",
         # jax.grad of bf16 params yields bf16 cotangents (see bench.py)
         use_fp32_accum_grad=False, optimizer_style="functional",
         enable_recompute=remat, recompute_granularity="full_block",
