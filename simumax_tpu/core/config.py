@@ -342,6 +342,13 @@ class RecomputeConfig:
     mlp_recompute: bool = False
     mlp_norm_recompute: bool = False
     sdp_recompute: bool = False
+    #: variance-tail optimisation (reference ``config.py:264,416-418``):
+    #: the LAST leaf of each checkpointed segment skips its forward
+    #: replay — its backward only needs the recomputed *input* produced
+    #: by the preceding replay, never its own output. Only meaningful
+    #: for selective recompute; Megatron full-block recompute does not
+    #: support it (reference ``config.py:690``), so it is forced off.
+    variance: bool = False
 
     @classmethod
     def from_strategy_dict(cls, d: Dict[str, Any]) -> "RecomputeConfig":
@@ -356,6 +363,7 @@ class RecomputeConfig:
             mlp_recompute=d.get("mlp_recompute", False),
             mlp_norm_recompute=d.get("mlp_rms_recompute", False),
             sdp_recompute=d.get("sdp_recompute", False),
+            variance=d.get("recompute_variance", False),
         )
         if gran == "full_recompute":
             cfg.granularity = "full_block"
@@ -372,6 +380,8 @@ class RecomputeConfig:
             cfg.granularity = "selective"
             cfg.mlp_recompute = True
             cfg.mlp_norm_recompute = True
+        if cfg.granularity == "full_block":
+            cfg.variance = False  # full-block recompute replays everything
         return cfg
 
     @property
@@ -467,6 +477,7 @@ class StrategyConfig(ConfigBase):
     mlp_recompute: bool = False
     mlp_rms_recompute: bool = False
     sdp_recompute: bool = False
+    recompute_variance: bool = False
 
     mem_factor: float = 0.94  # usable fraction of HBM
     enable_straggler_model: bool = False
@@ -484,6 +495,7 @@ class StrategyConfig(ConfigBase):
                 "mlp_recompute": self.mlp_recompute,
                 "mlp_rms_recompute": self.mlp_rms_recompute,
                 "sdp_recompute": self.sdp_recompute,
+                "recompute_variance": self.recompute_variance,
             }
         )
 

@@ -21,6 +21,7 @@ class GraphNode:
     inputs: List[int]
     outputs: List[int]
     recompute: bool = False
+    variance: bool = False  # segment tail skipped under recompute_variance
     fwd_ms: float = 0.0
     cache_mib: float = 0.0
 
@@ -40,6 +41,7 @@ class GraphBuilder:
             inputs=[t.uid for t in leaf.inputs],
             outputs=[t.uid for t in leaf.outputs],
             recompute=leaf.in_recompute,
+            variance=getattr(leaf, "variance_tail", False),
             fwd_ms=leaf.cost_info.fwd_time * 1e3,
             cache_mib=leaf.act_info.cache_bytes / 2**20,
         )
@@ -74,7 +76,12 @@ class GraphBuilder:
         nodes tinted, node label = op + fwd time + cache."""
         lines = ["digraph simumax {", "  rankdir=TB;", "  node [shape=box, fontsize=9];"]
         for i, n in enumerate(self.nodes):
-            color = "lightsalmon" if n.recompute else "lightblue2"
+            if n.variance:
+                color = "yellow"  # replay-skipped tail (reference graph.py:322)
+            elif n.recompute:
+                color = "lightsalmon"
+            else:
+                color = "lightblue2"
             label = f"{n.name}\\n{n.op_type} {n.fwd_ms:.3f}ms {n.cache_mib:.1f}MiB"
             lines.append(
                 f'  n{i} [label="{label}", style=filled, fillcolor={color}];'
@@ -88,3 +95,16 @@ class GraphBuilder:
         with open(path, "w") as f:
             f.write(self.to_dot())
         return path
+
+    def render(self, path: str, fmt: str = "svg") -> str:
+        """Render via the ``graphviz`` python package when a ``dot``
+        binary is available (reference ``visualize_with_graphviz``
+        ``graph.py:272-352``); otherwise fall back to writing the DOT
+        source next to ``path`` so the user can render elsewhere."""
+        try:
+            import graphviz
+
+            src = graphviz.Source(self.to_dot())
+            return src.render(outfile=f"{path}.{fmt}", cleanup=True)
+        except Exception:  # no dot binary / package: DOT text fallback
+            return self.save_dot(f"{path}.dot")

@@ -79,6 +79,11 @@ class MetaModule:
         self.recompute = False  # whole-subtree checkpoint flag
         self.recompute_status = RecomputeStatus.NONE
         self.in_recompute = False
+        #: variance-tail leaf (reference ``base_struct.py:314,335-337``):
+        #: last leaf of its checkpoint segment; its fwd replay is skipped
+        #: under ``recompute_variance`` because its backward consumes the
+        #: recomputed *input*, not its own output.
+        self.variance_tail = False
         # filled by __call__
         self.inputs: Tuple[TensorSpec, ...] = ()
         self.outputs: Tuple[TensorSpec, ...] = ()
@@ -366,9 +371,14 @@ class MetaModule:
             call.exposed_time = call.time if call.exposed else 0.0
             cost.net_exposed.add(call.phase, call.exposed_time)
             cost.net_hidden.add(call.phase, call.time - call.exposed_time)
-        # recompute: the fwd work is replayed before bwd_act
+        # recompute: the fwd work is replayed before bwd_act; a
+        # variance-tail leaf skips the replay entirely (reference
+        # ``base_struct.py:750-756,854-858``)
         if self.in_recompute:
-            cost.recompute_time = cost.compute.fwd + cost.net_exposed.fwd
+            cost.recompute_time = (
+                0.0 if self.variance_tail
+                else cost.compute.fwd + cost.net_exposed.fwd
+            )
             # effective steady-state cache: only the segment input survives
             self.act_info.cache_bytes = 0.0
             if self.recompute_status == RecomputeStatus.FIRST:
@@ -391,6 +401,8 @@ class MetaModule:
                 leaf.recompute_status = RecomputeStatus.LAST
             else:
                 leaf.recompute_status = RecomputeStatus.MIDDLE
+        if leaves and self.ctx.strategy.recompute.variance:
+            leaves[-1].variance_tail = True
 
     # -- repr ---------------------------------------------------------------
     def __repr__(self):

@@ -233,7 +233,7 @@ class LLMModel(MetaModule):
         """Recompute wiring fingerprint: which leaves are checkpointed
         and how (layer_recomputes(idx) makes leading layers differ)."""
         return tuple(
-            (l.in_recompute, l.recompute_status.name)
+            (l.in_recompute, l.recompute_status.name, l.variance_tail)
             for l in blk.leaves()
         )
 
@@ -296,18 +296,30 @@ class LLMModel(MetaModule):
                 # replay fwd: raw caches come alive again; the saved segment
                 # input (FIRST leaf's effective cache) is reused, not
                 # re-allocated, and is freed with FIRST's raw cache below.
+                # A variance-tail leaf is not replayed, so its raw cache
+                # never re-materialises; if the tail IS the first leaf
+                # (single-leaf segment) the saved input must stay live
+                # until that leaf's backward consumes it.
                 saved = seg_leaves[0].act_info.cache_bytes
+                tail_is_first = seg_leaves[0].variance_tail
                 for sl in seg_leaves:
+                    if sl.variance_tail:
+                        continue
                     live += sl.raw_act_info.cache_bytes
                     bump(sl.path_name(), "recompute",
                          live - saved + sl.raw_act_info.fwd_temp_bytes)
-                live -= saved
+                if not tail_is_first:
+                    live -= saved
                 # consume raw caches in reverse as bwd proceeds
                 for sl in reversed(seg_leaves):
                     bump(sl.path_name(), "bwd",
                          live + sl.raw_act_info.bwd_temp_bytes
                          + sl.raw_act_info.grad_flight_bytes)
-                    live -= sl.raw_act_info.cache_bytes
+                    if sl.variance_tail:
+                        if sl is seg_leaves[0]:
+                            live -= saved
+                    else:
+                        live -= sl.raw_act_info.cache_bytes
                     done.add(id(sl))
                 i -= 1
                 continue

@@ -327,10 +327,15 @@ class StageProcess:
                         l for l in leaves
                         if getattr(l, "recompute_segment", None) is seg
                     ]
+                    # variance-tail leaves are not replayed (reference
+                    # ``base_struct.py:444-451``): no replay time, no
+                    # re-materialised cache; a single-leaf segment keeps
+                    # its saved input live until its own backward.
                     replay = sum(
                         sl.cost_info.compute.fwd * self.perturb
                         + sl.cost_info.net_exposed.fwd
                         for sl in seg_leaves
+                        if not sl.variance_tail
                     )
                     name = seg.path_name().split(".", 1)[-1]
                     saved = seg_leaves[0].act_info.cache_bytes
@@ -338,10 +343,10 @@ class StageProcess:
                                "comp")
                     clock[0] = t
                     for sl in seg_leaves:
-                        if sl.raw_act_info.cache_bytes:
+                        if sl.raw_act_info.cache_bytes and not sl.variance_tail:
                             self._alloc(t, sl.raw_act_info.cache_bytes,
                                         self._token(mb, sl, "r:"), "recompute")
-                    if saved:
+                    if saved and not seg_leaves[0].variance_tail:
                         self._free(t, token=self._token(mb, seg_leaves[0]),
                                    tag="act")
                     for sl in reversed(seg_leaves):
@@ -359,7 +364,12 @@ class StageProcess:
                                        "comp")
                             clock[0] = t
                         self._free(clock[0], flight, tag="temp")
-                        if sl.raw_act_info.cache_bytes:
+                        if sl.variance_tail:
+                            if sl is seg_leaves[0] and saved:
+                                self._free(clock[0],
+                                           token=self._token(mb, sl),
+                                           tag="act")
+                        elif sl.raw_act_info.cache_bytes:
                             self._free(clock[0], token=self._token(mb, sl, "r:"),
                                        tag="recompute")
                         done.add(id(sl))

@@ -1,0 +1,144 @@
+"""Variance-tail recompute tests (reference ``recompute_variance``,
+``config.py:264`` + ``base_struct.py:314-337,444-451,750-756,854-858``):
+the LAST leaf of a checkpointed segment skips its forward replay — its
+backward needs the recomputed *input* produced by the preceding replay,
+never its own output — so replay time drops by exactly the tail's
+forward cost and the tail's cache never re-materialises."""
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_strategy_config
+
+
+def run(model="llama3-8b", system="tpu_v5e_256", **overrides):
+    p = PerfLLM()
+    st = get_strategy_config("tp2_pp1_dp4_mbs1_selective_recompute")
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    p.configure(st, model, system)
+    p.run_estimate()
+    return p
+
+
+def chunk_of(p):
+    return p.stage_chunks(0)[0]
+
+
+class TestMarking:
+    def test_tail_leaf_marked_per_segment(self):
+        p = run(recompute_variance=True)
+        segments = {}
+        for leaf in chunk_of(p).leaves():
+            if leaf.in_recompute:
+                seg = leaf.recompute_segment
+                segments.setdefault(id(seg), []).append(leaf)
+        assert segments, "selective recompute should create segments"
+        for leaves in segments.values():
+            tails = [l for l in leaves if l.variance_tail]
+            assert tails == [leaves[-1]]
+
+    def test_off_by_default(self):
+        p = run()
+        assert not any(
+            l.variance_tail for l in chunk_of(p).leaves()
+        )
+
+    def test_full_block_forces_variance_off(self):
+        st = get_strategy_config("tp2_pp1_dp4_mbs1_full_recompute")
+        st.recompute_variance = True
+        st.__post_init__()
+        assert st.recompute.variance is False
+        p = PerfLLM()
+        p.configure(st, "llama3-8b", "tpu_v5e_256")
+        p.run_estimate()
+        assert not any(
+            l.variance_tail for l in chunk_of(p).leaves()
+        )
+
+
+class TestCost:
+    def test_replay_time_drops_by_tail_fwd_cost(self):
+        base = run()
+        var = run(recompute_variance=True)
+        t_base = sum(
+            l.cost_info.recompute_time for l in chunk_of(base).leaves()
+        )
+        t_var = sum(
+            l.cost_info.recompute_time for l in chunk_of(var).leaves()
+        )
+        tails_fwd = sum(
+            l.cost_info.compute.fwd + l.cost_info.net_exposed.fwd
+            for l in chunk_of(var).leaves()
+            if l.variance_tail
+        )
+        assert tails_fwd > 0
+        assert t_base - t_var == pytest.approx(tails_fwd, rel=1e-9)
+
+    def test_iter_time_strictly_improves(self):
+        base = run().analysis_cost()["iter_time"]
+        var = run(recompute_variance=True).analysis_cost()["iter_time"]
+        assert var < base
+
+
+class TestMemoryAndSim:
+    def test_conservation_and_peak_not_larger(self):
+        # compute_activations asserts live==0 internally; the peak can
+        # only shrink (tail caches never re-materialise during replay)
+        base = run().analysis_mem()
+        var = run(recompute_variance=True).analysis_mem()
+        for b, v in zip(base["stages"], var["stages"]):
+            assert v["peak_bytes"] <= b["peak_bytes"] + 1024
+
+    def test_simulator_agrees_with_analytical(self):
+        p = run(recompute_variance=True)
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None, granularity="leaf")
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.03)
+
+    def test_simulator_memory_conserves(self):
+        p = run(recompute_variance=True)
+        sim = p.simulate(None)
+        for m in sim["memory"]:
+            assert m["peak_bytes"] > 0
+
+    def test_single_leaf_segment_norm_recompute(self):
+        # attn_norm-only recompute creates single-leaf segments whose
+        # FIRST leaf IS the tail: the saved input must survive until the
+        # leaf's own backward (no replay at all happens)
+        p = run(
+            attn_recompute=False,
+            mlp_recompute=False,
+            attn_norm_recompute=True,
+            mlp_rms_recompute=True,
+            sdp_recompute=False,
+            recompute_variance=True,
+        )
+        tails = [
+            l for l in chunk_of(p).leaves() if l.variance_tail
+        ]
+        assert tails
+        assert all(l.recompute_status.name == "FIRST" for l in tails)
+        assert sum(
+            l.cost_info.recompute_time for l in chunk_of(p).leaves()
+        ) == 0.0
+        # analytical + simulated paths stay consistent
+        analytical = p.analysis_cost()["iter_time"]
+        sim = p.simulate(None, granularity="leaf")
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.03)
+
+
+class TestGraph:
+    def test_graph_marks_variance_nodes(self):
+        p = PerfLLM()
+        st = get_strategy_config("tp2_pp1_dp4_mbs1_selective_recompute")
+        st.recompute_variance = True
+        st.__post_init__()
+        p.configure(st, "llama3-8b", "tpu_v5e_256")
+        p.run_estimate(capture_graph=True)
+        g = p.ctx.graph
+        variant = [n for n in g.nodes if n.variance]
+        assert variant
+        dot = g.to_dot()
+        assert "yellow" in dot
