@@ -1,0 +1,51 @@
+"""DeepSeek-V2 (layer-truncated l4) ep8 pp1: recompute variants
+(reference examples ``perf_deepseekv2_layer4_ep8_pp1.py`` +
+``..._full_recompute.py`` + ``..._selective_recompute.py``
+consolidated): MoE a2a dispatch under EP with the three recompute
+families."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+VARIANTS = {
+    "none": {},
+    "full_block": dict(
+        enable_recompute=True, recompute_granularity="full_block"
+    ),
+    "selective": dict(
+        enable_recompute=True,
+        recompute_granularity="selective",
+        attn_recompute=True,
+        mla_rms_recompute=True,
+    ),
+}
+
+
+def run(overrides):
+    model = get_model_config("deepseekv2")
+    model.layer_num = 4
+    st = get_strategy_config("ep8_pp1_dp8_mbs1")
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    perf = PerfLLM().configure(st, model, "tpu_v5p_256")
+    perf.run_estimate()
+    c, m = perf.analysis_cost(), perf.analysis_mem()
+    return c["mfu"], c["iter_time_ms"], m["max_peak_gib"]
+
+
+def main():
+    print("deepseekv2-l4 ep8 dp8 on 8x v5p")
+    print(f"{'recompute':>12} {'mfu %':>7} {'iter ms':>9} {'peak GiB':>9}")
+    for name, overrides in VARIANTS.items():
+        mfu, ms, gib = run(overrides)
+        print(f"{name:>12} {mfu * 100:>7.2f} {ms:>9.1f} {gib:>9.1f}")
+
+
+if __name__ == "__main__":
+    main()

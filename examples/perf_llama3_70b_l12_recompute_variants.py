@@ -1,0 +1,59 @@
+"""Llama-3-70B (layer-truncated l12) tp2: no-recompute vs full-block
+vs selective vs selective+variance-tail (reference examples
+``perf_llama3_70b_layer12_tp2{,_full_recompute,_selective_recompute}.py``
+consolidated): the classic memory-for-time trade."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+
+VARIANTS = {
+    "none": {},
+    "full_block": dict(
+        enable_recompute=True, recompute_granularity="full_block"
+    ),
+    "selective": dict(
+        enable_recompute=True,
+        recompute_granularity="selective",
+        attn_recompute=True,
+        mlp_recompute=True,
+    ),
+    "selective+variance": dict(
+        enable_recompute=True,
+        recompute_granularity="selective",
+        attn_recompute=True,
+        mlp_recompute=True,
+        recompute_variance=True,
+    ),
+}
+
+
+def run(overrides):
+    model = get_model_config("llama3-70b")
+    model.layer_num = 12
+    st = get_strategy_config("tp2_pp1_dp4_mbs1")
+    st.world_size = 8
+    st.micro_batch_num = 8
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    perf = PerfLLM().configure(st, model, "tpu_v5p_256")
+    perf.run_estimate()
+    c, m = perf.analysis_cost(), perf.analysis_mem()
+    return c["mfu"], c["iter_time_ms"], m["max_peak_gib"]
+
+
+def main():
+    print("llama3-70b-l12 tp2 dp4 on 8x v5p")
+    print(f"{'recompute':>20} {'mfu %':>7} {'iter ms':>9} {'peak GiB':>9}")
+    for name, overrides in VARIANTS.items():
+        mfu, ms, gib = run(overrides)
+        print(f"{name:>20} {mfu * 100:>7.2f} {ms:>9.1f} {gib:>9.1f}")
+
+
+if __name__ == "__main__":
+    main()

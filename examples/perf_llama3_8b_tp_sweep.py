@@ -1,0 +1,37 @@
+"""Llama-3-8B tp 1/2/4/8 sweep on TPU v5p (reference examples
+``perf_llama3_8b_tp2.py`` / ``_tp4.py`` / ``_tp8.py`` consolidated):
+how the TP all-gather/reduce-scatter cost eats into MFU as the shard
+count grows past the per-chip memory need."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_strategy_config
+
+
+def run(tp):
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = 8
+    st.tp_size = tp
+    # keep the global batch fixed at 64 as dp shrinks (gbs = mbs*mbc*dp)
+    st.micro_batch_num = 8 * tp
+    st.__post_init__()
+    perf = PerfLLM().configure(st, "llama3-8b", "tpu_v5p_256")
+    perf.run_estimate()
+    c, m = perf.analysis_cost(), perf.analysis_mem()
+    return c["mfu"], c["iter_time_ms"], m["max_peak_gib"]
+
+
+def main():
+    print("llama3-8b on 8x v5p, gbs fixed (dp shrinks as tp grows)")
+    print(f"{'tp':>3} {'mfu %':>7} {'iter ms':>9} {'peak GiB':>9}")
+    for tp in (1, 2, 4, 8):
+        mfu, ms, gib = run(tp)
+        print(f"{tp:>3} {mfu * 100:>7.2f} {ms:>9.1f} {gib:>9.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""List every registered model / strategy / system config (reference
+``examples/show_simu_avaliable_modes.py`` + ``show_simu_*`` tables)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from simumax_tpu.core.config import list_configs
+
+
+def main():
+    for kind, names in list_configs().items():
+        print(f"== {kind} ({len(names)})")
+        for n in sorted(names):
+            print(f"   {n}")
+
+
+if __name__ == "__main__":
+    main()

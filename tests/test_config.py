@@ -243,3 +243,42 @@ class TestStrategyConfig:
         mc.head_size = 128
         mc.hidden_size = 512
         PerfLLM().configure(st, mc, "tpu_v5e_256")
+
+
+class TestShippedSystemConfigs:
+    """Every registered system config must load, pass sanity, and price
+    an estimate (guards new hardware configs like tpu_v6e_256)."""
+
+    def _names(self):
+        from simumax_tpu.core.config import list_configs
+
+        return list_configs()["system"]
+
+    def test_registry_has_all_generations(self):
+        names = self._names()
+        for expected in (
+            "tpu_v5e_256", "tpu_v5e_calibrated", "tpu_v5p_256",
+            "tpu_v6e_256",
+        ):
+            assert expected in names
+
+    def test_all_system_configs_estimate(self):
+        from simumax_tpu.perf import PerfLLM
+
+        for name in self._names():
+            p = PerfLLM().configure("tp2_pp1_dp4_mbs1", "llama2-7b", name)
+            p.run_estimate()
+            cost = p.analysis_cost()
+            assert 0.0 < cost["mfu"] < 1.0, name
+
+    def test_v6e_prices_above_v5e(self):
+        """Trillium has ~4.7x the flops and 2x the HBM bandwidth of
+        v5e: the same config must be strictly faster."""
+        from simumax_tpu.perf import PerfLLM
+
+        def iter_ms(system):
+            p = PerfLLM().configure("tp2_pp1_dp4_mbs1", "llama2-7b", system)
+            p.run_estimate()
+            return p.analysis_cost()["iter_time_ms"]
+
+        assert iter_ms("tpu_v6e_256") < 0.5 * iter_ms("tpu_v5e_256")

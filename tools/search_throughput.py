@@ -14,10 +14,11 @@ tp x pp x recompute-family space, counting full analytical estimates
 (``run_estimate`` calls) and wall time.
 
 Caveats, stated in the output: the two frameworks price different
-hardware (TPU v5e vs B200) with different cost models, so per-estimate
-work is similar but not identical; both get their own memoization; the
-reference prints per-candidate tables (suppressed to /dev/null so IO
-does not bias it).
+hardware (TPU v5p vs B200 — both HBM-rich enough that the same
+candidate space has feasible members) with different cost models, so
+per-estimate work is similar but not identical; both get their own
+memoization; the reference prints per-candidate tables (suppressed so
+IO does not bias it).
 
 Usage: python tools/search_throughput.py [--md docs/search_throughput.md]
 """
@@ -167,7 +168,7 @@ memoization, as a user would experience it.
 | reference (simumax, B200 config) | {ref_wall} | {ref_est} | {ref_eps} | 1.0x |
 | **simumax_tpu (v5p config)** | **{our_wall}** | {our_est} | **{our_eps}** | **{speedup}x** |
 
-Caveats: the frameworks price different hardware (B200 vs TPU v5e)
+Caveats: the frameworks price different hardware (B200 vs TPU v5p)
 with different collective/cost models, so the per-estimate work is
 comparable but not identical; candidate pruning differs slightly (the
 reference prunes inside its recompute-layer binary search, this repo
