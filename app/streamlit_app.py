@@ -58,6 +58,35 @@ with st.sidebar:
 
 model = get_model_config(model_name)
 strategy = get_strategy_config(strategy_name)
+system = get_system_config(system_name)
+
+
+def _fnum(label, value, min_value=0.001):
+    # plain st.number_input so the widget lands INSIDE the active
+    # `with <container>` block (st.sidebar.* always targets sidebar root)
+    return float(st.number_input(
+        label, value=float(value), min_value=float(min_value)
+    ))
+
+
+# -- hardware editor (reference app's 硬件参数配置 section) ---------------
+with st.sidebar.expander("hardware overrides"):
+    base_tflops = system.accelerator.op["default"].tflops
+    new_tflops = _fnum("bf16 TFLOPs/chip", base_tflops)
+    new_mem = _fnum("HBM GiB/chip", system.accelerator.mem_gbs)
+    base_hbm = system.accelerator.bandwidth["default"].gbps
+    new_hbm = _fnum("HBM GB/s", base_hbm)
+    new_ici = _fnum("ICI link GB/s", system.ici.link_gbps)
+    if new_tflops != base_tflops:
+        scale = new_tflops / base_tflops
+        for op in system.accelerator.op.values():
+            op.tflops *= scale  # int8 classes keep their 2x ratio
+    system.accelerator.mem_gbs = new_mem
+    if new_hbm != base_hbm:
+        scale = new_hbm / base_hbm
+        for bw in system.accelerator.bandwidth.values():
+            bw.gbps *= scale
+    system.ici.link_gbps = new_ici
 
 st.sidebar.subheader("parallelism")
 strategy.world_size = _num("world_size", strategy.world_size)
@@ -72,6 +101,22 @@ st.sidebar.subheader("batch / sequence")
 strategy.seq_len = _num("seq_len", strategy.seq_len, step=1024)
 strategy.micro_batch_size = _num("micro_batch_size", strategy.micro_batch_size)
 strategy.micro_batch_num = _num("micro_batch_num", strategy.micro_batch_num)
+_dtypes = ["bf16", "fp32"]
+strategy.dtype = st.sidebar.selectbox(
+    "dtype", _dtypes,
+    index=_dtypes.index(strategy.dtype) if strategy.dtype in _dtypes else 0,
+)
+with st.sidebar.expander("advanced pipeline options"):
+    # uneven PP (reference app's PP层数高级选项): 0 = even split.
+    # plain st.number_input so the widgets land inside the expander.
+    strategy.num_layers_in_first_pipeline_stage = int(st.number_input(
+        "layers in first stage (0 = even)",
+        value=int(strategy.num_layers_in_first_pipeline_stage), min_value=0,
+    ))
+    strategy.num_layers_in_last_pipeline_stage = int(st.number_input(
+        "layers in last stage (0 = even)",
+        value=int(strategy.num_layers_in_last_pipeline_stage), min_value=0,
+    ))
 
 st.sidebar.subheader("recompute")
 _grans = ["none", "full_block", "selective", "attn_only", "mlp_only"]
@@ -129,7 +174,7 @@ tab_est, tab_mem, tab_sim, tab_search = st.tabs(
 
 if st.button("estimate"):
     try:
-        perf = PerfLLM().configure(strategy, model, system_name)
+        perf = PerfLLM().configure(strategy, model, system)
     except ConfigError as e:
         st.error(f"infeasible config: {e}")
         st.stop()
@@ -172,10 +217,57 @@ if st.button("estimate"):
                 "misses — run `python -m simumax_tpu calibrate` on a TPU "
                 "to refine the prediction."
             )
+        # warnings / suggestions (reference app's 警告信息 + 提示/建议)
+        st.subheader("warnings / suggestions")
+        warnings = []
+        if not mem["fits"]:
+            warnings.append(
+                f"peak {mem['max_peak_gib']:.1f} GiB exceeds usable HBM — "
+                "enable recompute, raise zero_state (FSDP=3), increase "
+                "tp/pp, or use more chips"
+            )
+        dcn_dims = [
+            d for d, p in perf.ctx.paths.items() if p.on_dcn
+        ]
+        if dcn_dims:
+            warnings.append(
+                f"parallel dims {', '.join(dcn_dims)} spill onto DCN "
+                "(~100x less bandwidth than ICI) — prefer layouts that "
+                "keep tp/cp/ep inside the slice"
+            )
+        bubble = cost.get("bubble_time", 0.0) / max(cost["iter_time"], 1e-9)
+        if bubble > 0.2:
+            warnings.append(
+                f"pipeline bubble is {bubble * 100:.0f}% of the "
+                "iteration — raise micro_batch_num or use interleaving "
+                "(vpp)"
+            )
+        if warnings:
+            for w in warnings:
+                st.write(f"- {w}")
+        else:
+            st.write("none — configuration looks healthy")
+        with st.expander("realized collective bandwidths (GB/s)"):
+            st.json(perf.ctx.system.real_comm_bw)
 
     with tab_mem:
         st.subheader("per-stage memory")
         st.dataframe(mem["stages"])
+        # per-stage breakdown (reference app's 模型内存细分 expander)
+        # model_bytes = weight + grad + optimizer_state (an aggregate)
+        # and peak/replay_peak are metrics, not components — exclude so
+        # the component rows sum to real memory
+        _components = (
+            "weight_bytes", "grad_bytes", "optimizer_state_bytes",
+            "act_cache_per_microbatch_bytes",
+        )
+        for s in mem["stages"]:
+            with st.expander(f"stage {s['stage']} breakdown"):
+                st.dataframe([
+                    {"component": k.replace("_bytes", ""),
+                     "GiB": round(s[k] / 2**30, 3)}
+                    for k in _components if k in s
+                ])
 
     artifacts = {
         "base_info.json": result["base_info"],
@@ -234,7 +326,6 @@ with tab_search:
     if st.button("search batch split"):
         from simumax_tpu.search import search_micro_batch_config
 
-        system = get_system_config(system_name)
         dp = strategy.dp_size
         if dp < 1:
             st.error(

@@ -107,6 +107,9 @@ def evaluate_strategy(
             "peak_gib": mem["max_peak_gib"],
             "fits": fits,
             "net": {k: p.describe() for k, p in perf.ctx.paths.items()},
+            "dcn_dims": ",".join(
+                d for d, p in perf.ctx.paths.items() if p.on_dcn
+            ),
         }
         if not fits:
             row = {**row, "mfu": 0.0}
@@ -333,10 +336,6 @@ def search_best_parallel_strategy(
         uniq.append(r)
     rows = uniq
     rows.sort(key=lambda r: r["mfu"], reverse=True)
-    for r in rows:
-        r["dcn_dims"] = ",".join(
-            d for d, desc in r["net"].items() if "dcn[" in desc
-        )
     if csv_path:
         fields = [k for k in rows[0] if k != "net"] if rows else []
         with open(csv_path, "w", newline="") as f:

@@ -173,6 +173,24 @@ def test_app_renders_estimate_and_simulation(stub_streamlit, tmp_path,
     ]
     assert holder_tables, [d[:1] for d in rec.dataframes]
     assert rec.charts and rec.charts[0]["GiB"]
+    # warnings/suggestions section + realized-bandwidth expander rendered
+    assert ("subheader", ("warnings / suggestions",), {}) in rec.calls
+    bw_jsons = [
+        j for j in rec.jsons
+        if isinstance(j, dict) and j
+        and all(isinstance(v, dict) for v in j.values())
+        and any("all_gather" in v or "all_reduce" in v or "p2p" in v
+                for v in j.values())
+    ]
+    assert bw_jsons, "realized collective bandwidths not rendered"
+    # per-stage memory breakdown expanders rendered component tables
+    breakdown_tables = [
+        d for d in rec.dataframes
+        if d and isinstance(d[0], dict) and "component" in d[0]
+    ]
+    assert breakdown_tables
+    comps = {row["component"] for row in breakdown_tables[0]}
+    assert {"weight", "grad", "optimizer_state"} <= comps
     # the search tab found a feasible batch split at the default layout
     split_tables = [
         d for d in rec.dataframes
