@@ -226,14 +226,24 @@ if st.button("estimate"):
                 "enable recompute, raise zero_state (FSDP=3), increase "
                 "tp/pp, or use more chips"
             )
+        # pp across DCN is the recommended multi-slice layout (tiny p2p
+        # volume) — only warn when a bandwidth-heavy dim spills; dp_cp
+        # is the same physical group as dp, so don't list it twice
         dcn_dims = [
-            d for d, p in perf.ctx.paths.items() if p.on_dcn
+            d for d, p in perf.ctx.paths.items()
+            if p.on_dcn and d not in ("pp", "dp_cp")
         ]
         if dcn_dims:
+            hint = (
+                "enable overlap_grad_reduce/overlap_param_gather to hide "
+                "the DP gradient traffic, or try mesh_order='tp,cp,dp,pp' "
+                "to put pipeline p2p across slices instead"
+                if "dp" in dcn_dims
+                else "prefer layouts that keep tp/cp/ep inside the slice"
+            )
             warnings.append(
                 f"parallel dims {', '.join(dcn_dims)} spill onto DCN "
-                "(~100x less bandwidth than ICI) — prefer layouts that "
-                "keep tp/cp/ep inside the slice"
+                f"(~10-100x less bandwidth than ICI) — {hint}"
             )
         bubble = cost.get("bubble_time", 0.0) / max(cost["iter_time"], 1e-9)
         if bubble > 0.2:

@@ -426,6 +426,12 @@ class StrategyConfig(ConfigBase):
 
     moe_dispatcher_policy: str = "all2all"
     moe_capacity_factor: float = 0.0  # 0 => dropless (balanced assumption)
+    #: Megatron-0.14 combine-fusion (reference ``config.py:297``):
+    #: router probs ride their own EP all-to-all at dispatch and the
+    #: weighting fuses into the expert activation (weighted-SiLU), so
+    #: the combine step caches nothing. Trades a small probs a2a for
+    #: the pre-combine hidden-states cache.
+    dispatch_probs: bool = False
     enable_sequence_parallel: bool = True
     cp_comm_type: str = "a2a"  # a2a (Ulysses) | all_gather (ring/KV-gather)
     cp_a2a_mode: str = "sync_cp"  # sync_cp | async_cp
@@ -481,6 +487,13 @@ class StrategyConfig(ConfigBase):
 
     mem_factor: float = 0.94  # usable fraction of HBM
     enable_straggler_model: bool = False
+    #: innermost-first placement of the dense parallel dims on the ICI
+    #: torus / DCN (the TPU analog of the reference's per-dim net
+    #: selection ``tp_net..edp_net``). Default keeps pp outermost (it
+    #: spans DCN in multi-slice); "tp,cp,pp,dp" is the standard
+    #: multislice recipe — dp gradients over DCN (overlappable), pipeline
+    #: p2p inside the slice. tp must stay innermost (MXU sharding).
+    mesh_order: str = "tp,cp,dp,pp"
 
     def __post_init__(self):
         self.recompute = RecomputeConfig.from_strategy_dict(
@@ -638,6 +651,23 @@ class StrategyConfig(ConfigBase):
                 "sdp_backend='pallas' is the fused flash kernel — "
                 "use_flash_sdp must be set (math accounting would time "
                 "one kernel while modeling another)",
+            )
+        order = self.mesh_order.split(",")
+        _require(
+            sorted(order) == ["cp", "dp", "pp", "tp"],
+            f"mesh_order {self.mesh_order!r} must be a permutation of "
+            "tp,cp,dp,pp",
+        )
+        _require(
+            order[0] == "tp",
+            "mesh_order must keep tp innermost (MXU sharding rides the "
+            "fastest ICI axis)",
+        )
+        if self.mesh_order != "tp,cp,dp,pp":
+            _require(
+                self.ep_size == 1,
+                "non-default mesh_order with expert parallelism is not "
+                "modeled yet (the ep/edp overlay assumes pp outermost)",
             )
 
 

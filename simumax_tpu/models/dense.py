@@ -657,17 +657,34 @@ class KVAllGather(SeqAllGather):
 
 class Swiglu(LeafModule):
     """SwiGLU activation (reference ``dense_module.py:1874-2096``):
-    memory-bound; input is the concatenated ``[.., 2*f]`` projection."""
+    memory-bound; input is the concatenated ``[.., 2*f]`` projection.
+    ``weighted`` fuses the router-prob multiply into the activation
+    (reference ``is_weighted_silu``, the ``dispatch_probs`` MoE path):
+    one extra per-token fp32 prob is read each phase and cached for the
+    backward's dL/dprob term."""
+
+    def __init__(self, ctx, name="swiglu", weighted: bool = False):
+        super().__init__(ctx, name)
+        self.weighted = weighted
+
+    def _probs_bytes(self) -> float:
+        if not self.weighted:
+            return 0.0
+        b, s, _ = self.outputs[0].shape
+        return b * s * 4.0  # one fp32 prob per routed token copy
 
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         return x.split_dim(-1, 2)
 
     def op_accessed(self) -> Dict[str, float]:
         i, o = self.inputs[0].bytes, self.outputs[0].bytes
-        return {"fwd": i + o, "bwd_act": 2 * i + o}
+        p = self._probs_bytes()
+        return {"fwd": i + o + p, "bwd_act": 2 * i + o + p}
 
     def activation_info(self) -> ActivationInfo:
-        return ActivationInfo(cache_bytes=self.inputs[0].bytes)
+        return ActivationInfo(
+            cache_bytes=self.inputs[0].bytes + self._probs_bytes()
+        )
 
 
 class Gelu(LeafModule):

@@ -119,6 +119,18 @@ class Permutation(LeafModule):
             full = permuted * st.ep_size  # full logical tensor contract
             calls.append(CollectiveCall("fwd", "all2all", "ep", full, "pre"))
             calls.append(CollectiveCall("bwd_act", "all2all", "ep", full, "post"))
+            if st.dispatch_probs:
+                # router probs ride their own a2a to the experts
+                # (reference ``moe_module.py:407-424``)
+                b, s, _ = self.inputs[0].shape
+                probs_full = b * s * self.ctx.model.topk * 4 * st.ep_size
+                calls.append(
+                    CollectiveCall("fwd", "all2all", "ep", probs_full, "pre")
+                )
+                calls.append(
+                    CollectiveCall("bwd_act", "all2all", "ep", probs_full,
+                                   "post")
+                )
         return calls
 
 
@@ -143,6 +155,15 @@ class UnPermutation(LeafModule):
         return "permute_fwd" if phase == "fwd" else "permute_bwd"
 
     def activation_info(self) -> ActivationInfo:
+        if _st(self.ctx).dispatch_probs:
+            # weighting already happened inside the expert activation:
+            # the combine is a pure layout op — nothing cached, just the
+            # in/out copies live at once (reference
+            # ``moe_module.py:737-746``)
+            return ActivationInfo(
+                fwd_temp_bytes=max(self.inputs[0].bytes,
+                                   self.outputs[0].bytes)
+            )
         # cache the pre-combine expert outputs (for grad w.r.t. probs)
         return ActivationInfo(cache_bytes=self.inputs[0].bytes)
 
@@ -281,7 +302,8 @@ class ExpertMLP(MetaModule):
         self.permutation = Permutation(ctx, name="dispatch")
         self.experts_up = GroupLinearCol(ctx, quantized=quantized)
         if m.use_swiglu:
-            self.act = Swiglu(ctx, name="expert_swiglu")
+            self.act = Swiglu(ctx, name="expert_swiglu",
+                              weighted=ctx.strategy.dispatch_probs)
         else:
             from simumax_tpu.models.dense import Gelu
 

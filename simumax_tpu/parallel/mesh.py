@@ -29,8 +29,16 @@ def _sizes(st: StrategyConfig, order) -> List[int]:
     ]
 
 
-def rank_coords(rank: int, st: StrategyConfig, order=DENSE_ORDER) -> Dict[str, int]:
+def _dense_order(st: StrategyConfig):
+    """The strategy's dense placement order (``mesh_order``), so real
+    device assignments match what the simulator placed on the torus."""
+    return tuple(st.mesh_order.split(","))
+
+
+def rank_coords(rank: int, st: StrategyConfig, order=None) -> Dict[str, int]:
     """Decompose a global rank into per-dim indices (innermost-first)."""
+    if order is None:
+        order = _dense_order(st)
     coords = {}
     rem = rank
     for dim, size in zip(order, _sizes(st, order)):
@@ -43,7 +51,9 @@ def rank_groups(st: StrategyConfig, dim: str, order=None) -> List[List[int]]:
     """All groups of ranks that communicate over ``dim``: ranks whose
     coords differ only in ``dim``."""
     if order is None:
-        order = MOE_ORDER if dim in ("etp", "ep", "edp") else DENSE_ORDER
+        order = (
+            MOE_ORDER if dim in ("etp", "ep", "edp") else _dense_order(st)
+        )
     assert dim in order, (dim, order)
     sizes = _sizes(st, order)
     world = 1

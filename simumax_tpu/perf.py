@@ -20,6 +20,7 @@ import os
 from typing import Dict, List, Optional, Union
 
 from simumax_tpu.core.config import (
+    CommPath,
     GiB,
     ModelConfig,
     StrategyConfig,
@@ -76,6 +77,13 @@ class PerfBase:
             f"strategy world_size {st.world_size} exceeds system "
             f"{sysc.total_chips} chips",
         )
+        if st.dispatch_probs and m.model_type == "moe":
+            _require(
+                m.use_swiglu,
+                "dispatch_probs fuses the prob-weighting into the SwiGLU "
+                "expert activation (weighted-SiLU); a gelu MoE has no "
+                "fusion point, so the combine cache cannot be dropped",
+            )
         head_shard = st.tp_size
         if st.cp_size > 1 and st.cp_comm_type == "a2a":
             head_shard *= st.cp_size  # Ulysses scatters heads over cp too
@@ -164,17 +172,50 @@ class PerfLLM(PerfBase):
         st, sysc = self.strategy, self.system
         tp, cp, dp, pp = st.tp_size, st.cp_size, st.dp_size, st.pp_size
         ep, etp = st.ep_size, st.etp_size
+        sizes = {"tp": tp, "cp": cp, "dp": dp, "pp": pp}
+        order = st.mesh_order.split(",")
+
+        def inner(dim: str) -> int:
+            n = 1
+            for d in order:
+                if d == dim:
+                    return n
+                n *= sizes[d]
+            raise KeyError(dim)
+
         paths = {
-            "tp": sysc.place_group("tp", 1, tp),
-            "cp": sysc.place_group("cp", tp, cp),
-            "dp": sysc.place_group("dp", tp * cp, dp),
-            "dp_cp": sysc.place_group("dp_cp", tp, cp * dp),
-            "pp": sysc.place_group("pp", tp * cp * dp, pp),
-            # MoE dims: etp shares the tp placement; ep strides over etp
-            "etp": sysc.place_group("etp", 1, etp),
-            "ep": sysc.place_group("ep", etp, ep),
-            "edp": sysc.place_group("edp", etp * ep, st.edp_size),
+            d: sysc.place_group(d, inner(d), sizes[d]) for d in sizes
         }
+        # dp_cp (ZeRO sharding + grad reduce group) = the cp and dp dims
+        # combined. With the default order they are adjacent and a single
+        # placement reproduces the round-3 anchor behavior exactly; with
+        # dp moved outermost the group is strided across pp, which the
+        # hierarchical span concatenation expresses (innermost first).
+        if st.mesh_order == "tp,cp,dp,pp":
+            paths["dp_cp"] = sysc.place_group("dp_cp", tp, cp * dp)
+        else:
+            first, second = sorted(("cp", "dp"), key=order.index)
+            combined = CommPath(dim="dp_cp", group_size=cp * dp)
+            combined.spans = list(paths[first].spans) + list(
+                paths[second].spans
+            )
+            paths["dp_cp"] = combined
+        # MoE dims: etp shares the tp placement; ep strides over etp
+        paths["etp"] = sysc.place_group("etp", 1, etp)
+        paths["ep"] = sysc.place_group("ep", etp, ep)
+        if st.mesh_order == "tp,cp,dp,pp":
+            paths["edp"] = sysc.place_group("edp", etp * ep, st.edp_size)
+        else:
+            # non-default orders are guarded to ep=etp=1, where the edp
+            # group is exactly tp x cp x dp — strided across pp when pp
+            # is not outermost. Reuse those dims' placements so expert
+            # gradients see the same DCN spans the dense dims do.
+            assert ep == 1 and etp == 1, (ep, etp)
+            combined = CommPath(dim="edp", group_size=st.edp_size)
+            for d in order:
+                if d != "pp":
+                    combined.spans.extend(paths[d].spans)
+            paths["edp"] = combined
         return paths
 
     # ------------------------------------------------------------------

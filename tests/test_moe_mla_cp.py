@@ -337,3 +337,55 @@ class TestComposition:
         assert sim["end_time"] == pytest.approx(
             p.analysis_cost()["iter_time"], rel=0.01
         )
+
+
+class TestDispatchProbs:
+    """Megatron-0.14 combine-fusion (reference ``dispatch_probs``,
+    ``config.py:297`` + ``moe_module.py:407-424,737-746,1472``)."""
+
+    def _pair(self, **kw):
+        base = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b", **kw)
+        fused = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                    dispatch_probs=True, **kw)
+        return base, fused
+
+    def _chunk(self, p):
+        return p.stage_chunks(0)[0]
+
+    def test_probs_a2a_added(self):
+        base, fused = self._pair()
+        def a2a_volume(p):
+            return sum(
+                c.size_bytes
+                for l in self._chunk(p).leaves()
+                for c in l.collective_calls
+                if c.op == "all2all" and c.phase == "fwd"
+            )
+        assert a2a_volume(fused) > a2a_volume(base)
+
+    def test_combine_cache_dropped_swiglu_caches_probs(self):
+        base, fused = self._pair()
+        def leaf(p, name):
+            return [l for l in self._chunk(p).leaves()
+                    if name in l.path_name()]
+        for l in leaf(fused, "combine"):
+            assert l.act_info.cache_bytes == 0.0
+        assert any(
+            l.act_info.cache_bytes > 0 for l in leaf(base, "combine")
+        )
+        sw_base = sum(l.act_info.cache_bytes
+                      for l in leaf(base, "expert_swiglu"))
+        sw_fused = sum(l.act_info.cache_bytes
+                       for l in leaf(fused, "expert_swiglu"))
+        assert sw_fused > sw_base  # probs cached with the activation
+
+    def test_memory_drops_and_paths_agree(self):
+        base, fused = self._pair()
+        # combine-cache >> probs-cache, so per-stage act cache shrinks
+        mb = base.analysis_mem()["stages"][0]
+        mf = fused.analysis_mem()["stages"][0]
+        assert (mf["act_cache_per_microbatch_bytes"]
+                < mb["act_cache_per_microbatch_bytes"])
+        analytical = fused.analysis_cost()["iter_time"]
+        sim = fused.simulate(None, granularity="leaf")
+        assert sim["end_time"] == pytest.approx(analytical, rel=0.03)

@@ -60,6 +60,8 @@ def sample_strategy(rng, model):
             sdp_recompute=rng.random() < 0.5,
             attn_recompute=rng.random() < 0.5,
             mlp_recompute=rng.random() < 0.5,
+            recompute_variance=rng.random() < 0.5,
+            dispatch_probs=rng.random() < 0.5,
             fp8=rng.random() < 0.3,
             enable_dropout=rng.random() < 0.3,
             zero_state=rng.choice([0, 1, 2, 3]),
