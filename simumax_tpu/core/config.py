@@ -328,6 +328,18 @@ class ModelConfig(ConfigBase):
 # --------------------------------------------------------------------------
 
 
+#: valid ``megatron_recompute_modules`` entries (reference
+#: ``valid_megatron_recompute_modules`` config.py:308-315) — the single
+#: source for sanity validation and the flag mapping below
+MEGATRON_RECOMPUTE_MODULES = frozenset(
+    {"core_attn", "layernorm", "mla_up_proj", "moe_act", "mlp", "moe"}
+)
+#: the subset whose segments are single ops: their replay is pure tail,
+#: so they get the variance-tail model automatically (reference
+#: ``use_variance_tail_model`` config.py:416-418)
+MEGATRON_TAIL_MODULES = frozenset({"layernorm", "mla_up_proj", "moe_act"})
+
+
 @dataclass
 class RecomputeConfig:
     """Activation recompute policy (reference's three generations of flags,
@@ -342,6 +354,10 @@ class RecomputeConfig:
     mlp_recompute: bool = False
     mlp_norm_recompute: bool = False
     sdp_recompute: bool = False
+    #: Megatron-0.14 module granularities (reference
+    #: ``valid_megatron_recompute_modules`` config.py:308-315)
+    moe_act_recompute: bool = False  # expert activation only
+    mla_up_proj_recompute: bool = False  # MLA q_up/kv_up projections
     #: variance-tail optimisation (reference ``config.py:264,416-418``):
     #: the LAST leaf of each checkpointed segment skips its forward
     #: replay — its backward only needs the recomputed *input* produced
@@ -349,6 +365,11 @@ class RecomputeConfig:
     #: for selective recompute; Megatron full-block recompute does not
     #: support it (reference ``config.py:690``), so it is forced off.
     variance: bool = False
+    #: megatron modules whose segments get the tail model regardless of
+    #: the global ``variance`` flag (their replay is pure tail); kept
+    #: per-module so e.g. core_attn + layernorm does NOT make the sdp
+    #: segment free
+    tail_modules: frozenset = frozenset()
 
     @classmethod
     def from_strategy_dict(cls, d: Dict[str, Any]) -> "RecomputeConfig":
@@ -380,8 +401,29 @@ class RecomputeConfig:
             cfg.granularity = "selective"
             cfg.mlp_recompute = True
             cfg.mlp_norm_recompute = True
+        # Megatron-0.14 spelling: a module list instead of flags
+        # (reference ``megatron_recompute``/``megatron_recompute_modules``
+        # config.py:265-266,308-315). Normalised onto the same flags
+        # AFTER the granularity remaps so the module list cannot be
+        # silently discarded; unlike the reference, core_attn maps onto
+        # the supported sdp-only path instead of asserting. Single-op
+        # modules get the tail model per-segment (reference
+        # ``use_variance_tail_model`` config.py:416), not globally.
+        modules = set(d.get("megatron_recompute_modules") or [])
+        if d.get("megatron_recompute") and modules:
+            cfg.granularity = "selective"
+            cfg.attn_norm_recompute |= "layernorm" in modules
+            cfg.mlp_norm_recompute |= "layernorm" in modules
+            cfg.sdp_recompute |= "core_attn" in modules
+            cfg.mla_up_proj_recompute |= "mla_up_proj" in modules
+            cfg.moe_act_recompute |= "moe_act" in modules
+            cfg.mlp_recompute |= bool(modules & {"mlp", "moe"})
+            cfg.tail_modules = frozenset(
+                modules & MEGATRON_TAIL_MODULES
+            )
         if cfg.granularity == "full_block":
             cfg.variance = False  # full-block recompute replays everything
+            cfg.tail_modules = frozenset()
         return cfg
 
     @property
@@ -483,7 +525,13 @@ class StrategyConfig(ConfigBase):
     mlp_recompute: bool = False
     mlp_rms_recompute: bool = False
     sdp_recompute: bool = False
+    moe_act_recompute: bool = False
+    mla_up_proj_recompute: bool = False
     recompute_variance: bool = False
+    #: Megatron-0.14 spelling: recompute a module list instead of flags
+    #: (reference ``config.py:265-266``); normalised into ``recompute``
+    megatron_recompute: bool = False
+    megatron_recompute_modules: Optional[List[str]] = None
 
     mem_factor: float = 0.94  # usable fraction of HBM
     enable_straggler_model: bool = False
@@ -509,8 +557,12 @@ class StrategyConfig(ConfigBase):
                 "mlp_rms_recompute": self.mlp_rms_recompute,
                 "sdp_recompute": self.sdp_recompute,
                 "recompute_variance": self.recompute_variance,
+                "megatron_recompute": self.megatron_recompute,
+                "megatron_recompute_modules": self.megatron_recompute_modules,
             }
         )
+        self.recompute.moe_act_recompute |= self.moe_act_recompute
+        self.recompute.mla_up_proj_recompute |= self.mla_up_proj_recompute
 
 
     # -- derived sizes (reference ``config.py:352-368``) -------------------
@@ -651,6 +703,35 @@ class StrategyConfig(ConfigBase):
                 "sdp_backend='pallas' is the fused flash kernel — "
                 "use_flash_sdp must be set (math accounting would time "
                 "one kernel while modeling another)",
+            )
+        if self.megatron_recompute:
+            modules = set(self.megatron_recompute_modules or [])
+            _require(
+                bool(modules),
+                "megatron_recompute requires non-empty "
+                "megatron_recompute_modules",
+            )
+            _require(
+                modules <= MEGATRON_RECOMPUTE_MODULES,
+                "unknown megatron_recompute_modules "
+                f"{modules - MEGATRON_RECOMPUTE_MODULES}",
+            )
+            _require(
+                self.recompute_granularity
+                in ("selective", "selective_recompute"),
+                "megatron_recompute requires "
+                "recompute_granularity='selective' (the module list is "
+                "meaningless under full-block recompute)",
+            )
+            _require(
+                not any([self.attn_recompute, self.attn_norm_recompute,
+                         self.mla_rms_recompute, self.mlp_recompute,
+                         self.mlp_rms_recompute, self.sdp_recompute,
+                         self.moe_act_recompute,
+                         self.mla_up_proj_recompute,
+                         self.recompute_variance]),
+                "megatron_recompute is mutually exclusive with the legacy "
+                "selective flags and recompute_variance",
             )
         order = self.mesh_order.split(",")
         _require(

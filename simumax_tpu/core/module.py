@@ -386,10 +386,16 @@ class MetaModule:
         self.cost_info = cost
 
     # -- recompute marking (reference ``base_struct.py:499-529``) ----------
-    def mark_recompute(self):
+    def mark_recompute(self, variance: bool = None):
         """Mark this subtree as one checkpointed segment. Leaves already
         claimed by another segment (e.g. sdp-only inside a checkpointed
-        attention) keep their original segment."""
+        attention) keep their original segment.
+
+        ``variance`` controls THIS segment's tail model (reference
+        ``set_variance_node`` base_struct.py:335); ``None`` falls back to
+        the strategy's global ``recompute_variance`` — per-segment so a
+        megatron tail module (layernorm/moe_act/mla_up_proj) does not
+        make unrelated segments free."""
         self.recompute = True
         leaves = [l for l in self.leaves() if not l.in_recompute]
         for i, leaf in enumerate(leaves):
@@ -401,7 +407,9 @@ class MetaModule:
                 leaf.recompute_status = RecomputeStatus.LAST
             else:
                 leaf.recompute_status = RecomputeStatus.MIDDLE
-        if leaves and self.ctx.strategy.recompute.variance:
+        if variance is None:
+            variance = self.ctx.strategy.recompute.variance
+        if leaves and variance:
             leaves[-1].variance_tail = True
 
     # -- repr ---------------------------------------------------------------

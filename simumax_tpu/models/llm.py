@@ -80,6 +80,12 @@ class LLMBlock(MetaModule):
             self.mark_recompute()
             return
         # selective
+        # megatron tail modules force the tail model on exactly their
+        # own segments (reference use_variance_tail_model, per-module);
+        # None -> the segment follows the global recompute_variance flag
+        def tail(module_name):
+            return True if module_name in rc.tail_modules else None
+
         if rc.sdp_recompute:
             core = getattr(self.attention, "core", None)
             if core is not None:
@@ -87,14 +93,27 @@ class LLMBlock(MetaModule):
         if rc.attn_recompute:
             self.attention.mark_recompute()
         if rc.attn_norm_recompute:
-            self.input_norm.mark_recompute()
+            self.input_norm.mark_recompute(variance=tail("layernorm"))
             # MLA internal rms norms (reference mla_rms_recompute)
             for norm in getattr(self.attention, "norms", []):
-                norm.mark_recompute()
+                norm.mark_recompute(variance=tail("layernorm"))
+        if rc.mla_up_proj_recompute:
+            # MLA up-projections only (megatron_recompute_modules
+            # "mla_up_proj"): the latent caches stay, the big q/kv
+            # expansions replay
+            for name in ("q_up", "kv_up"):
+                mod = getattr(self.attention, name, None)
+                if mod is not None:
+                    mod.mark_recompute(variance=tail("mla_up_proj"))
         if rc.mlp_recompute:
             self.mlp.mark_recompute()
         if rc.mlp_norm_recompute:
-            self.pre_mlp_norm.mark_recompute()
+            self.pre_mlp_norm.mark_recompute(variance=tail("layernorm"))
+        if rc.moe_act_recompute and self.is_moe_layer:
+            # expert activation only (megatron_recompute_modules
+            # "moe_act"); skipped when the whole mlp is already marked
+            if not self.mlp.recompute:
+                self.mlp.act.mark_recompute(variance=tail("moe_act"))
 
     def _post_forward(self):
         st = self.ctx.strategy

@@ -84,6 +84,18 @@ class PerfBase:
                 "expert activation (weighted-SiLU); a gelu MoE has no "
                 "fusion point, so the combine cache cannot be dropped",
             )
+        if st.recompute.mla_up_proj_recompute:
+            _require(
+                m.attention_type == "mla",
+                "mla_up_proj recompute requires an MLA model "
+                f"(model {m.model_name!r} uses {m.attention_type})",
+            )
+        if st.recompute.moe_act_recompute:
+            _require(
+                m.model_type == "moe",
+                "moe_act recompute requires a MoE model "
+                f"(model {m.model_name!r} is {m.model_type})",
+            )
         head_shard = st.tp_size
         if st.cp_size > 1 and st.cp_comm_type == "a2a":
             head_shard *= st.cp_size  # Ulysses scatters heads over cp too

@@ -142,3 +142,151 @@ class TestGraph:
         assert variant
         dot = g.to_dot()
         assert "yellow" in dot
+
+
+class TestMegatronRecomputeModules:
+    """Megatron-0.14 module-list spelling (reference ``config.py:265,
+    308-315,416-418``), normalised onto the selective flags with
+    auto variance-tail for single-op segments."""
+
+    def _run(self, modules, model="deepseekv2-lite", strat="ep4_pp2_dp4_mbs1"):
+        from simumax_tpu.core.config import get_model_config
+        p = PerfLLM()
+        model = get_model_config(model)
+        model.layer_num = 4  # divisible over pp*vp, like the l4 examples
+        st = get_strategy_config(strat)
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.attn_recompute = False
+        st.mlp_recompute = False
+        st.sdp_recompute = False
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = modules
+        st.__post_init__()
+        p.configure(st, model, "tpu_v5p_256")
+        p.run_estimate()
+        return p
+
+    def test_moe_act_marks_expert_activation_with_variance(self):
+        p = self._run(["moe_act"])
+        chunk = p.stage_chunks(0)[0]
+        marked = [l for l in chunk.leaves() if l.in_recompute]
+        assert marked
+        assert all("expert_swiglu" in l.path_name() for l in marked)
+        assert all(l.variance_tail for l in marked)
+        # replay is pure tail => costs nothing
+        assert sum(l.cost_info.recompute_time for l in marked) == 0.0
+
+    def test_mla_up_proj_marks_projections(self):
+        # deepseekv2 (not -lite) has the q_lora path, so both
+        # up-projections exist
+        p = self._run(["mla_up_proj"], model="deepseekv2")
+        chunk = p.stage_chunks(0)[0]
+        marked = {l.path_name().rsplit(".", 1)[-1]
+                  for l in chunk.leaves() if l.in_recompute}
+        assert marked == {"q_up", "kv_up"}, marked
+
+    def test_layernorm_maps_to_both_norm_flags(self):
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["layernorm"]
+        st.__post_init__()
+        assert st.recompute.attn_norm_recompute
+        assert st.recompute.mlp_norm_recompute
+        # tail model applies per-module, not via the global flag
+        assert "layernorm" in st.recompute.tail_modules
+        assert st.recompute.variance is False
+
+    def test_core_attn_supported_via_sdp(self):
+        # beyond-reference: the reference asserts core_attn unsupported
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["core_attn"]
+        st.__post_init__()
+        assert st.recompute.sdp_recompute
+        assert not st.recompute.tail_modules  # sdp is not a tail module
+
+    def test_sanity_rejects_bad_modules_and_legacy_mix(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.megatron_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute_modules = ["bogus"]
+        with pytest.raises(ConfigError, match="unknown"):
+            st.sanity_check()
+        st.megatron_recompute_modules = ["mlp"]
+        st.mlp_recompute = True
+        with pytest.raises(ConfigError, match="mutually exclusive"):
+            st.sanity_check()
+
+    def test_estimates_and_sim_agree(self):
+        p = self._run(["moe_act", "layernorm"])
+        cost = p.analysis_cost()
+        assert 0.0 < cost["mfu"] < 1.0
+        sim = p.simulate(None, granularity="leaf")
+        assert sim["end_time"] == pytest.approx(
+            cost["iter_time"], rel=0.03)
+
+    def test_core_attn_plus_layernorm_keeps_sdp_replay_paid(self):
+        # review regression: the tail model must be per-segment — mixing
+        # core_attn with a tail module must NOT make the sdp replay free
+        p = self._run(["core_attn", "layernorm"], model="deepseekv2")
+        chunk = p.stage_chunks(0)[0]
+        sdp = [l for l in chunk.leaves()
+               if l.in_recompute and "core_attention" in l.path_name()]
+        norms = [l for l in chunk.leaves()
+                 if l.in_recompute and "norm" in l.path_name()]
+        assert sdp and norms
+        assert not any(l.variance_tail for l in sdp)
+        assert sum(l.cost_info.recompute_time for l in sdp) > 0.0
+        assert all(l.variance_tail for l in norms)
+
+    def test_full_recompute_granularity_rejected_with_megatron(self):
+        # review regression: the module list must not be silently
+        # discarded by the full_recompute remap
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "full_recompute"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["moe_act"]
+        with pytest.raises(ConfigError, match="selective"):
+            st.sanity_check()
+
+    def test_legacy_sdp_flag_also_excluded(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["mlp"]
+        st.sdp_recompute = True
+        with pytest.raises(ConfigError, match="mutually exclusive"):
+            st.sanity_check()
+
+    def test_mla_up_proj_rejected_on_gqa_model(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["mla_up_proj", "mlp"]
+        st.__post_init__()
+        with pytest.raises(ConfigError, match="MLA"):
+            PerfLLM().configure(st, "llama3-8b", "tpu_v5e_256")
+
+    def test_moe_act_rejected_on_dense_model(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        st.enable_recompute = True
+        st.recompute_granularity = "selective"
+        st.megatron_recompute = True
+        st.megatron_recompute_modules = ["moe_act"]
+        st.__post_init__()
+        with pytest.raises(ConfigError, match="MoE"):
+            PerfLLM().configure(st, "llama3-8b", "tpu_v5e_256")
