@@ -468,6 +468,14 @@ class StrategyConfig(ConfigBase):
 
     moe_dispatcher_policy: str = "all2all"
     moe_capacity_factor: float = 0.0  # 0 => dropless (balanced assumption)
+    #: grouped-GEMM execution style (reference ``group_linear_mode``,
+    #: ``moe_module.py:835-1289``): "parallel" = one grouped kernel
+    #: (TPU: megablox/ragged_dot; costed via the ``group_matmul``
+    #: efficiency table), "sequential" = per-expert GEMMs (TPU: a
+    #: ``lax.scan`` of dense matmuls; costed via the ``matmul`` table at
+    #: batch=ng with the smaller per-expert m — capturing the MXU
+    #: under-utilisation of small per-expert tiles).
+    group_linear_mode: str = "parallel"
     #: Megatron-0.14 combine-fusion (reference ``config.py:297``):
     #: router probs ride their own EP all-to-all at dispatch and the
     #: weighting fuses into the expert activation (weighted-SiLU), so
@@ -663,6 +671,10 @@ class StrategyConfig(ConfigBase):
         _require(
             self.moe_dispatcher_policy in ("all2all",),
             f"unknown moe_dispatcher_policy {self.moe_dispatcher_policy!r}",
+        )
+        _require(
+            self.group_linear_mode in ("parallel", "sequential"),
+            f"unknown group_linear_mode {self.group_linear_mode!r}",
         )
         _require(
             self.optimizer_style in ("megatron", "functional"),

@@ -203,13 +203,25 @@ class GroupLinearBase(GemmBase):
         self.numel = self.ng * in_features * out_features
 
     @property
+    def sequential(self) -> bool:
+        """``group_linear_mode="sequential"``: per-expert GEMMs (a
+        ``lax.scan`` of dense matmuls on TPU) instead of one grouped
+        kernel — costed off the ``matmul`` table at batch=ng with the
+        smaller per-expert m, which is where the mode's MXU
+        under-utilisation shows up."""
+        return _st(self.ctx).group_linear_mode == "sequential"
+
+    @property
     def matmul_op_key(self) -> str:
+        kind = "matmul" if self.sequential else "group_matmul"
         if self.quantized:
-            return f"{self.ctx.strategy.quant_dtype}_group_matmul"
-        return "group_matmul"
+            return f"{self.ctx.strategy.quant_dtype}_{kind}"
+        return kind
 
     def gemm_mnk(self, phase: str):
         tokens = self._tokens()
+        if self.sequential:
+            tokens = max(tokens // self.ng, 1)  # per-expert share
         k, n = self.in_features, self.out_features
         if phase == "fwd":
             return (self.ng, tokens, k, n)
@@ -218,6 +230,11 @@ class GroupLinearBase(GemmBase):
         return (self.ng, k, tokens, n)
 
     def gemm_shape_key(self, phase: str):
+        if self.sequential:
+            # dense-matmul grammar (batch=ng) so the matmul efficiency
+            # table and its batched calibration path apply; gemm_mnk
+            # already returns a (b, m, k, n)-compatible tuple
+            return super().gemm_shape_key(phase)
         ng, m, k, n = self.gemm_mnk(phase)
         acc = phase == "bwd_w" and self.ctx.strategy.use_fp32_accum_grad
         return (
@@ -229,21 +246,36 @@ class GroupLinearBase(GemmBase):
         return self.inputs[0].shape[0] * self.inputs[0].shape[1]
 
     def op_flops(self) -> Dict[str, float]:
-        ng, m, k, n = self.gemm_mnk("fwd")
-        f = 2.0 * m * k * n  # m is total tokens across groups
+        # totals over ALL experts — independent of the execution mode
+        # (gemm_mnk's m is per-expert under group_linear_mode=sequential)
+        tokens = self._tokens()
+        k, n = self.in_features, self.out_features
+        f = 2.0 * tokens * k * n
         return {"fwd": f, "bwd_act": f, "bwd_w": f}
 
     def op_accessed(self) -> Dict[str, float]:
         st = _st(self.ctx)
         e = st.element_size
-        ng, m, k, n = self.gemm_mnk("fwd")
-        io = (m * k + ng * k * n + m * n) * e
-        wgrad_extra = ng * k * n * (st.grad_element_size - e)
+        tokens = self._tokens()
+        k, n = self.in_features, self.out_features
+        io = (tokens * k + self.ng * k * n + tokens * n) * e
+        wgrad_extra = self.ng * k * n * (st.grad_element_size - e)
         return {
             "fwd": io + self.quant_cast_bytes("fwd"),
             "bwd_act": io + self.quant_cast_bytes("bwd_act"),
             "bwd_w": io + wgrad_extra + self.quant_cast_bytes("bwd_w"),
         }
+
+    def quant_cast_bytes(self, phase: str) -> float:
+        # totals, not per-expert (see op_flops); phase-dependent like
+        # GemmBase: bwd_act quantizes the output-grad (tokens x n)
+        if not self.quantized:
+            return 0.0
+        e = _st(self.ctx).element_size
+        width = (
+            self.out_features if phase == "bwd_act" else self.in_features
+        )
+        return self._tokens() * width * (e + 1.0)
 
     def activation_info(self) -> ActivationInfo:
         fsdp = _fsdp_temp(self, self.numel, is_moe=True)

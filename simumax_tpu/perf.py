@@ -133,7 +133,8 @@ class PerfBase:
             )
         if st.fp8:
             needed = [f"{st.quant_dtype}_matmul"]
-            if m.model_type == "moe":
+            # sequential mode costs experts off the dense matmul table
+            if m.model_type == "moe" and st.group_linear_mode == "parallel":
                 needed.append(f"{st.quant_dtype}_group_matmul")
             for key in needed:
                 _require(

@@ -389,3 +389,66 @@ class TestDispatchProbs:
         analytical = fused.analysis_cost()["iter_time"]
         sim = fused.simulate(None, granularity="leaf")
         assert sim["end_time"] == pytest.approx(analytical, rel=0.03)
+
+
+class TestGroupLinearMode:
+    """group_linear_mode (reference ``moe_module.py:835-1289``):
+    parallel grouped kernel vs sequential per-expert GEMMs."""
+
+    def _run(self, mode, **kw):
+        return run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                   group_linear_mode=mode, **kw)
+
+    def test_sequential_uses_batched_matmul_keys(self):
+        # ep2 on 8 experts -> ng=4 local experts per chip
+        p = self._run("sequential", ep_size=2)
+        chunk = p.stage_chunks(0)[0]
+        keys = [
+            l.comp_key("fwd")
+            for l in chunk.leaves()
+            if type(l).__name__.startswith("GroupLinear")
+        ]
+        assert keys
+        for op_key, shape_key in keys:
+            assert op_key == "matmul"
+            assert shape_key.startswith("b=4, ")  # batch = ng
+
+    def test_parallel_uses_group_matmul_keys(self):
+        p = self._run("parallel")
+        chunk = p.stage_chunks(0)[0]
+        keys = [
+            l.comp_key("fwd")
+            for l in chunk.leaves()
+            if type(l).__name__.startswith("GroupLinear")
+        ]
+        assert keys
+        for op_key, shape_key in keys:
+            assert op_key == "group_matmul"
+            assert shape_key.startswith("ng=")
+
+    def test_flops_and_memory_identical_across_modes(self):
+        seqp = self._run("sequential")
+        par = self._run("parallel")
+        def totals(p):
+            chunk = p.stage_chunks(0)[0]
+            return (
+                sum(l.compute_info.fwd_flops for l in chunk.leaves()),
+                p.analysis_mem()["stages"][0]["peak_bytes"],
+            )
+        fs, ms = totals(seqp)
+        fp, mp = totals(par)
+        assert fs == pytest.approx(fp, rel=1e-9)
+        assert ms == pytest.approx(mp, rel=1e-6)
+
+    def test_sim_agrees(self):
+        p = self._run("sequential")
+        cost = p.analysis_cost()
+        sim = p.simulate(None, granularity="leaf")
+        assert sim["end_time"] == pytest.approx(cost["iter_time"], rel=0.03)
+
+    def test_bad_mode_rejected(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("ep8_pp1_dp8_mbs1")
+        st.group_linear_mode = "bogus"
+        with pytest.raises(ConfigError, match="group_linear_mode"):
+            st.sanity_check()

@@ -62,6 +62,7 @@ def sample_strategy(rng, model):
             mlp_recompute=rng.random() < 0.5,
             recompute_variance=rng.random() < 0.5,
             dispatch_probs=rng.random() < 0.5,
+            group_linear_mode=rng.choice(["parallel", "sequential"]),
             fp8=rng.random() < 0.3,
             enable_dropout=rng.random() < 0.3,
             zero_state=rng.choice([0, 1, 2, 3]),
