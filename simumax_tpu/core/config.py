@@ -476,6 +476,12 @@ class StrategyConfig(ConfigBase):
     #: batch=ng with the smaller per-expert m — capturing the MXU
     #: under-utilisation of small per-expert tiles).
     group_linear_mode: str = "parallel"
+    #: host-offload the dispatched-token inputs of the first expert GEMM
+    #: (reference ``offload_groupgemm_col_inputs`` config.py:239,
+    #: ``moe_module.py:962-979``): their HBM cache drops to zero and the
+    #: backward re-uploads them as a transient. Memory-only effect, as
+    #: in the reference (the d2h/h2d rides the async DMA engines).
+    offload_groupgemm_col_inputs: bool = False
     #: Megatron-0.14 combine-fusion (reference ``config.py:297``):
     #: router probs ride their own EP all-to-all at dispatch and the
     #: weighting fuses into the expert activation (weighted-SiLU), so
@@ -676,6 +682,15 @@ class StrategyConfig(ConfigBase):
             self.group_linear_mode in ("parallel", "sequential"),
             f"unknown group_linear_mode {self.group_linear_mode!r}",
         )
+        if self.offload_groupgemm_col_inputs:
+            _require(
+                not (self.enable_recompute
+                     and self.recompute_granularity
+                     in ("full_block", "full_recompute")),
+                "offload_groupgemm_col_inputs is incompatible with "
+                "full-block recompute (the replay would re-offload; "
+                "reference config.py:601-602 forbids the same)",
+            )
         _require(
             self.optimizer_style in ("megatron", "functional"),
             f"unknown optimizer_style {self.optimizer_style!r}",

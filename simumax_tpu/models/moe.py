@@ -304,6 +304,20 @@ class GroupLinearCol(GroupLinearBase):
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         return x.with_shape(x.shape[0], x.shape[1], self.out_features)
 
+    def activation_info(self) -> ActivationInfo:
+        info = super().activation_info()
+        if (_st(self.ctx).offload_groupgemm_col_inputs
+                and not self.in_recompute):
+            # dispatched-token inputs live on the host (reference
+            # ``moe_module.py:962-979``): no HBM cache; the backward
+            # re-uploads them as a transient next to the grads. Inside a
+            # recompute segment the replay regenerates the input in HBM,
+            # so there is nothing to offload (full-block recompute is
+            # rejected at sanity; selective mlp recompute lands here).
+            info.bwd_temp_bytes += info.cache_bytes
+            info.cache_bytes = 0.0
+        return info
+
 
 class GroupLinearRow(GroupLinearBase):
     def __init__(self, ctx, name="group_linear_row", quantized=False):

@@ -41,7 +41,8 @@ _KEY_FIELDS = (
     "recompute_layer_num", "attn_recompute", "attn_norm_recompute",
     "mla_rms_recompute", "mlp_recompute", "mlp_rms_recompute",
     "sdp_recompute", "recompute_variance", "moe_capacity_factor",
-    "dispatch_probs", "mesh_order", "group_linear_mode", "mem_factor",
+    "dispatch_probs", "mesh_order", "group_linear_mode",
+    "offload_groupgemm_col_inputs", "mem_factor",
     "enable_straggler_model", "num_layers_in_first_pipeline_stage",
     "num_layers_in_last_pipeline_stage",
     "account_for_embedding_in_pipeline_split",

@@ -452,3 +452,61 @@ class TestGroupLinearMode:
         st.group_linear_mode = "bogus"
         with pytest.raises(ConfigError, match="group_linear_mode"):
             st.sanity_check()
+
+
+class TestOffloadGroupGemmInputs:
+    """offload_groupgemm_col_inputs (reference ``config.py:239``,
+    ``moe_module.py:962-979``): memory-only host offload of the
+    dispatched-token inputs of the first expert GEMM."""
+
+    def test_cache_drops_peak_drops(self):
+        base = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b")
+        off = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                  offload_groupgemm_col_inputs=True)
+        def col(p):
+            return [l for l in p.stage_chunks(0)[0].leaves()
+                    if type(l).__name__ == "GroupLinearCol"]
+        assert all(l.act_info.cache_bytes == 0 for l in col(off))
+        assert all(l.act_info.cache_bytes > 0 for l in col(base))
+        assert all(
+            o.raw_act_info.bwd_temp_bytes > b.raw_act_info.bwd_temp_bytes
+            for b, o in zip(col(base), col(off))
+        )
+        mb = base.analysis_mem()["stages"][0]
+        mo = off.analysis_mem()["stages"][0]
+        assert (mo["act_cache_per_microbatch_bytes"]
+                < mb["act_cache_per_microbatch_bytes"])
+
+    def test_conservation_and_sim(self):
+        p = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                offload_groupgemm_col_inputs=True)
+        cost = p.analysis_cost()
+        sim = p.simulate(None)
+        assert sim["end_time"] == pytest.approx(cost["iter_time"], rel=0.03)
+
+    def test_rejected_with_full_block_recompute(self):
+        from simumax_tpu.core.config import ConfigError
+        st = get_strategy_config("ep4_pp2_dp4_mbs1_full_recompute")
+        st.offload_groupgemm_col_inputs = True
+        with pytest.raises(ConfigError, match="offload"):
+            st.sanity_check()
+
+    def test_noop_inside_recomputed_mlp(self):
+        # review regression: with the expert MLP checkpointed, the
+        # replay regenerates the input in HBM — offload must not add a
+        # phantom re-upload transient
+        base = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                   enable_recompute=True,
+                   recompute_granularity="selective",
+                   mlp_recompute=True)
+        off = run("ep8_pp1_dp8_mbs1", "mixtral-8x7b",
+                  enable_recompute=True,
+                  recompute_granularity="selective",
+                  mlp_recompute=True,
+                  offload_groupgemm_col_inputs=True)
+        def col(p):
+            return [l for l in p.stage_chunks(0)[0].leaves()
+                    if type(l).__name__ == "GroupLinearCol"]
+        for b, o in zip(col(base), col(off)):
+            assert o.raw_act_info.bwd_temp_bytes == b.raw_act_info.bwd_temp_bytes
+            assert o.act_info.cache_bytes == b.act_info.cache_bytes
