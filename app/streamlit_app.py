@@ -259,6 +259,18 @@ if st.button("estimate"):
             st.write("none — configuration looks healthy")
         with st.expander("realized collective bandwidths (GB/s)"):
             st.json(perf.ctx.system.real_comm_bw)
+        if (strategy.pp_size >= 2 and strategy.pp_size % 2 == 0
+                and strategy.vp_size == 1):
+            dual = perf.analysis_dualpp()
+            st.subheader("DualPipe projection")
+            st.write(
+                f"bidirectional schedule: "
+                f"{dual['dualpp_iter_time'] * 1e3:.1f} ms "
+                f"({dual['speedup']:.2f}x vs 1F1B) at "
+                f"{dual['max_peak_gib']:.1f} GiB peak "
+                f"(2 stage chunks per rank vs "
+                f"{dual['baseline_peak_gib']:.1f} GiB)"
+            )
 
     with tab_mem:
         st.subheader("per-stage memory")

@@ -128,6 +128,42 @@ def cmd_calibrate(args):
     perf.analysis()
 
 
+def cmd_dualpp(args):
+    from simumax_tpu import PerfLLM
+
+    perf = PerfLLM().configure(args.strategy, args.model, args.system)
+    if perf.strategy.pp_size % 2 or perf.strategy.pp_size < 2:
+        raise SystemExit(
+            f"DualPipe requires an even pp >= 2 "
+            f"(strategy has pp={perf.strategy.pp_size})"
+        )
+    if perf.strategy.vp_size != 1:
+        raise SystemExit(
+            "DualPipe and VPP interleaving are exclusive "
+            f"(strategy has interleaving_size={perf.strategy.vp_size})"
+        )
+    perf.run_estimate()
+    res = perf.analysis_dualpp(save_path=args.plot)
+    print(
+        f"1F1B baseline  {res['baseline_iter_time'] * 1e3:9.1f} ms  "
+        f"peak {res['baseline_peak_gib']:.1f} GiB"
+    )
+    print(
+        f"DualPipe       {res['dualpp_iter_time'] * 1e3:9.1f} ms  "
+        f"peak {res['max_peak_gib']:.1f} GiB  "
+        f"(speedup {res['speedup']:.3f}x, projected MFU "
+        f"{res['projected_mfu'] * 100:.2f}%)"
+    )
+    for r in res["ranks"]:
+        print(
+            f"  rank {r['rank']}: stages {r['stages']}  "
+            f"bubble {r['bubble'] * 1e3:7.1f} ms  "
+            f"peak {r['peak_gib']:.1f} GiB"
+        )
+    if args.plot:
+        print(f"F&B cell timeline -> {args.plot}")
+
+
 def cmd_straggler(args):
     from simumax_tpu import PerfLLM
     from simumax_tpu.simulator.runner import analyze_stragglers
@@ -208,6 +244,16 @@ def main(argv=None):
     pc.add_argument("--collectives", action="store_true",
                     help="also sweep+fit collectives (needs >1 device)")
     pc.set_defaults(fn=cmd_calibrate)
+
+    pd = sub.add_parser(
+        "dualpp",
+        help="DualPipe bidirectional-schedule projection (even pp)",
+    )
+    pd.add_argument("--model", required=True)
+    pd.add_argument("--strategy", required=True)
+    pd.add_argument("--system", required=True)
+    pd.add_argument("--plot", help="PNG path for the F&B cell timeline")
+    pd.set_defaults(fn=cmd_dualpp)
 
     pst = sub.add_parser(
         "straggler",

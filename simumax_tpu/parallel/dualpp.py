@@ -235,6 +235,93 @@ def plot_fb_cell(cell: Dict[str, object], save_path: str) -> str:
     return save_path
 
 
+def _compare_to_baseline(perf, dual_total: float) -> Dict[str, float]:
+    """Shared 1F1B-vs-DualPipe comparison tail: add the schedule-external
+    terms (DP comm, optimizer) and the SAME straggler inflation the
+    baseline iter_time carries, so the speedup compares like with like."""
+    base = perf.analysis_cost()
+    extra = base["dp_comm"]["total"] + base["optim_time"]
+    dual_iter = (dual_total + extra) * base["straggle_ratio"]
+    speedup = base["iter_time"] / dual_iter if dual_iter > 0 else 0.0
+    return {
+        "dualpp_iter_time": dual_iter,
+        "baseline_iter_time": base["iter_time"],
+        "baseline_bubble": base["bubble_time"],
+        "speedup": speedup,
+        "projected_mfu": base["mfu"] * speedup,
+    }
+
+
+def analyze(perf, save_path: str = None) -> Dict[str, object]:
+    """Full per-rank DualPipe projection for an estimated ``PerfLLM``
+    (beyond the reference, whose DualPipe support is the standalone
+    closed-form helper only): rank r hosts TWO stage chunks — stage r of
+    the forward direction and stage pp-1-r of the reverse direction —
+    so parameters double per rank and each direction contributes half
+    the microbatches. Peak memory per rank uses the DualPipe paper's
+    in-flight bound of pp+1 microbatch activations, charged
+    conservatively at the bigger chunk's per-microbatch cache.
+    """
+    from simumax_tpu.core.config import _require
+
+    st = perf.strategy
+    pp, mbc = st.pp_size, st.micro_batch_num
+    _require(pp % 2 == 0 and pp > 1, "DualPipe requires even pp >= 2")
+    _require(st.vp_size == 1, "DualPipe and VPP interleaving are exclusive")
+    mem = perf.analysis_mem()
+    stages = mem["stages"]
+    # rank r and its mirror pp-1-r host the identical stage pair, so
+    # compute each pair once and mirror the row
+    pair_rows: Dict[int, dict] = {}
+    cells: Dict[int, dict] = {}
+    for r in range(pp // 2):
+        m = pp - 1 - r
+        ph_a, ph_b = cal_cost(perf, r), cal_cost(perf, m)
+        phase = DualPPPhase(
+            fwd=(ph_a.fwd + ph_b.fwd) / 2,
+            bwd_act=(ph_a.bwd_act + ph_b.bwd_act) / 2,
+            bwd_w=(ph_a.bwd_w + ph_b.bwd_w) / 2,
+            comm_exposed=(ph_a.comm_exposed + ph_b.comm_exposed) / 2,
+        )
+        cells[r] = schedule_fb_cell(cell_components(perf, r))
+        fb = (
+            cells[r]["total"]
+            + schedule_fb_cell(cell_components(perf, m))["total"]
+        ) / 2
+        d = duration_dualpp(pp, mbc, phase, fb_duration=fb)
+        model_bytes = (
+            stages[r]["model_bytes"] + stages[m]["model_bytes"]
+        )
+        act_mb = max(
+            stages[r]["act_cache_per_microbatch_bytes"],
+            stages[m]["act_cache_per_microbatch_bytes"],
+        )
+        replay = max(
+            stages[r]["replay_peak_bytes"], stages[m]["replay_peak_bytes"]
+        )
+        peak = model_bytes + (pp + 1) * act_mb + replay
+        pair_rows[r] = {
+            "total": d["total"], "bubble": d["bubble"],
+            "model_bytes": model_bytes,
+            "peak_bytes": peak, "peak_gib": peak / 2**30,
+        }
+    rows = []
+    for r in range(pp):
+        pair = pair_rows[min(r, pp - 1 - r)]
+        rows.append({"rank": r, "stages": (r, pp - 1 - r), **pair})
+    worst_total = max(p["total"] for p in pair_rows.values())
+    if save_path:
+        plot_fb_cell(cells[0], save_path)
+    out = _compare_to_baseline(perf, worst_total)
+    out.update({
+        "ranks": rows,
+        "max_peak_bytes": max(r["peak_bytes"] for r in rows),
+        "max_peak_gib": max(r["peak_gib"] for r in rows),
+        "baseline_peak_gib": mem["max_peak_gib"],
+    })
+    return out
+
+
 def perf_dualpp(perf, stage: int = 0,
                 save_path: str = None) -> Dict[str, float]:
     """Compare a DualPipe schedule against the estimated 1F1B result
@@ -249,15 +336,6 @@ def perf_dualpp(perf, stage: int = 0,
         plot_fb_cell(cell, save_path)
     dual = duration_dualpp(st.pp_size, st.micro_batch_num, phase,
                            fb_duration=cell["total"])
-    base = perf.analysis_cost()
-    extra = base["dp_comm"]["total"] + base["optim_time"]
-    dual_iter = dual["total"] + extra
-    mfu_scale = base["iter_time"] / dual_iter if dual_iter > 0 else 0.0
-    return {
-        "dualpp_iter_time": dual_iter,
-        "dualpp_bubble": dual["bubble"],
-        "baseline_iter_time": base["iter_time"],
-        "baseline_bubble": base["bubble_time"],
-        "projected_mfu": base["mfu"] * mfu_scale,
-        "speedup": mfu_scale,
-    }
+    out = _compare_to_baseline(perf, dual["total"])
+    out["dualpp_bubble"] = dual["bubble"]
+    return out

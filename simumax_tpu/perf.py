@@ -1011,3 +1011,12 @@ class PerfLLM(PerfBase):
         from simumax_tpu.simulator.runner import run_simulation
 
         return run_simulation(self, save_path, **kwargs)
+
+    def analysis_dualpp(self, save_path: Optional[str] = None):
+        """Per-rank DualPipe projection of this estimate (even pp only):
+        bidirectional schedule, 2 stage chunks per rank, pp+1 in-flight
+        activation bound. ``save_path`` renders the overlapped F&B cell
+        timeline PNG. See ``parallel/dualpp.py``."""
+        from simumax_tpu.parallel.dualpp import analyze
+
+        return analyze(self, save_path)

@@ -249,3 +249,57 @@ class TestModelArch:
         txt = open(tmp_path / "model_arch.txt").read()
         assert "stage 0" in txt and "stage 1" in txt
         assert "parallel_ce" in txt  # postprocess only on the last stage
+
+
+class TestDualPPAnalyze:
+    """Per-rank DualPipe projection (PerfLLM.analysis_dualpp)."""
+
+    def _perf(self, pp=2, model="llama3-8b"):
+        from simumax_tpu.core.config import get_strategy_config
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = pp
+        st.world_size = 4 * pp
+        st.__post_init__()
+        p = PerfLLM().configure(st, model, "tpu_v5p_256")
+        p.run_estimate()
+        return p
+
+    def test_params_double_and_speedup_projected(self):
+        p = self._perf()
+        res = p.analysis_dualpp()
+        mem = p.analysis_mem()
+        for r in res["ranks"]:
+            a, b = r["stages"]
+            assert r["model_bytes"] == (
+                mem["stages"][a]["model_bytes"]
+                + mem["stages"][b]["model_bytes"]
+            )
+        assert res["max_peak_gib"] > res["baseline_peak_gib"]
+        assert 0 < res["speedup"] < 2.0
+        assert res["dualpp_iter_time"] < res["baseline_iter_time"]
+
+    def test_pp4_has_bubble_and_all_ranks(self):
+        p = self._perf(pp=4)
+        res = p.analysis_dualpp()
+        assert len(res["ranks"]) == 4
+        assert {tuple(r["stages"]) for r in res["ranks"]} == {
+            (0, 3), (1, 2), (2, 1), (3, 0)
+        }
+
+    def test_odd_pp_rejected(self):
+        from simumax_tpu.core.config import ConfigError
+
+        p = PerfLLM().configure(
+            "tp2_pp1_dp4_mbs1", "llama3-8b", "tpu_v5p_256"
+        )
+        p.run_estimate()
+        with pytest.raises(ConfigError, match="even pp"):
+            p.analysis_dualpp()
+
+    def test_cli_dualpp(self, capsys):
+        from simumax_tpu.cli import main
+        main(["dualpp", "--model", "llama3-8b",
+              "--strategy", "tp1_pp2_dp4_mbs1",
+              "--system", "tpu_v5p_256"])
+        out = capsys.readouterr().out
+        assert "DualPipe" in out and "speedup" in out
