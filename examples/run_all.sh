@@ -2,7 +2,7 @@
 # Run every example (reference examples/run_all.sh analog).
 set -e
 cd "$(dirname "$0")"
-for f in show_*.py perf_*.py search_*.py simulator_*.py jaxref_*.py straggler_*.py; do
+for f in show_*.py perf_*.py search_*.py simulator_*.py jaxref_*.py straggler_*.py dualpp_*.py; do
   echo "=== $f"
   python "$f"
 done

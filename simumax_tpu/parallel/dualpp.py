@@ -299,7 +299,12 @@ def analyze(perf, save_path: str = None) -> Dict[str, object]:
         replay = max(
             stages[r]["replay_peak_bytes"], stages[m]["replay_peak_bytes"]
         )
-        peak = model_bytes + (pp + 1) * act_mb + replay
+        # baseline convention (perf.analysis_mem): live-1 full caches +
+        # the replay peak, which already includes the active
+        # microbatch's cache; DualPipe's in-flight bound is pp+1,
+        # capped by the microbatches that actually exist
+        live = min(mbc, pp + 1)
+        peak = model_bytes + max(live - 1, 0) * act_mb + replay
         pair_rows[r] = {
             "total": d["total"], "bubble": d["bubble"],
             "model_bytes": model_bytes,

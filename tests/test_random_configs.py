@@ -63,6 +63,11 @@ def sample_strategy(rng, model):
             recompute_variance=rng.random() < 0.5,
             dispatch_probs=rng.random() < 0.5,
             group_linear_mode=rng.choice(["parallel", "sequential"]),
+            offload_groupgemm_col_inputs=rng.random() < 0.3,
+            mesh_order=(
+                rng.choice(["tp,cp,dp,pp", "tp,cp,pp,dp", "tp,dp,cp,pp"])
+                if ep == 1 else "tp,cp,dp,pp"
+            ),
             fp8=rng.random() < 0.3,
             enable_dropout=rng.random() < 0.3,
             zero_state=rng.choice([0, 1, 2, 3]),
@@ -97,9 +102,20 @@ def test_random_config_invariants(seed):
     st = sample_strategy(rng, model)
     if st is None:
         pytest.skip("no valid sample for this seed")
+    system = "tpu_v5p_256"
+    if rng.random() < 0.3:
+        from simumax_tpu.core.config import get_system_config
+
+        # exercise the DCN spill paths for real: shrink the slice to 16
+        # chips so the sampled worlds (up to 128) genuinely overflow
+        # onto DCN (a 256-chip slice never spills at these sizes)
+        system = get_system_config("tpu_v5p_256")
+        system.ici.axes = [4, 4]
+        system.ici.wraparound = [True, True]
+        system.num_slices = 16
     p = PerfLLM()
     try:
-        p.configure(st, model, "tpu_v5p_256")
+        p.configure(st, model, system)
     except ConfigError:
         pytest.skip("cross-sanity rejected sample")
     p.run_estimate()  # asserts activation conservation internally
