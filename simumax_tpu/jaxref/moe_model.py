@@ -39,6 +39,11 @@ class MoeConfig:
     capacity_factor: float = 2.0
     rope_theta: float = 1e4
     dtype: Any = jnp.bfloat16
+    #: Megatron-0.14 combine fusion (the analytical ``dispatch_probs``
+    #: strategy flag): the routing weight multiplies the expert
+    #: activation (weighted-SiLU) instead of the combine gather —
+    #: mathematically identical because the down projection is linear
+    dispatch_probs: bool = False
 
     @classmethod
     def from_model_config(cls, m, layer_num: Optional[int] = None,
@@ -128,15 +133,27 @@ def _moe_mlp(y, p, cfg: MoeConfig):
     )
     gate_a, val = jnp.split(up, 2, axis=-1)
     act = jax.nn.silu(gate_a) * val
+    if cfg.dispatch_probs:
+        # weighted-SiLU: scatter the routing weights into the capacity
+        # buffer next to their tokens and fold them into the activation
+        # overflow slots (slot >= cap) are dropped by the scatter mode,
+        # same as the xin dispatch above — no separate keep mask needed
+        wbuf = jnp.zeros((e, cap), y.dtype).at[sorted_e, slot].set(
+            flat_w[order], mode="drop"
+        )
+        act = act * wbuf[..., None]
     down = jax.lax.dot_general(
         act, p["moe_down"], (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=y.dtype,
     )
-    # unpermute (combine): weighted gather back to token order (the
-    # gather clamps out-of-bounds overflow slots; their contribution is
-    # zeroed by the keep mask on the weights)
+    # unpermute (combine): gather back to token order (the gather
+    # clamps out-of-bounds overflow slots; their contribution is zeroed
+    # by the keep mask). Weights apply here unless already fused above.
     vals = down[sorted_e, jnp.minimum(slot, cap - 1)]
-    vals = vals * (flat_w[order] * keep.astype(y.dtype))[:, None]
+    if cfg.dispatch_probs:
+        vals = vals * keep.astype(y.dtype)[:, None]
+    else:
+        vals = vals * (flat_w[order] * keep.astype(y.dtype))[:, None]
     o = jnp.zeros((T, h), y.dtype).at[flat_tok[order]].add(vals)
     return o.reshape(b, s, h)
 

@@ -457,3 +457,34 @@ class TestInt8Reference:
         assert gx.shape == x.shape and gw.shape == w.shape
         assert float(jnp.max(jnp.abs(gx))) > 0
         assert float(jnp.max(jnp.abs(gw))) > 0
+
+
+class TestDispatchProbsReference:
+    def test_weighted_silu_equivalent_to_combine_weighting(self):
+        """The dispatch_probs combine fusion (weighted-SiLU before the
+        down projection) must match classic combine-side weighting —
+        the down projection is linear, so the two orders are
+        mathematically identical (fp32 to exclude rounding)."""
+        import jax
+        import jax.numpy as jnp
+
+        from simumax_tpu.jaxref.moe_model import (
+            MoeConfig,
+            init_params,
+            loss_fn,
+        )
+
+        ids = jnp.array(
+            np.random.RandomState(11).randint(0, 1024, (2, 64))
+        ).astype(jnp.int32)
+        losses = {}
+        for fused in (False, True):
+            cfg = MoeConfig(
+                vocab_size=1024, hidden_size=256, head_num=4,
+                kv_head_num=4, head_size=64, layer_num=2,
+                expert_num=4, topk=2, moe_ffn=512,
+                dtype=jnp.float32, dispatch_probs=fused,
+            )
+            params = init_params(cfg, jax.random.PRNGKey(5))
+            losses[fused] = float(loss_fn(params, (ids, ids), cfg))
+        assert losses[True] == pytest.approx(losses[False], rel=1e-6)
