@@ -47,10 +47,20 @@ def sample_strategy(rng, model):
             ep = rng.choice(choices)
         mbc = rng.choice([1, 2, 4, 6, 8])
         vp = rng.choice([1, 2]) if pp > 1 and mbc % pp == 0 else 1
+        # uneven PP: the first stage takes f layers, the other pp-1
+        # stages k each (f may be larger or smaller than k — both are
+        # genuinely uneven; f == k would be the even split)
+        first = 0
+        if pp > 2 and vp == 1 and rng.random() < 0.3:
+            k = model.layer_num // pp + rng.choice([0, 1])
+            f = model.layer_num - k * (pp - 1)
+            if k >= 1 and f >= 1 and f != k:
+                first = f
         math_sdp = rng.random() < 0.2
         st = StrategyConfig(
             world_size=world, tp_size=tp, cp_size=cp, pp_size=pp,
             ep_size=ep, micro_batch_num=mbc, interleaving_size=vp,
+            num_layers_in_first_pipeline_stage=first,
             seq_len=rng.choice([1024, 2048]),
             enable_sequence_parallel=rng.random() < 0.8,
             enable_recompute=rng.random() < 0.4,
@@ -85,7 +95,7 @@ def sample_strategy(rng, model):
         if st.enable_sequence_parallel and st.seq_len % (tp * cp):
             continue
         total_stages = pp * vp
-        if model.layer_num % total_stages:
+        if first == 0 and model.layer_num % total_stages:
             continue
         return st
     return None
