@@ -50,12 +50,19 @@ class PPConfig:
     #: "a2a": capacity-based all_to_all token dispatch/combine (the
     #: layout the analytical Permutation/UnPermutation ops cost)
     ep_dispatch: str = "psum"
+    #: routing weights ride their own a2a at dispatch and fold into the
+    #: expert activation (weighted-SiLU) — the Megatron-0.14 combine
+    #: fusion the analytical ``dispatch_probs`` flag models. a2a only.
+    dispatch_probs: bool = False
     #: resolved from the mesh platform by make_pp_train_step (pallas
     #: kernels require real TPU devices, not the process default)
     use_flash: bool = False
 
     def __post_init__(self):
         assert self.ep_dispatch in ("psum", "a2a"), self.ep_dispatch
+        assert not self.dispatch_probs or self.ep_dispatch == "a2a", (
+            "dispatch_probs requires the a2a dispatch layout"
+        )
     expert_num: int = 8
     topk: int = 2
     moe_ffn: int = 256
@@ -252,6 +259,14 @@ def _moe_a2a_dispatch(y, p, li, cfg: PPConfig):
                               tiled=True)
     recv_e = jax.lax.all_to_all(send_e, "ep", split_axis=0, concat_axis=0,
                                 tiled=True)
+    if cfg.dispatch_probs:
+        # the probs a2a the analytical Permutation charges under
+        # dispatch_probs (reference ``moe_module.py:407-424``)
+        send_w = jnp.zeros((ep, C), y.dtype).at[sorted_dest, slot].set(
+            flat_w[order]
+        )
+        recv_w = jax.lax.all_to_all(send_w, "ep", split_axis=0,
+                                    concat_axis=0, tiled=True)
 
     local_e = recv_e.reshape(ep * C) - eidx
     valid = (recv_e.reshape(ep * C) >= 0) & (local_e >= 0) & (local_e < e_local)
@@ -260,6 +275,10 @@ def _moe_a2a_dispatch(y, p, li, cfg: PPConfig):
     xin = recv.reshape(ep * C, h)
     up = jnp.einsum("th,ehf->tef", xin, p["moe_up"][li])
     act = swiglu(up)
+    if cfg.dispatch_probs:
+        # weighted-SiLU: the routing weight multiplies the activation
+        # on the expert side; the combine becomes a plain gather-add
+        act = act * recv_w.reshape(ep * C)[:, None, None]
     down = jnp.einsum("tef,efh->teh", act, p["moe_down"][li])
     out_tok = jnp.einsum("teh,te->th", down, sel)
 
@@ -268,9 +287,9 @@ def _moe_a2a_dispatch(y, p, li, cfg: PPConfig):
         tiled=True,
     )
     vals = back[sorted_dest, slot]  # values in `order` ordering
-    o = jnp.zeros((T, h), y.dtype).at[flat_tok[order]].add(
-        vals * flat_w[order][:, None]
-    )
+    if not cfg.dispatch_probs:
+        vals = vals * flat_w[order][:, None]
+    o = jnp.zeros((T, h), y.dtype).at[flat_tok[order]].add(vals)
     return o.reshape(b, s_loc, h)
 
 
