@@ -76,14 +76,20 @@ def cmd_search(args):
         ep_list=_ints(args.ep), cp_list=_ints(args.cp),
         zero_list=zero_list,
         topk=args.topk, csv_path=args.csv, verbose=args.verbose,
+        project_dualpp=args.dualpp,
     )
     for r in rows:
+        dual = ""
+        if r.get("dualpp_mfu") is not None:
+            fits = "fits" if r["dualpp_fits"] else "OOM"
+            dual = f"  [DualPipe: {r['dualpp_mfu']*100:.2f}% {fits}]"
         print(
             f"tp{r['tp']} cp{r['cp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
             f"z{r['zero']} mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
             f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
             f"peak {r['peak_gib']:.1f} GiB"
             + (f"  [DCN: {r['dcn_dims']}]" if r.get("dcn_dims") else "")
+            + dual
         )
 
 
@@ -229,6 +235,8 @@ def main(argv=None):
     ps.add_argument("--topk", type=int, default=5)
     ps.add_argument("--csv")
     ps.add_argument("--verbose", action="store_true")
+    ps.add_argument("--dualpp", action="store_true",
+                    help="add a DualPipe projection column (even-pp rows)")
     ps.set_defaults(fn=cmd_search)
 
     pc = sub.add_parser(

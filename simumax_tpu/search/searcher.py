@@ -71,11 +71,18 @@ def evaluate_strategy(
     system: SystemConfig,
     cache: Optional[Dict] = None,
     gib_margin: float = 0.0,
+    project_dualpp: bool = False,
 ) -> Optional[dict]:
     """Estimate one candidate; returns a flat result row or None when
     the candidate is invalid or does not fit in HBM (reference
-    feasibility gate ``perf_llm.py:3148-3149``)."""
-    key = _strategy_key(strategy, model, system, gib_margin)
+    feasibility gate ``perf_llm.py:3148-3149``).
+
+    ``project_dualpp`` adds a DualPipe projection column for eligible
+    layouts (even pp, no VPP) — opt-in because it costs ~8% sweep
+    throughput."""
+    key = _strategy_key(strategy, model, system, gib_margin) + (
+        project_dualpp,
+    )
     if cache is not None and key in cache:
         return cache[key]
     row = None
@@ -113,6 +120,23 @@ def evaluate_strategy(
                 d for d, p in perf.ctx.paths.items() if p.on_dcn
             ),
         }
+        # DualPipe projection for eligible layouts (reuses the cached
+        # analyses; no re-estimate) — lets a sweep surface candidates
+        # whose bidirectional-schedule potential beats their 1F1B rank
+        # before anyone commits to the schedule
+        if (project_dualpp and strategy.pp_size >= 2
+                and strategy.pp_size % 2 == 0 and strategy.vp_size == 1):
+            dual = perf.analysis_dualpp()
+            row["dualpp_mfu"] = dual["projected_mfu"]
+            # same feasibility convention as the baseline gate,
+            # including the GiB safety margin
+            row["dualpp_fits"] = (
+                dual["max_peak_bytes"] + gib_margin * GiB
+                <= system.mem_bytes * strategy.mem_factor
+            )
+        elif project_dualpp:
+            row["dualpp_mfu"] = None
+            row["dualpp_fits"] = None
         if not fits:
             row = {**row, "mfu": 0.0}
     except ConfigError:
@@ -156,6 +180,7 @@ def search_micro_batch_config(
     global_batch_size: int,
     gib_margin: float = 1.0,
     cache: Optional[Dict] = None,
+    project_dualpp: bool = False,
 ) -> Optional[dict]:
     """Fixed-GBS (mbs, mbc) search with a GiB safety margin
     (reference ``perf_llm.py:3111-3167``, ``gmi_error``)."""
@@ -171,7 +196,8 @@ def search_micro_batch_config(
         st.micro_batch_num = per_dp // mbs
         if st.vp_size > 1 and st.micro_batch_num % st.vpp_group_size:
             continue
-        row = evaluate_strategy(st, model, system, cache, gib_margin)
+        row = evaluate_strategy(st, model, system, cache, gib_margin,
+                                project_dualpp=project_dualpp)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -193,6 +219,7 @@ def search_best_selective_recompute(
     model: ModelConfig,
     system: SystemConfig,
     cache: Optional[Dict] = None,
+    project_dualpp: bool = False,
 ) -> Optional[dict]:
     best = None
     for combo in _SELECTIVE_COMBOS:
@@ -202,7 +229,8 @@ def search_best_selective_recompute(
         st.recompute_layer_num = -1
         for k, v in combo.items():
             setattr(st, k, v)
-        row = evaluate_strategy(st, model, system, cache)
+        row = evaluate_strategy(st, model, system, cache,
+                                project_dualpp=project_dualpp)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -215,6 +243,7 @@ def search_best_recompute_layer_num(
     model: ModelConfig,
     system: SystemConfig,
     cache: Optional[Dict] = None,
+    project_dualpp: bool = False,
 ) -> Optional[dict]:
     """Binary-search the fewest full-recompute layers that still fit
     (reference ``perf_llm.py:3270-3328``) — fewer recomputed layers is
@@ -228,7 +257,8 @@ def search_best_recompute_layer_num(
         st.enable_recompute = mid > 0
         st.recompute_granularity = "full_block"
         st.recompute_layer_num = mid
-        row = evaluate_strategy(st, model, system, cache)
+        row = evaluate_strategy(st, model, system, cache,
+                                project_dualpp=project_dualpp)
         if row is not None and row["fits"]:
             best = row
             hi = mid - 1
@@ -252,6 +282,7 @@ def search_best_parallel_strategy(
     csv_path: Optional[str] = None,
     verbose: bool = False,
     cache: Optional[Dict] = None,
+    project_dualpp: bool = False,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): for each
@@ -285,7 +316,8 @@ def search_best_parallel_strategy(
                 st_rc.enable_recompute = False
                 candidates.append(
                     search_micro_batch_config(
-                        st_rc, model, system, global_batch_size, cache=cache
+                        st_rc, model, system, global_batch_size,
+                        cache=cache, project_dualpp=project_dualpp,
                     )
                 )
             elif rc == "selective":
@@ -303,7 +335,8 @@ def search_best_parallel_strategy(
                 st_rc.micro_batch_num = bs["mbc"]
                 candidates.append(
                     search_best_selective_recompute(
-                        st_rc, model, system, cache=cache
+                        st_rc, model, system, cache=cache,
+                        project_dualpp=project_dualpp,
                     )
                 )
             elif rc == "full_block":
@@ -311,7 +344,8 @@ def search_best_parallel_strategy(
                 st_rc.micro_batch_num = global_batch_size // st.dp_size
                 candidates.append(
                     search_best_recompute_layer_num(
-                        st_rc, model, system, cache=cache
+                        st_rc, model, system, cache=cache,
+                        project_dualpp=project_dualpp,
                     )
                 )
             for row in candidates:

@@ -192,3 +192,38 @@ class TestLayerDedup:
         # positive case: same-signature blocks DO share (fast path on)
         assert blocks[1].cost_info is blocks[2].cost_info
         assert blocks[4].cost_info is blocks[5].cost_info
+
+
+class TestDualPPProjectionColumn:
+    def test_even_pp_rows_carry_dualpp_projection(self):
+        from simumax_tpu.core.config import (
+            get_model_config,
+            get_strategy_config,
+            get_system_config,
+        )
+        from simumax_tpu.search import search_best_parallel_strategy
+
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        rows = search_best_parallel_strategy(
+            st, get_model_config("llama3-8b"),
+            get_system_config("tpu_v5p_256"), 64,
+            tp_list=(2,), pp_list=(1, 2),
+            recompute_types=("none",), topk=10,
+            project_dualpp=True,
+        )
+        assert rows
+        by_pp = {}
+        for r in rows:
+            by_pp.setdefault(r["pp"], r)
+        assert {1, 2} <= set(by_pp), by_pp.keys()
+        assert by_pp[2]["dualpp_mfu"] is not None
+        assert by_pp[2]["dualpp_fits"] in (True, False)
+        assert by_pp[1]["dualpp_mfu"] is None
+        # default sweeps stay lean: no projection columns
+        lean = search_best_parallel_strategy(
+            st, get_model_config("llama3-8b"),
+            get_system_config("tpu_v5p_256"), 64,
+            tp_list=(2,), pp_list=(2,),
+            recompute_types=("none",), topk=3,
+        )
+        assert lean and "dualpp_mfu" not in lean[0]
