@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import json
 import math
+import time as _time
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
 from simumax_tpu.calibration.timing import time_fn
+from simumax_tpu.core.errors import CalibrationError
+from simumax_tpu.core.records import Diagnostics
 
 _DTYPES = {
     "bf16": jnp.bfloat16,
@@ -29,6 +32,58 @@ _DTYPES = {
     "fp32": jnp.float32,
     "int8": jnp.int8,
 }
+
+#: measured efficiencies must land in (0, EFF_MAX] — a couple of percent
+#: above 1.0 is plausible clock/peak-spec slack, more means the
+#: benchmark (or its FLOPs/traffic convention) is wrong
+EFF_MAX = 1.05
+
+
+def validate_efficiency(eff: float, op_key: str = "",
+                        shape_key: str = "") -> float:
+    """Guard a measured efficiency before it is written back into the
+    system tables: must be finite and in ``(0, EFF_MAX]``."""
+    if not isinstance(eff, (int, float)) or not math.isfinite(eff):
+        raise CalibrationError(
+            f"measured efficiency for {op_key}[{shape_key}] is not finite: "
+            f"{eff!r}",
+            phase="calibrate", op_key=op_key, shape_key=shape_key,
+        )
+    if not 0.0 < eff <= EFF_MAX:
+        raise CalibrationError(
+            f"measured efficiency {eff:.4f} for {op_key}[{shape_key}] is "
+            f"outside (0, {EFF_MAX}] — benchmark or peak spec is wrong; "
+            f"refusing to write it back",
+            phase="calibrate", op_key=op_key, shape_key=shape_key,
+            efficiency=eff,
+        )
+    return float(eff)
+
+
+def with_retries(fn, *args, attempts: int = 3, backoff: float = 0.25,
+                 label: str = "", **kwargs):
+    """Run ``fn`` with bounded retry + exponential backoff.
+
+    JAX microbenchmarks fail transiently (tunnel drops, device OOM from
+    a neighbor, compile-cache races); a bounded retry keeps one flaky
+    measurement from aborting a whole calibration pass. After
+    ``attempts`` failures the last error is wrapped in a
+    :class:`CalibrationError` so callers can skip the key and continue."""
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn(*args, **kwargs)
+        except CalibrationError:
+            raise  # already classified (e.g. all-NaN samples): no retry
+        except Exception as exc:
+            last = exc
+            if attempt < attempts - 1:
+                _time.sleep(backoff * (2 ** attempt))
+    raise CalibrationError(
+        f"microbenchmark {label or getattr(fn, '__name__', fn)!s} failed "
+        f"after {attempts} attempts: {last}",
+        phase="calibrate", attempts=attempts, last_error=repr(last),
+    ) from last
 
 
 def _parse_key(key: str) -> Dict[str, str]:
@@ -389,28 +444,40 @@ def calibrate_bandwidth_classes(system, verbose: bool = False,
 
 
 def calibrate_key(op_key: str, shape_key: str, system,
-                  sparse_ratio: float = 0.5) -> Optional[float]:
-    """Measure one (op table, shape key) pair; None if unsupported."""
+                  sparse_ratio: float = 0.5,
+                  attempts: int = 3) -> Optional[float]:
+    """Measure one (op table, shape key) pair; None if unsupported.
+
+    Each microbenchmark runs under bounded retry with backoff
+    (:func:`with_retries`); after exhausting retries a
+    :class:`CalibrationError` propagates so the caller can quarantine
+    the key."""
     kv = _parse_key(shape_key)
     peak = _peak_tflops(system, op_key)
+    label = f"{op_key}[{shape_key}]"
     try:
         if op_key.endswith("group_matmul"):
-            return measure_gemm_efficiency(
+            return with_retries(
+                measure_gemm_efficiency,
                 m=int(kv["M"]), k=int(kv["K"]), n=int(kv["N"]),
                 dtype=kv.get("dtype", "bf16"),
                 out_dtype="fp32" if kv.get("accumulate") == "True" else kv.get("dtype", "bf16"),
                 peak_tflops=peak, groups=int(kv["ng"]),
+                attempts=attempts, label=label,
             )
         if op_key.endswith("matmul"):
-            return measure_gemm_efficiency(
+            return with_retries(
+                measure_gemm_efficiency,
                 m=int(kv["m"]), k=int(kv["k"]), n=int(kv["n"]),
                 dtype="int8" if op_key.startswith("int8") else "bf16",
                 out_dtype=kv.get("out_dtype", "bf16"),
                 peak_tflops=peak, batch=int(kv.get("b", 1)),
                 layout=kv.get("layout", "NN"),
+                attempts=attempts, label=label,
             )
         if op_key in ("sdp_fwd", "sdp_bwd"):
-            return measure_sdp_efficiency(
+            return with_retries(
+                measure_sdp_efficiency,
                 b=int(kv["b"]), sq=int(kv["sq"]), skv=int(kv["skv"]),
                 hn=int(kv["hn"]), kv_hn=int(kv["kv_hn"]), hd=int(kv["hd"]),
                 hd_v=int(kv.get("hd_v", kv["hd"])),
@@ -419,17 +486,28 @@ def calibrate_key(op_key: str, shape_key: str, system,
                 backward=op_key == "sdp_bwd", sparse_ratio=sparse_ratio,
                 backend=kv.get("backend", "xla"),
                 flash=kv.get("flash", "True") == "True",
+                attempts=attempts, label=label,
             )
     except (KeyError, ValueError):
+        # malformed shape key for this op family: unsupported, not an
+        # error worth retrying
         return None
     return None
 
 
 def calibrate_for_perf(perf, max_keys: Optional[int] = None,
-                       verbose: bool = False) -> Dict[str, Dict[str, float]]:
+                       verbose: bool = False,
+                       diagnostics: Optional[Diagnostics] = None,
+                       ) -> Dict[str, Dict[str, float]]:
     """Measure every efficiency-table miss recorded by the last
     ``run_estimate()`` and write the results into the live SystemConfig.
-    Returns {op_key: {shape_key: efficiency}}."""
+    Returns {op_key: {shape_key: efficiency}}.
+
+    Hardened: each key's benchmark retries transient failures
+    (:func:`with_retries`) and its result must pass
+    :func:`validate_efficiency` before write-back; keys that still fail
+    are skipped and recorded in ``diagnostics`` instead of aborting the
+    whole calibration pass."""
     system = perf.system
     sparse = perf.strategy.attention_sparse_ratio
     measured: Dict[str, Dict[str, float]] = {}
@@ -441,8 +519,19 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
         for shape_key in keys:
             if max_keys is not None and count >= max_keys:
                 break
-            eff = calibrate_key(op_key, shape_key, system, sparse)
-            if eff is None:
+            try:
+                eff = calibrate_key(op_key, shape_key, system, sparse)
+                if eff is None:
+                    continue
+                eff = validate_efficiency(eff, op_key, shape_key)
+            except CalibrationError as exc:
+                if diagnostics is not None:
+                    diagnostics.record_exception(
+                        exc, category="calibration",
+                        op_key=op_key, shape_key=shape_key,
+                    )
+                if verbose:
+                    print(f"[cal] SKIP {op_key}: {shape_key} ({exc})")
                 continue
             spec.accurate_efficient_factor[shape_key] = eff
             measured.setdefault(op_key, {})[shape_key] = eff
@@ -458,8 +547,19 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
 
         base = system.accelerator.bandwidth["default"]
         try:
-            eff = _measure_fused_adam(base.gbps)
-        except Exception:
+            eff = validate_efficiency(
+                with_retries(_measure_fused_adam, base.gbps,
+                             label="bandwidth[fused_adam]"),
+                "bandwidth", "fused_adam",
+            )
+        except CalibrationError as exc:
+            if diagnostics is not None:
+                diagnostics.record_exception(
+                    exc, category="calibration", op_key="bandwidth",
+                    shape_key="fused_adam",
+                )
+            if verbose:
+                print(f"[cal] SKIP bandwidth fused_adam ({exc})")
             eff = None
         if eff is not None:
             system.accelerator.bandwidth["fused_adam"] = BandwidthSpec(
@@ -475,10 +575,16 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
 def calibrate_system(perf, save_path: Optional[str] = None, **kwargs):
     """calibrate_for_perf + re-estimate + optional write-back of the
     updated system config JSON (reference ``combine_efficiency.py`` +
-    ``apply_ws_comm_model.py`` write-back)."""
+    ``apply_ws_comm_model.py`` write-back).
+
+    The saved config carries a provenance stamp (hardware-identity hash
+    + date + version, ``SystemConfig.stamp_provenance``) so loading it
+    against a different system config warns instead of silently skewing
+    estimates."""
     measured = calibrate_for_perf(perf, **kwargs)
     perf.run_estimate()  # re-run with calibrated tables
     if save_path:
+        perf.system.stamp_provenance()
         cfg = perf.system.to_dict()
         with open(save_path, "w") as f:
             json.dump(cfg, f, indent=2, default=lambda o: vars(o))

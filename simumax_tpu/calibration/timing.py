@@ -9,14 +9,44 @@ separately measured fetch round-trip.
 
 from __future__ import annotations
 
+import math
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from simumax_tpu.core.errors import CalibrationError
+
 _rtt_cache: Optional[float] = None
+
+
+def reject_outliers(samples: Sequence[float], z: float = 3.5) -> List[float]:
+    """Drop non-finite samples and MAD outliers.
+
+    A sample is an outlier when its modified z-score
+    ``|x - median| / (1.4826 * MAD)`` exceeds ``z`` — robust against the
+    occasional GC pause / tunnel hiccup that a mean (or even a plain
+    median of few samples) would let skew the measurement. Raises
+    :class:`CalibrationError` when nothing finite remains."""
+    finite = [float(s) for s in samples if math.isfinite(s)]
+    if not finite:
+        raise CalibrationError(
+            f"no finite timing samples (got {list(samples)!r})",
+            phase="calibrate",
+        )
+    med = float(np.median(finite))
+    mad = float(np.median([abs(x - med) for x in finite]))
+    if mad == 0.0:
+        return finite
+    kept = [x for x in finite if abs(x - med) / (1.4826 * mad) <= z]
+    return kept or [med]
+
+
+def robust_median(samples: Sequence[float], z: float = 3.5) -> float:
+    """Median of the MAD-filtered samples (median-of-k hardening)."""
+    return float(np.median(reject_outliers(samples, z)))
 
 
 def _fetch_scalar(out) -> float:
@@ -51,12 +81,14 @@ def time_fn(
     iters: int = 3,
     amortize: int = 8,
 ) -> float:
-    """Median per-call seconds of ``fn(*args)``.
+    """Robust-median per-call seconds of ``fn(*args)``.
 
     Each sample chains ``amortize`` calls and fetches a scalar from the
     last result; the fetch round-trip is subtracted. Calls must be
     side-effect-free (results independent) — the chain exists purely to
-    amortize dispatch/fetch overhead.
+    amortize dispatch/fetch overhead. Samples are hardened with MAD
+    outlier rejection (:func:`robust_median`) so a single scheduler
+    stall cannot skew the calibrated efficiency.
     """
     rtt = fetch_rtt()
     for _ in range(warmup):
@@ -70,8 +102,7 @@ def time_fn(
         _fetch_scalar(out)
         total = time.perf_counter() - t0
         samples.append(max(total - rtt, 1e-9) / amortize)
-    samples.sort()
-    return samples[len(samples) // 2]
+    return robust_median(samples)
 
 
 def time_stateful(step: Callable, warmup: int = 1, iters: int = 8) -> float:

@@ -13,16 +13,80 @@ non-interactively::
         --system ... --save my_system.json      # needs a live TPU
     python -m simumax_tpu straggler --model ... --strategy ... \
         --system ... --ranks 0:1.2,5:1.5        # per-rank slowdowns
+
+Resilience surface (see ``docs/diagnostics.md``): ``perf`` / ``search``
+/ ``calibrate`` accept ``--diagnostics PATH`` (write the JSON report)
+and ``--strict`` (exit 3 on any warning / efficiency miss / quarantined
+failure); ``search`` additionally takes ``--journal`` / ``--resume``
+(JSONL sweep checkpointing) and ``--candidate-timeout``. Config-family
+errors exit 2 with a one-line message instead of a traceback.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
+import json
 import sys
+
+#: exit codes: 2 = bad config / usage, 3 = --strict violation
+EXIT_CONFIG = 2
+EXIT_STRICT = 3
 
 
 def _ints(s: str):
     return tuple(int(x) for x in s.split(","))
+
+
+def _emit_diagnostics(diag, args):
+    """Emit the diagnostics report — also on the failure path (a run
+    that aborted is exactly the run the report must explain).
+
+    Writes the JSON to ``--diagnostics PATH`` when given (a compact
+    summary goes to stdout), otherwise prints the full report as one
+    ``[diagnostics]``-prefixed JSON line."""
+    path = getattr(args, "diagnostics", None)
+    if path:
+        diag.write(path)
+        print(f"[diagnostics] {diag.summary_line()} -> {path}")
+    else:
+        print("[diagnostics] "
+              + json.dumps(diag.to_dict(), separators=(",", ":")))
+
+
+def _check_strict(diag, args):
+    if getattr(args, "strict", False):
+        violations = diag.violations()
+        if violations:
+            print(
+                "error: strict mode: " + ", ".join(violations),
+                file=sys.stderr,
+            )
+            sys.exit(EXIT_STRICT)
+
+
+@contextlib.contextmanager
+def _diagnosed(diag, args):
+    """Run a command body with the report guaranteed on exit: a fatal
+    ``SimuMaxError`` is recorded as the report's final error, the report
+    is emitted in a ``finally`` (so aborts still produce it — a failed
+    emit must not mask the real failure), then ``--strict`` is enforced
+    only when the body itself succeeded — a failing body already
+    carries its own exit code."""
+    from simumax_tpu.core.errors import SimuMaxError
+
+    try:
+        yield
+    except SimuMaxError as exc:
+        diag.record_exception(exc, category="fatal")
+        raise
+    finally:
+        try:
+            _emit_diagnostics(diag, args)
+        except OSError as exc:
+            print(f"warning: could not write diagnostics report: {exc}",
+                  file=sys.stderr)
+    _check_strict(diag, args)
 
 
 def cmd_list(args):
@@ -37,18 +101,30 @@ def cmd_list(args):
 def cmd_perf(args):
     from simumax_tpu import PerfLLM
 
-    perf = PerfLLM().configure(args.strategy, args.model, args.system)
-    perf.run_estimate(capture_graph=args.graph)
-    perf.analysis(save_path=args.save)
-    if args.simulate:
-        result = perf.simulate(args.simulate)
-        print(
-            f"simulated: {result['end_time_ms']:.2f} ms, "
-            f"trace at {result.get('trace_path')}"
-        )
+    perf = PerfLLM()
+    perf.diagnostics.strict = args.strict
+    with _diagnosed(perf.diagnostics, args):
+        perf.configure(args.strategy, args.model, args.system)
+        perf.run_estimate(capture_graph=args.graph)
+        perf.analysis(save_path=args.save)
+        if args.simulate:
+            with perf.diagnostics.capture(category="simulate"):
+                result = perf.simulate(args.simulate)
+            print(
+                f"simulated: {result['end_time_ms']:.2f} ms, "
+                f"trace at {result.get('trace_path')}"
+            )
 
 
 def cmd_search(args):
+    from simumax_tpu.core.records import Diagnostics
+
+    diag = Diagnostics(strict=args.strict)
+    with _diagnosed(diag, args):
+        _run_search(args, diag)
+
+
+def _run_search(args, diag):
     from simumax_tpu.core.config import (
         get_model_config,
         get_strategy_config,
@@ -56,9 +132,10 @@ def cmd_search(args):
     )
     from simumax_tpu.search import search_best_parallel_strategy
 
-    model = get_model_config(args.model)
-    system = get_system_config(args.system)
-    base = get_strategy_config(args.base_strategy)
+    with diag.capture(category="config"):
+        model = get_model_config(args.model)
+        system = get_system_config(args.system)
+        base = get_strategy_config(args.base_strategy)
     if args.world:
         base.world_size = args.world
     if args.seq_len:
@@ -70,14 +147,22 @@ def cmd_search(args):
             f"invalid --zero levels {bad}: expected a comma list of "
             "0-3 (e.g. --zero 1,3)"
         )
-    rows = search_best_parallel_strategy(
-        base, model, system, args.gbs,
-        tp_list=_ints(args.tp), pp_list=_ints(args.pp),
-        ep_list=_ints(args.ep), cp_list=_ints(args.cp),
-        zero_list=zero_list,
-        topk=args.topk, csv_path=args.csv, verbose=args.verbose,
-        project_dualpp=args.dualpp,
-    )
+    # --resume without an explicit --journal extends the same journal,
+    # so repeated interrupted runs keep one continuous checkpoint
+    journal_path = args.journal or args.resume
+    with diag.capture(category="search"):
+        rows = search_best_parallel_strategy(
+            base, model, system, args.gbs,
+            tp_list=_ints(args.tp), pp_list=_ints(args.pp),
+            ep_list=_ints(args.ep), cp_list=_ints(args.cp),
+            zero_list=zero_list,
+            topk=args.topk, csv_path=args.csv, verbose=args.verbose,
+            project_dualpp=args.dualpp,
+            candidate_timeout=args.candidate_timeout,
+            journal_path=journal_path,
+            resume=args.resume,
+            diagnostics=diag,
+        )
     for r in rows:
         dual = ""
         if r.get("dualpp_mfu") is not None:
@@ -95,9 +180,17 @@ def cmd_search(args):
 
 def cmd_calibrate(args):
     from simumax_tpu import PerfLLM
+
+    perf = PerfLLM()
+    perf.diagnostics.strict = args.strict
+    with _diagnosed(perf.diagnostics, args):
+        _run_calibrate(args, perf)
+
+
+def _run_calibrate(args, perf):
     from simumax_tpu.calibration import calibrate_system
 
-    perf = PerfLLM().configure(args.strategy, args.model, args.system)
+    perf.configure(args.strategy, args.model, args.system)
     perf.run_estimate()
     if args.bandwidth:
         from simumax_tpu.calibration.autocal import calibrate_bandwidth_classes
@@ -126,7 +219,8 @@ def cmd_calibrate(args):
                 print(f"[cal] {op}: {fit['fitted_bw_gbps']:.1f} GB/s, "
                       f"{fit['fitted_latency_us']:.1f} us")
     measured = calibrate_system(
-        perf, save_path=args.save, max_keys=args.max_keys, verbose=True
+        perf, save_path=args.save, max_keys=args.max_keys, verbose=True,
+        diagnostics=perf.diagnostics,
     )
     n = sum(len(v) for v in measured.values())
     print(f"calibrated {n} shape keys"
@@ -211,6 +305,18 @@ def main(argv=None):
         fn=cmd_list
     )
 
+    def _add_diag_args(parser):
+        parser.add_argument(
+            "--diagnostics", metavar="PATH",
+            help="write the diagnostics JSON report here "
+                 "(default: printed as one [diagnostics] line)",
+        )
+        parser.add_argument(
+            "--strict", action="store_true",
+            help="exit 3 on any warning / efficiency-table miss / "
+                 "quarantined failure",
+        )
+
     pp = sub.add_parser("perf", help="estimate one configuration")
     pp.add_argument("--model", required=True)
     pp.add_argument("--strategy", required=True)
@@ -218,6 +324,7 @@ def main(argv=None):
     pp.add_argument("--save", help="directory for result JSONs")
     pp.add_argument("--simulate", help="run the event simulator; dir for trace")
     pp.add_argument("--graph", action="store_true", help="capture op graph")
+    _add_diag_args(pp)
     pp.set_defaults(fn=cmd_perf)
 
     ps = sub.add_parser("search", help="sweep parallel strategies")
@@ -237,6 +344,22 @@ def main(argv=None):
     ps.add_argument("--verbose", action="store_true")
     ps.add_argument("--dualpp", action="store_true",
                     help="add a DualPipe projection column (even-pp rows)")
+    ps.add_argument(
+        "--journal", metavar="PATH",
+        help="checkpoint every evaluated candidate to this JSONL journal",
+    )
+    ps.add_argument(
+        "--resume", metavar="PATH",
+        help="replay a sweep journal: journaled candidates are not "
+             "re-evaluated (also extends the journal unless --journal "
+             "points elsewhere)",
+    )
+    ps.add_argument(
+        "--candidate-timeout", type=float, default=None, metavar="SECONDS",
+        help="per-candidate deadline; slower candidates are quarantined "
+             "as status=error rows instead of stalling the sweep",
+    )
+    _add_diag_args(ps)
     ps.set_defaults(fn=cmd_search)
 
     pc = sub.add_parser(
@@ -251,6 +374,7 @@ def main(argv=None):
                     help="also calibrate HBM bandwidth classes")
     pc.add_argument("--collectives", action="store_true",
                     help="also sweep+fit collectives (needs >1 device)")
+    _add_diag_args(pc)
     pc.set_defaults(fn=cmd_calibrate)
 
     pd = sub.add_parser(
@@ -277,7 +401,28 @@ def main(argv=None):
     pst.set_defaults(fn=cmd_straggler)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    # One-line actionable messages instead of tracebacks for the whole
+    # anticipated-failure taxonomy (core/errors.py). Unanticipated bugs
+    # still traceback — that is the right behavior for them.
+    from simumax_tpu.core.errors import (
+        ConfigError,
+        SimuMaxError,
+        UnknownConfigError,
+    )
+
+    try:
+        return args.fn(args)
+    except UnknownConfigError as e:
+        print(f"error: {e}", file=sys.stderr)
+        print("hint: `python -m simumax_tpu list` shows every config",
+              file=sys.stderr)
+        sys.exit(EXIT_CONFIG)
+    except ConfigError as e:
+        print(f"error: invalid configuration — {e}", file=sys.stderr)
+        sys.exit(EXIT_CONFIG)
+    except SimuMaxError as e:
+        print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -29,12 +29,15 @@ per second); ``latency_us`` in microseconds.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import math
 import os
 import warnings
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
+
+from simumax_tpu.core.errors import ConfigError, UnknownConfigError
 
 # --------------------------------------------------------------------------
 # Constants / small helpers
@@ -67,14 +70,6 @@ def dtype_to_bytes(dtype: str) -> float:
 
 def _is_pow2(x: int) -> bool:
     return x > 0 and (x & (x - 1)) == 0
-
-
-class ConfigError(ValueError):
-    """An infeasible / inconsistent config combination.
-
-    Raised by the config ``sanity_check``s and the cross-config checks so
-    that strategy search can reject a candidate without also swallowing
-    internal invariant failures (which stay ``AssertionError``)."""
 
 
 def _require(cond: bool, msg: str = "invalid config"):
@@ -927,6 +922,16 @@ class SystemConfig(ConfigBase):
     accelerator: Any = field(default_factory=AcceleratorSpec)
     ici: Any = field(default_factory=IciConfig)
     dcn: Any = field(default_factory=DcnConfig)
+    #: calibration-table provenance stamp written by
+    #: ``calibration.autocal.calibrate_system``: ``system_hash``
+    #: (``fingerprint()`` of the hardware identity at calibration time),
+    #: ``created`` (ISO date), ``version``. Checked on load so a table
+    #: calibrated for different hardware warns instead of silently
+    #: skewing estimates.
+    provenance: Optional[Dict[str, Any]] = None
+
+    #: provenance stamps older than this warn as stale
+    PROVENANCE_MAX_AGE_DAYS = 180
 
     def __post_init__(self):
         if isinstance(self.accelerator, dict):
@@ -936,20 +941,95 @@ class SystemConfig(ConfigBase):
         if isinstance(self.dcn, dict):
             self.dcn = DcnConfig(**self.dcn)
         self.reset_status()
+        self._check_provenance()
+
+    def fingerprint(self) -> str:
+        """Stable hash of the *hardware identity* — peak rates, capacity,
+        topology — excluding the measured efficiency tables (which
+        calibration rewrites). Two configs with the same fingerprint
+        describe the same machine, so each other's calibration tables
+        are interchangeable."""
+        ident = {
+            "sys_name": self.sys_name,
+            "num_slices": self.num_slices,
+            "mem_gbs": self.accelerator.mem_gbs,
+            "op_tflops": {k: v.tflops for k, v in self.accelerator.op.items()},
+            # 'fused_adam' is synthesized by calibration (same physical
+            # HBM as 'default'), so hashing it would make a calibrated
+            # config's stamp mismatch the pristine config it came from
+            "bw_gbps": {k: v.gbps
+                        for k, v in self.accelerator.bandwidth.items()
+                        if k != "fused_adam"},
+            "ici_axes": list(self.ici.axes),
+            "ici_link_gbps": self.ici.link_gbps,
+            "dcn_gbps_per_chip": self.dcn.gbps_per_chip,
+        }
+        blob = json.dumps(ident, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def stamp_provenance(self) -> Dict[str, Any]:
+        """Write a fresh provenance stamp (called after calibration)."""
+        import datetime
+
+        from simumax_tpu.version import __version__
+
+        self.provenance = {
+            "system_hash": self.fingerprint(),
+            "created": datetime.date.today().isoformat(),
+            "version": __version__,
+        }
+        return self.provenance
+
+    def _check_provenance(self):
+        """Warn when a loaded calibration table is stale: stamped for a
+        different hardware identity, or older than
+        ``PROVENANCE_MAX_AGE_DAYS``."""
+        if not self.provenance:
+            return
+        stamped = self.provenance.get("system_hash")
+        if stamped and stamped != self.fingerprint():
+            warnings.warn(
+                f"system {self.sys_name!r}: calibration tables are stale — "
+                f"stamped for hardware {stamped}, this config is "
+                f"{self.fingerprint()}; re-run `simumax_tpu calibrate` "
+                f"(estimates will use possibly-skewed efficiencies)",
+                stacklevel=2,
+            )
+        created = self.provenance.get("created")
+        if created:
+            import datetime
+
+            try:
+                age = (
+                    datetime.date.today()
+                    - datetime.date.fromisoformat(str(created))
+                ).days
+            except ValueError:
+                age = None
+            if age is not None and age > self.PROVENANCE_MAX_AGE_DAYS:
+                warnings.warn(
+                    f"system {self.sys_name!r}: calibration tables are "
+                    f"{age} days old (> {self.PROVENANCE_MAX_AGE_DAYS}); "
+                    f"consider re-running `simumax_tpu calibrate`",
+                    stacklevel=2,
+                )
 
     # -- observability (reference ``config.py:792-813``) -------------------
     def reset_status(self):
         self.hit_efficiency: Dict[str, Dict[str, float]] = {}
-        self.miss_efficiency: Dict[str, List[str]] = {}
+        #: shape keys that fell back to the flat per-op efficiency, mapped
+        #: to the fallback factor used. An insertion-ordered dict keyed
+        #: per op: O(1) membership (a long estimate records the same hot
+        #: keys millions of times) while staying JSON-serializable and
+        #: iterable in first-miss order like the old list.
+        self.miss_efficiency: Dict[str, Dict[str, float]] = {}
         self.real_comm_bw: Dict[str, Dict[str, float]] = {}
 
     def _record_eff(self, op_key: str, shape_key: str, eff: float, hit: bool):
         if hit:
             self.hit_efficiency.setdefault(op_key, {})[shape_key] = eff
         else:
-            misses = self.miss_efficiency.setdefault(op_key, [])
-            if shape_key not in misses:
-                misses.append(shape_key)
+            self.miss_efficiency.setdefault(op_key, {})[shape_key] = eff
 
     def _record_bw(self, dim: str, op: str, bw_gbps: float):
         self.real_comm_bw.setdefault(dim, {})[op] = bw_gbps
@@ -1172,21 +1252,21 @@ def _registry(kind: str) -> Dict[str, str]:
 def get_model_config(name: str) -> ModelConfig:
     reg = _registry("models")
     if name not in reg:
-        raise KeyError(f"unknown model config {name!r}; have {sorted(reg)}")
+        raise UnknownConfigError("model", name, available=reg)
     return ModelConfig.init_from_config_file(reg[name])
 
 
 def get_strategy_config(name: str) -> StrategyConfig:
     reg = _registry("strategy")
     if name not in reg:
-        raise KeyError(f"unknown strategy config {name!r}; have {sorted(reg)}")
+        raise UnknownConfigError("strategy", name, available=reg)
     return StrategyConfig.init_from_config_file(reg[name])
 
 
 def get_system_config(name: str) -> SystemConfig:
     reg = _registry("system")
     if name not in reg:
-        raise KeyError(f"unknown system config {name!r}; have {sorted(reg)}")
+        raise UnknownConfigError("system", name, available=reg)
     return SystemConfig.init_from_config_file(reg[name])
 
 

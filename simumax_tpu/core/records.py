@@ -10,9 +10,14 @@ dataclasses keyed by the three backprop phases ``fwd`` / ``bwd_act``
 
 from __future__ import annotations
 
+import contextlib
 import enum
+import json
+import warnings as _warnings
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Any, Dict, List, Optional
+
+from simumax_tpu.core.errors import SimuMaxError, _json_safe
 
 PHASES = ("fwd", "bwd_act", "bwd_w")
 
@@ -211,6 +216,211 @@ class CostInfo:
     @property
     def total_net_exposed(self) -> float:
         return self.net_exposed.total
+
+
+@dataclass
+class DiagnosticEvent:
+    """One diagnostic fact: a funneled warning, a quarantined candidate,
+    a calibration skip. ``context`` carries structured coordinates
+    (candidate key, op/shape key, phase...)."""
+
+    severity: str  # "warning" | "error"
+    category: str  # e.g. "config", "placement", "calibration", "quarantine"
+    message: str
+    context: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "severity": self.severity,
+            "category": self.category,
+            "message": self.message,
+            "context": _json_safe(self.context),
+        }
+
+
+class Diagnostics:
+    """Central diagnostics collector (the report side of the resilience
+    layer — see ``docs/diagnostics.md`` for the JSON schema).
+
+    Funnels the previously ad-hoc ``warnings.warn`` calls (via
+    :meth:`capture`), quarantined sweep failures, calibration skips, and
+    efficiency-table hit/miss coverage into one machine-readable report
+    emitted by ``perf`` / ``search`` / ``simulate`` / ``calibrate``.
+
+    ``strict`` promotes any warning / miss / quarantined failure into a
+    hard failure: :meth:`violations` lists what strict mode objects to,
+    and the CLI turns a non-empty list into exit code 3."""
+
+    SCHEMA = "simumax-diagnostics-v1"
+
+    #: innermost :meth:`activate` collector — lets deep layers (each
+    #: sweep candidate builds its own PerfLLM) report into the run-level
+    #: collector without threading it through every call signature
+    _active: List["Diagnostics"] = []
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self.events: List[DiagnosticEvent] = []
+        self._dedup: Dict[tuple, DiagnosticEvent] = {}
+        self._eff_hits: Dict[str, set] = {}
+        self._eff_misses: Dict[str, set] = {}
+
+    @classmethod
+    def active(cls) -> Optional["Diagnostics"]:
+        return cls._active[-1] if cls._active else None
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this the collector that ``Diagnostics.active()`` (and so
+        every ``PerfBase`` built inside the block) reports into."""
+        Diagnostics._active.append(self)
+        try:
+            yield self
+        finally:
+            Diagnostics._active.pop()
+
+    # -- recording ---------------------------------------------------------
+    def _record(self, event: DiagnosticEvent):
+        # a sweep repeats the same warning for thousands of candidates:
+        # collapse identical facts into one event with a `count`, but
+        # never collapse across distinct coordinates (candidate / table key)
+        ctx = event.context
+        key = (event.severity, event.category, event.message,
+               ctx.get("candidate"), ctx.get("op_key"), ctx.get("shape_key"))
+        prior = self._dedup.get(key)
+        if prior is not None:
+            prior.context["count"] = prior.context.get("count", 1) + 1
+            return
+        self._dedup[key] = event
+        self.events.append(event)
+
+    def warn(self, category: str, message: str, **context: Any):
+        self._record(
+            DiagnosticEvent("warning", category, message, dict(context))
+        )
+
+    def error(self, category: str, message: str, **context: Any):
+        self._record(
+            DiagnosticEvent("error", category, message, dict(context))
+        )
+
+    def record_exception(self, exc: BaseException, category: str = "error",
+                         **context: Any):
+        """Record a caught exception; ``SimuMaxError`` context is merged."""
+        ctx = dict(context)
+        if isinstance(exc, SimuMaxError):
+            ctx.update(exc.context)
+        ctx["exception"] = type(exc).__name__
+        self.error(category, str(exc) or type(exc).__name__, **ctx)
+
+    def record_efficiency(self, system):
+        """Merge efficiency-table coverage from a ``SystemConfig`` after
+        an estimate (``hit_efficiency`` / ``miss_efficiency``). Merging
+        (not snapshotting) matters for sweeps: ``run_estimate`` resets
+        the per-candidate status, so the report must union coverage
+        across every candidate it saw."""
+        for op_key, hits in system.hit_efficiency.items():
+            self._eff_hits.setdefault(op_key, set()).update(hits)
+        for op_key, misses in system.miss_efficiency.items():
+            self._eff_misses.setdefault(op_key, set()).update(misses)
+
+    @property
+    def efficiency(self) -> Dict[str, Dict[str, Any]]:
+        """Per-op coverage: shape keys hit vs missed across the run."""
+        per_op: Dict[str, Dict[str, Any]] = {}
+        for op_key, hits in self._eff_hits.items():
+            per_op.setdefault(op_key, {"hits": 0, "misses": 0})["hits"] = (
+                len(hits)
+            )
+        for op_key, misses in self._eff_misses.items():
+            entry = per_op.setdefault(op_key, {"hits": 0, "misses": 0})
+            entry["misses"] = len(misses)
+            entry["miss_keys"] = sorted(misses)
+        return per_op
+
+    @contextlib.contextmanager
+    def capture(self, category: str = "warning"):
+        """Funnel ``warnings.warn`` calls raised inside the block into
+        this collector (they land in the report instead of stderr).
+
+        Exceptions are NOT recorded here: an error escaping this block
+        may still be handled upstream (a sweep rejecting an infeasible
+        candidate is not a run failure). Recording belongs to whoever
+        decides the error's fate — the sweep's quarantine handler, or
+        the CLI boundary for genuinely fatal ones."""
+        with _warnings.catch_warnings(record=True) as buf:
+            _warnings.simplefilter("always")
+            try:
+                yield self
+            finally:
+                for w in buf:
+                    self.warn(category, str(w.message),
+                              warning_class=w.category.__name__)
+
+    # -- reporting ---------------------------------------------------------
+    @property
+    def warnings(self) -> List[DiagnosticEvent]:
+        return [e for e in self.events if e.severity == "warning"]
+
+    @property
+    def errors(self) -> List[DiagnosticEvent]:
+        return [e for e in self.events if e.severity == "error"]
+
+    @property
+    def quarantined(self) -> List[DiagnosticEvent]:
+        return [e for e in self.events if e.category == "quarantine"]
+
+    @property
+    def miss_count(self) -> int:
+        return sum(e.get("misses", 0) for e in self.efficiency.values())
+
+    @property
+    def hit_count(self) -> int:
+        return sum(e.get("hits", 0) for e in self.efficiency.values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        hits, misses = self.hit_count, self.miss_count
+        total = hits + misses
+        return {
+            "schema": self.SCHEMA,
+            "strict": self.strict,
+            "counts": {
+                "warnings": len(self.warnings),
+                "errors": len(self.errors),
+                "quarantined": len(self.quarantined),
+            },
+            "efficiency": {
+                "hits": hits,
+                "misses": misses,
+                "coverage": (hits / total) if total else 1.0,
+                "per_op": self.efficiency,
+            },
+            "warnings": [e.to_dict() for e in self.warnings],
+            "errors": [e.to_dict() for e in self.errors],
+        }
+
+    def write(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+    def summary_line(self) -> str:
+        return (
+            f"warnings={len(self.warnings)} errors={len(self.errors)} "
+            f"quarantined={len(self.quarantined)} "
+            f"eff_hits={self.hit_count} eff_misses={self.miss_count}"
+        )
+
+    def violations(self) -> List[str]:
+        """What strict mode would object to."""
+        out = []
+        if self.errors:
+            out.append(f"{len(self.errors)} error(s)")
+        if self.warnings:
+            out.append(f"{len(self.warnings)} warning(s)")
+        if self.miss_count:
+            out.append(f"{self.miss_count} efficiency-table miss(es)")
+        return out
 
 
 @dataclass

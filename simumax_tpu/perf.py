@@ -31,6 +31,7 @@ from simumax_tpu.core.config import (
     get_system_config,
 )
 from simumax_tpu.core.module import BuildContext
+from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.core.utils import dp_comm_buckets, human_time
 from simumax_tpu.models.llm import LLMModel
 
@@ -54,6 +55,12 @@ class PerfBase:
         self.strategy: Optional[StrategyConfig] = None
         self.model_config: Optional[ModelConfig] = None
         self.system: Optional[SystemConfig] = None
+        #: central collector for this estimate's warnings / calibration
+        #: coverage / quarantined failures (see docs/diagnostics.md).
+        #: Inside a ``Diagnostics.activate()`` block (a sweep, a CLI run)
+        #: this joins the run-level collector instead of starting a
+        #: throwaway one, so per-candidate warnings reach the report.
+        self.diagnostics = Diagnostics.active() or Diagnostics()
 
     def configure(
         self,
@@ -61,12 +68,13 @@ class PerfBase:
         model: Union[str, dict, ModelConfig],
         system: Union[str, dict, SystemConfig],
     ):
-        self.strategy = _resolve(strategy, StrategyConfig, get_strategy_config)
-        self.model_config = _resolve(model, ModelConfig, get_model_config)
-        self.system = _resolve(system, SystemConfig, get_system_config)
-        self.strategy.sanity_check()
-        self.model_config.sanity_check()
-        self._cross_sanity_check()
+        with self.diagnostics.capture(category="config"):
+            self.strategy = _resolve(strategy, StrategyConfig, get_strategy_config)
+            self.model_config = _resolve(model, ModelConfig, get_model_config)
+            self.system = _resolve(system, SystemConfig, get_system_config)
+            self.strategy.sanity_check()
+            self.model_config.sanity_check()
+            self._cross_sanity_check()
         return self
 
     def _cross_sanity_check(self):
@@ -313,7 +321,8 @@ class PerfLLM(PerfBase):
                      debug: bool = False):
         assert self.strategy is not None, "call configure() first"
         self.system.reset_status()
-        self.build()
+        with self.diagnostics.capture(category="placement"):
+            self.build()
         env_graph = os.environ.get("ENABLE_SIMU_GRAPH", "").lower()
         if capture_graph or env_graph in ("1", "true", "yes", "on"):
             from simumax_tpu.core.graph import GraphBuilder
@@ -323,7 +332,11 @@ class PerfLLM(PerfBase):
         env_debug = os.environ.get("SIMU_DEBUG", "").lower()
         if debug or env_debug in ("1", "true", "yes", "on"):
             self.ctx.debug.enabled = True
-        self._run()
+        with self.diagnostics.capture(category="estimate"):
+            self._run()
+        # merge (not snapshot) so a sweep's run-level collector
+        # accumulates table coverage across every candidate it estimates
+        self.diagnostics.record_efficiency(self.system)
         self._mem_result = None
         self._cost_result = None
         self._interleaved_result = None
@@ -937,10 +950,13 @@ class PerfLLM(PerfBase):
             "net_info": {k: p.describe() for k, p in self.ctx.paths.items()},
             "efficiency_misses": self.system.miss_efficiency,
         }
+        self.diagnostics.record_efficiency(self.system)
+        result["diagnostics"] = self.diagnostics.to_dict()
         if verbose:
             self._print_summary(result)
         if save_path:
             os.makedirs(save_path, exist_ok=True)
+            self.diagnostics.write(os.path.join(save_path, "diagnostics.json"))
             for key in ("base_info", "mem_result", "compute_result", "net_info"):
                 with open(os.path.join(save_path, f"{key}.json"), "w") as f:
                     json.dump(result[key], f, indent=2, default=str)

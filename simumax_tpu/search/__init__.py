@@ -1,5 +1,6 @@
 from simumax_tpu.search.searcher import (  # noqa: F401
     StrategySearcher,
+    SweepJournal,
     evaluate_strategy,
     search_best_parallel_strategy,
     search_best_selective_recompute,

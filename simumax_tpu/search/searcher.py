@@ -14,9 +14,14 @@ dims that spilled over the slice onto DCN).
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import csv
 import itertools
+import json
+import os
+import signal
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -27,6 +32,8 @@ from simumax_tpu.core.config import (
     StrategyConfig,
     SystemConfig,
 )
+from simumax_tpu.core.errors import CandidateTimeoutError, FeasibilityError
+from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.perf import PerfLLM
 
 #: result-cache key: the strategy fields that affect estimates
@@ -51,6 +58,15 @@ _KEY_FIELDS = (
 )
 
 
+#: _KEY_FIELDS the parallel-strategy sweep overrides per cell — the
+#: complement (base fields) is the journal's run identity
+_SWEPT_FIELDS = frozenset({
+    "tp_size", "cp_size", "ep_size", "pp_size", "etp_size", "zero_state",
+    "micro_batch_size", "micro_batch_num", "enable_recompute",
+    "recompute_granularity", "recompute_layer_num", "sdp_recompute",
+})
+
+
 def _strategy_key(st: StrategyConfig, model, system, gib_margin) -> tuple:
     # model/system identity + margin are part of the verdict, not just
     # the strategy fields; use stable content-ish keys, not id() (which
@@ -63,6 +79,112 @@ def _strategy_key(st: StrategyConfig, model, system, gib_margin) -> tuple:
         model_key, system_key, gib_margin,
         tuple(getattr(st, f) for f in _KEY_FIELDS),
     )
+
+
+@contextlib.contextmanager
+def _candidate_deadline(seconds: Optional[float], candidate: str):
+    """Interrupt a candidate evaluation that runs past ``seconds`` with a
+    :class:`CandidateTimeoutError` (SIGALRM-based; best-effort no-op off
+    the main thread or on platforms without ``setitimer``)."""
+    usable = (
+        seconds is not None
+        and seconds > 0
+        and hasattr(signal, "setitimer")
+        and threading.current_thread() is threading.main_thread()
+    )
+    if not usable:
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise CandidateTimeoutError(
+            f"candidate {candidate} exceeded the {seconds:g}s "
+            f"per-candidate timeout",
+            candidate=candidate, timeout_s=seconds, phase="search",
+        )
+
+    prev = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+class SweepJournal:
+    """Incremental JSONL checkpoint of evaluated sweep cells.
+
+    One line per evaluated candidate cell: ``{"key": ..., "status":
+    "ok" | "empty" | "error", "row": {...} | null, "error": {...} |
+    null}``. Appended (and flushed) as soon as each cell finishes, so a
+    killed sweep loses at most the in-flight candidate;
+    ``--resume <journal>`` replays the journal instead of re-evaluating
+    the memoized prefix.
+
+    A fresh journal starts with a ``{"header": {...}}`` line stamping
+    the run identity (model / system fingerprint / gbs / world) —
+    resuming against a journal recorded for a *different* run is
+    refused instead of silently replaying wrong rows."""
+
+    def __init__(self, path: str, header: Optional[dict] = None):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, "a", encoding="utf-8")
+        if fresh and header is not None:
+            self._f.write(json.dumps({"header": header}) + "\n")
+            self._f.flush()
+
+    def append(self, key: str, status: str, row: Optional[dict] = None,
+               error: Optional[dict] = None):
+        entry = {"key": key, "status": status, "row": row, "error": error}
+        self._f.write(json.dumps(entry, default=str) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    @staticmethod
+    def load(path: str) -> Dict[str, dict]:
+        """Parse a journal into {cell_key: last entry}. Tolerates a torn
+        final line (the sweep was killed mid-write)."""
+        done: Dict[str, dict] = {}
+        if not os.path.exists(path):
+            return done
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a killed sweep
+                if isinstance(entry, dict) and "key" in entry:
+                    done[entry["key"]] = entry
+        return done
+
+    @staticmethod
+    def read_header(path: str) -> Optional[dict]:
+        """The run-identity header of a journal, if it has one (older
+        journals and hand-built fixtures may not)."""
+        if not os.path.exists(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    return None
+                if isinstance(entry, dict) and "header" in entry:
+                    return entry["header"]
+                return None  # first line is a cell entry: headerless
+        return None
 
 
 def evaluate_strategy(
@@ -142,8 +264,9 @@ def evaluate_strategy(
     except ConfigError:
         # genuinely infeasible candidate (divisibility / capability):
         # rejected silently. Internal invariant failures (AssertionError
-        # from conservation/schedule checks) propagate so sweeps surface
-        # bugs instead of masking them.
+        # from conservation/schedule checks, SimulationError) propagate —
+        # the sweep loop quarantines them per-candidate so one bad cell
+        # cannot kill the run, but they stay visible in the report.
         row = None
     if cache is not None:
         cache[key] = row
@@ -185,7 +308,12 @@ def search_micro_batch_config(
     """Fixed-GBS (mbs, mbc) search with a GiB safety margin
     (reference ``perf_llm.py:3111-3167``, ``gmi_error``)."""
     dp = strategy.dp_size
-    assert global_batch_size % dp == 0, (global_batch_size, dp)
+    if dp < 1 or global_batch_size % dp:
+        raise FeasibilityError(
+            f"global_batch_size {global_batch_size} does not divide over "
+            f"dp {dp}",
+            phase="search", global_batch_size=global_batch_size, dp=dp,
+        )
     per_dp = global_batch_size // dp
     best = None
     for mbs in range(1, per_dp + 1):
@@ -267,6 +395,45 @@ def search_best_recompute_layer_num(
     return best
 
 
+def _evaluate_sweep_cell(
+    st, rc, model, system, global_batch_size, cache, project_dualpp
+) -> Optional[dict]:
+    """Evaluate one (layout, recompute-family) sweep cell: search the
+    batch split, then the recompute family; at most one result row."""
+    st_rc = copy.deepcopy(st)
+    if rc == "none":
+        st_rc.enable_recompute = False
+        return search_micro_batch_config(
+            st_rc, model, system, global_batch_size,
+            cache=cache, project_dualpp=project_dualpp,
+        )
+    if rc == "selective":
+        # pick the batch split under selective-recompute memory,
+        # not whatever recompute the base strategy carried
+        st_rc.enable_recompute = True
+        st_rc.recompute_granularity = "selective"
+        st_rc.recompute_layer_num = -1
+        st_rc.sdp_recompute = True
+        base_batch = search_micro_batch_config(
+            st_rc, model, system, global_batch_size, cache=cache
+        )
+        bs = base_batch or {"mbs": 1, "mbc": global_batch_size // st.dp_size}
+        st_rc.micro_batch_size = bs["mbs"]
+        st_rc.micro_batch_num = bs["mbc"]
+        return search_best_selective_recompute(
+            st_rc, model, system, cache=cache,
+            project_dualpp=project_dualpp,
+        )
+    if rc == "full_block":
+        st_rc.micro_batch_size = 1
+        st_rc.micro_batch_num = global_batch_size // st.dp_size
+        return search_best_recompute_layer_num(
+            st_rc, model, system, cache=cache,
+            project_dualpp=project_dualpp,
+        )
+    raise ConfigError(f"unknown recompute family {rc!r}", phase="search")
+
+
 def search_best_parallel_strategy(
     base_strategy: StrategyConfig,
     model: ModelConfig,
@@ -283,81 +450,170 @@ def search_best_parallel_strategy(
     verbose: bool = False,
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
+    candidate_timeout: Optional[float] = None,
+    journal_path: Optional[str] = None,
+    resume: Optional[str] = None,
+    diagnostics: Optional[Diagnostics] = None,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): for each
     layout, search the batch split, then each recompute family; rank by
-    MFU."""
+    MFU.
+
+    Fault isolation: each (layout, recompute) cell is evaluated under an
+    optional ``candidate_timeout`` (seconds), and any exception —
+    invariant failure, timeout, crash — quarantines just that cell: it
+    lands in the CSV as a ``status=error`` row carrying the exception
+    class and in ``diagnostics``, while the sweep continues.
+    ``journal_path`` checkpoints every finished cell to a JSONL journal;
+    ``resume`` replays a journal so an interrupted sweep continues
+    without re-evaluating the journaled prefix (pass the same path as
+    both to extend one journal across runs). A journal stamped for a
+    different run identity (model / system / gbs / world) is refused."""
     cache = {} if cache is None else cache
+    diagnostics = diagnostics if diagnostics is not None else Diagnostics()
+    # run identity for the journal: everything a cell row depends on
+    # besides the swept dims themselves — model, hardware fingerprint,
+    # batch size, and every estimate-relevant base-strategy field the
+    # sweep does NOT override (seq_len, dtype, world_size, ...).
+    # json round-trip so the comparison against a loaded header is
+    # apples-to-apples (tuples become lists, etc.)
+    identity = json.loads(json.dumps({
+        "model": model.model_name,
+        "system": system.sys_name,
+        "system_hash": system.fingerprint(),
+        "gbs": global_batch_size,
+        "base_strategy": {
+            f: getattr(base_strategy, f)
+            for f in _KEY_FIELDS if f not in _SWEPT_FIELDS
+        },
+    }, default=str, sort_keys=True))
+    resumed: Dict[str, dict] = {}
+    if resume:
+        if not os.path.exists(resume):
+            raise ConfigError(
+                f"--resume journal {resume} does not exist — check the "
+                f"path (a fresh sweep wants --journal, not --resume)",
+                phase="search", journal=resume,
+            )
+        stamped = SweepJournal.read_header(resume)
+        if stamped is not None and stamped != identity:
+            diff = sorted(
+                k for k in set(stamped) | set(identity)
+                if stamped.get(k) != identity.get(k)
+            )
+            raise ConfigError(
+                f"journal {resume} was recorded for a different run "
+                f"(mismatched: {', '.join(diff)}); refusing to replay "
+                f"its rows — start a fresh journal",
+                phase="search", journal=resume,
+                journal_identity=stamped, run_identity=identity,
+            )
+        resumed = SweepJournal.load(resume)
+    journal = SweepJournal(journal_path, header=identity) \
+        if journal_path else None
+    # --journal pointing at a different file than --resume starts a new
+    # checkpoint: carry replayed cells over so it is complete on its own
+    rejournal = (
+        journal is not None and resume is not None
+        and os.path.abspath(journal_path) != os.path.abspath(resume)
+    )
     rows: List[dict] = []
+    quarantine: List[dict] = []
     world = base_strategy.world_size
-    for tp, cp, ep, pp, zero in itertools.product(
-        tp_list, cp_list, ep_list, pp_list, zero_list
-    ):
-        if world % (tp * cp * pp) or world % (ep * pp):
-            continue
-        if model.model_type != "moe" and ep > 1:
-            continue
-        st = copy.deepcopy(base_strategy)
-        st.tp_size, st.cp_size = tp, cp
-        st.ep_size, st.pp_size = ep, pp
-        st.zero_state = zero
-        # ZeRO has no effect without data-parallel replicas; keep one
-        # representative level to avoid duplicate candidates
-        if zero > min(zero_list) and st.dp_size * st.cp_size == 1:
-            continue
-        st.etp_size = min(st.etp_size, tp) or 1
-        if st.dp_size < 1 or global_batch_size % st.dp_size:
-            continue
-        for rc in recompute_types:
-            candidates: List[Optional[dict]] = []
-            st_rc = copy.deepcopy(st)
-            if rc == "none":
-                st_rc.enable_recompute = False
-                candidates.append(
-                    search_micro_batch_config(
-                        st_rc, model, system, global_batch_size,
-                        cache=cache, project_dualpp=project_dualpp,
-                    )
-                )
-            elif rc == "selective":
-                # pick the batch split under selective-recompute memory,
-                # not whatever recompute the base strategy carried
-                st_rc.enable_recompute = True
-                st_rc.recompute_granularity = "selective"
-                st_rc.recompute_layer_num = -1
-                st_rc.sdp_recompute = True
-                base_batch = search_micro_batch_config(
-                    st_rc, model, system, global_batch_size, cache=cache
-                )
-                bs = base_batch or {"mbs": 1, "mbc": global_batch_size // st.dp_size}
-                st_rc.micro_batch_size = bs["mbs"]
-                st_rc.micro_batch_num = bs["mbc"]
-                candidates.append(
-                    search_best_selective_recompute(
-                        st_rc, model, system, cache=cache,
-                        project_dualpp=project_dualpp,
-                    )
-                )
-            elif rc == "full_block":
-                st_rc.micro_batch_size = 1
-                st_rc.micro_batch_num = global_batch_size // st.dp_size
-                candidates.append(
-                    search_best_recompute_layer_num(
-                        st_rc, model, system, cache=cache,
-                        project_dualpp=project_dualpp,
-                    )
-                )
-            for row in candidates:
-                if row is not None and row["fits"]:
-                    rows.append(row)
-                    if verbose:
-                        print(
-                            f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
-                            f"pp{row['pp']} {row['recompute']}: "
-                            f"mfu {row['mfu']*100:.2f}% "
-                            f"peak {row['peak_gib']:.1f} GiB"
+    # every PerfLLM built under a candidate reports into this run's
+    # collector (Diagnostics.active()) instead of a throwaway one
+    try:
+        with diagnostics.activate():
+            for tp, cp, ep, pp, zero in itertools.product(
+                tp_list, cp_list, ep_list, pp_list, zero_list
+            ):
+                if world % (tp * cp * pp) or world % (ep * pp):
+                    continue
+                if model.model_type != "moe" and ep > 1:
+                    continue
+                st = copy.deepcopy(base_strategy)
+                st.tp_size, st.cp_size = tp, cp
+                st.ep_size, st.pp_size = ep, pp
+                st.zero_state = zero
+                # ZeRO has no effect without data-parallel replicas; keep one
+                # representative level to avoid duplicate candidates
+                if zero > min(zero_list) and st.dp_size * st.cp_size == 1:
+                    continue
+                st.etp_size = min(st.etp_size, tp) or 1
+                if st.dp_size < 1 or global_batch_size % st.dp_size:
+                    continue
+                for rc in recompute_types:
+                    cell_key = f"tp{tp}_cp{cp}_ep{ep}_pp{pp}_z{zero}_{rc}"
+                    prior = resumed.get(cell_key)
+                    if prior is not None \
+                            and prior.get("status") not in ("ok", "empty",
+                                                            "error"):
+                        # hand-built or torn entry with no recognizable
+                        # status: re-evaluate rather than guess
+                        prior = None
+                    if prior is not None:
+                        # journaled in a previous run: replay, don't re-evaluate
+                        status = prior["status"]
+                        if (status == "ok" and prior.get("row")
+                                and prior["row"].get("fits")):
+                            rows.append(prior["row"])
+                        elif status == "error":
+                            err = prior.get("error") or {}
+                            quarantine.append(_quarantine_row(st, rc, err))
+                            # the resumed run's report must count this
+                            # failure just like the run that journaled it
+                            diagnostics.error(
+                                "quarantine",
+                                err.get("error_msg") or "journaled failure",
+                                candidate=cell_key, phase="search",
+                                exception=err.get("error_type", ""),
+                                replayed=True,
+                            )
+                        if rejournal:
+                            journal.append(cell_key, status,
+                                           row=prior.get("row"),
+                                           error=prior.get("error"))
+                        continue
+                    try:
+                        with _candidate_deadline(candidate_timeout, cell_key):
+                            row = _evaluate_sweep_cell(
+                                st, rc, model, system, global_batch_size,
+                                cache, project_dualpp,
+                            )
+                    except Exception as exc:  # quarantine, keep sweeping
+                        err = {
+                            "error_type": type(exc).__name__,
+                            "error_msg": str(exc)[:500],
+                        }
+                        quarantine.append(_quarantine_row(st, rc, err))
+                        diagnostics.record_exception(
+                            exc, category="quarantine",
+                            candidate=cell_key, phase="search",
                         )
+                        if journal:
+                            journal.append(cell_key, "error", error=err)
+                        continue
+                    if row is not None:
+                        row.setdefault("status", "ok")
+                    if journal:
+                        journal.append(
+                            cell_key,
+                            "ok" if row is not None else "empty",
+                            row=row,
+                        )
+                    if row is not None and row["fits"]:
+                        rows.append(row)
+                        if verbose:
+                            print(
+                                f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
+                                f"pp{row['pp']} {row['recompute']}: "
+                                f"mfu {row['mfu']*100:.2f}% "
+                                f"peak {row['peak_gib']:.1f} GiB"
+                            )
+    finally:
+        if journal:
+            journal.close()
     # dedup: the recompute-layer search bottoming out at 0 layers is the
     # same candidate as the no-recompute row
     seen = set()
@@ -373,12 +629,33 @@ def search_best_parallel_strategy(
     rows = uniq
     rows.sort(key=lambda r: r["mfu"], reverse=True)
     if csv_path:
-        fields = [k for k in rows[0] if k != "net"] if rows else []
+        csv_rows = rows + quarantine
+        fields: List[str] = []
+        for r in csv_rows:
+            for k in r:
+                if k != "net" and k not in fields:
+                    fields.append(k)
         with open(csv_path, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
             w.writeheader()
-            w.writerows(rows)
+            w.writerows(csv_rows)
     return rows[:topk]
+
+
+def _quarantine_row(st, rc: str, err: dict) -> dict:
+    """A CSV-compatible ``status=error`` row for a failed sweep cell."""
+    return {
+        "tp": st.tp_size, "cp": st.cp_size, "pp": st.pp_size,
+        "dp": st.dp_size, "ep": st.ep_size, "etp": st.etp_size,
+        "vp": st.vp_size, "mbs": st.micro_batch_size,
+        "mbc": st.micro_batch_num, "zero": st.zero_state,
+        "recompute": rc, "recompute_layers": 0,
+        "mfu": 0.0, "iter_ms": 0.0, "tgs": 0.0, "peak_gib": 0.0,
+        "fits": False, "dcn_dims": "",
+        "status": "error",
+        "error_type": err.get("error_type", ""),
+        "error_msg": err.get("error_msg", ""),
+    }
 
 
 @dataclass

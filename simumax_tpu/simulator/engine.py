@@ -50,6 +50,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generator, List, Optional, Tuple
 
+from simumax_tpu.core.errors import SimulationError
+
 
 @dataclass
 class TraceEvent:
@@ -77,8 +79,10 @@ class _Rendezvous:
         return max(self.arrivals.values()) + self.duration
 
 
-class DeadlockError(RuntimeError):
-    pass
+class DeadlockError(SimulationError):
+    """No rank can make progress and no blocked request published new
+    state — the schedule itself is wedged. Carries the full per-rank
+    state dump in the message and structured context for diagnostics."""
 
 
 class SimuEngine:
@@ -190,9 +194,10 @@ class SimuEngine:
             if rank not in rv.arrivals:
                 rv.arrivals[rank] = self.clock[rank]
                 if rv.duration != duration:
-                    raise RuntimeError(
+                    raise SimulationError(
                         f"collective {key}#{seq}: mismatched durations "
-                        f"{rv.duration} vs {duration} from rank {rank}"
+                        f"{rv.duration} vs {duration} from rank {rank}",
+                        phase="simulate", rank=rank, collective=str(key),
                     )
             if not rv.complete:
                 return False  # stay blocked
@@ -217,9 +222,10 @@ class SimuEngine:
                     peers=pset, duration=duration
                 )
             if rv.duration != duration:
-                raise RuntimeError(
+                raise SimulationError(
                     f"async collective {stream}#{seq}: mismatched durations "
-                    f"{rv.duration} vs {duration} from rank {rank}"
+                    f"{rv.duration} vs {duration} from rank {rank}",
+                    phase="simulate", rank=rank, stream=str(stream),
                 )
             rv.arrivals[rank] = self.clock[rank]
             self._async_pending[rank].add(ckey)
@@ -241,7 +247,10 @@ class SimuEngine:
             self._send_seq[(rank, dst, tag)] = seq + 1
             skey = (rank, dst, tag, seq)
             if skey in self._sends:
-                raise RuntimeError(f"duplicate send {skey}")
+                raise SimulationError(
+                    f"duplicate send {skey}",
+                    phase="simulate", rank=rank, send=str(skey),
+                )
             post = self.clock[rank]
             self._sends[skey] = (post, duration)
             fid = self._next_flow
@@ -383,7 +392,9 @@ class SimuEngine:
             self.clock[rank] = end
             self._advance_rank(rank, end)
             return True
-        raise RuntimeError(f"unknown request {req!r}")
+        raise SimulationError(
+            f"unknown request {req!r}", phase="simulate", rank=rank
+        )
 
     def _finish_async(self, ckey: tuple, rv: _Rendezvous, name: str):
         """All peers posted: schedule the op on its comm stream (starts

@@ -1,0 +1,191 @@
+"""Calibration hardening tests: bounded retry, MAD outlier rejection,
+the (0, 1.05] efficiency guard, per-key quarantine, and table
+provenance. No live accelerator needed — the benchmarks are faked."""
+
+import warnings
+from types import SimpleNamespace
+
+import pytest
+
+import simumax_tpu.calibration.autocal as autocal
+from simumax_tpu.calibration.autocal import (
+    EFF_MAX,
+    calibrate_for_perf,
+    validate_efficiency,
+    with_retries,
+)
+from simumax_tpu.calibration.timing import reject_outliers, robust_median
+from simumax_tpu.core.config import get_system_config
+from simumax_tpu.core.errors import CalibrationError
+from simumax_tpu.core.records import Diagnostics
+
+
+class TestWithRetries:
+    def test_transient_failure_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("tunnel dropped")
+            return 0.7
+
+        assert with_retries(flaky, attempts=3, backoff=0.0) == 0.7
+        assert len(calls) == 3
+
+    def test_exhausted_retries_wrap_in_calibration_error(self):
+        def always():
+            raise ValueError("device OOM")
+
+        with pytest.raises(CalibrationError) as ei:
+            with_retries(always, attempts=2, backoff=0.0, label="gemm[x]")
+        assert "gemm[x]" in str(ei.value)
+        assert ei.value.context["attempts"] == 2
+        assert "device OOM" in ei.value.context["last_error"]
+
+    def test_calibration_error_is_not_retried(self):
+        calls = []
+
+        def classified():
+            calls.append(1)
+            raise CalibrationError("all samples NaN")
+
+        with pytest.raises(CalibrationError):
+            with_retries(classified, attempts=3, backoff=0.0)
+        assert len(calls) == 1  # already classified: no pointless retries
+
+
+class TestOutlierRejection:
+    def test_mad_drops_scheduler_stall(self):
+        # nine tight samples + one 50x stall: the median must not move
+        samples = [1.0, 1.01, 0.99, 1.02, 0.98, 1.0, 1.01, 0.99, 1.0, 50.0]
+        kept = reject_outliers(samples)
+        assert 50.0 not in kept and len(kept) == 9
+        assert robust_median(samples) == pytest.approx(1.0, abs=0.02)
+
+    def test_nan_and_inf_samples_dropped(self):
+        assert robust_median([float("nan"), 2.0, float("inf"), 2.0]) == 2.0
+
+    def test_all_nonfinite_raises(self):
+        with pytest.raises(CalibrationError, match="no finite"):
+            robust_median([float("nan"), float("inf")])
+
+    def test_identical_samples_kept_verbatim(self):
+        assert reject_outliers([3.0, 3.0, 3.0]) == [3.0, 3.0, 3.0]  # MAD=0
+
+
+class TestEfficiencyGuard:
+    @pytest.mark.parametrize("eff", [0.01, 0.5, 1.0, EFF_MAX])
+    def test_plausible_values_pass(self, eff):
+        assert validate_efficiency(eff, "matmul", "k") == pytest.approx(eff)
+
+    @pytest.mark.parametrize(
+        "eff", [0.0, -0.3, EFF_MAX + 0.01, 2.0,
+                float("nan"), float("inf")]
+    )
+    def test_implausible_values_refused(self, eff):
+        with pytest.raises(CalibrationError):
+            validate_efficiency(eff, "matmul", "m=1,k=2,n=3")
+
+    def test_error_carries_table_coordinates(self):
+        with pytest.raises(CalibrationError) as ei:
+            validate_efficiency(2.0, "sdp_fwd", "b=1")
+        assert ei.value.context["op_key"] == "sdp_fwd"
+        assert ei.value.context["shape_key"] == "b=1"
+
+
+class TestCalibrateForPerfQuarantine:
+    def _fake_perf(self, misses):
+        system = get_system_config("tpu_v5e_256")
+        system.reset_status()
+        system.miss_efficiency = {"matmul": dict.fromkeys(misses, 0.5)}
+        strategy = SimpleNamespace(
+            attention_sparse_ratio=0.5, optimizer_style="fused"
+        )
+        return SimpleNamespace(system=system, strategy=strategy)
+
+    def test_failed_key_is_skipped_not_fatal(self, monkeypatch):
+        perf = self._fake_perf(["good_key", "bad_key"])
+
+        def fake_calibrate_key(op_key, shape_key, system, sparse,
+                               attempts=3):
+            if shape_key == "bad_key":
+                raise CalibrationError(
+                    "benchmark failed after retries",
+                    op_key=op_key, shape_key=shape_key,
+                )
+            return 0.85
+
+        monkeypatch.setattr(autocal, "calibrate_key", fake_calibrate_key)
+        diag = Diagnostics()
+        measured = calibrate_for_perf(perf, diagnostics=diag)
+        assert measured == {"matmul": {"good_key": 0.85}}
+        spec = perf.system.accelerator.op["matmul"]
+        assert spec.accurate_efficient_factor["good_key"] == 0.85
+        assert "bad_key" not in spec.accurate_efficient_factor
+        assert len(diag.errors) == 1
+        assert diag.errors[0].context["shape_key"] == "bad_key"
+
+    def test_implausible_measurement_never_written_back(self, monkeypatch):
+        perf = self._fake_perf(["hot_key"])
+        monkeypatch.setattr(
+            autocal, "calibrate_key", lambda *a, **k: 1.8  # bogus > 1.05
+        )
+        diag = Diagnostics()
+        measured = calibrate_for_perf(perf, diagnostics=diag)
+        assert measured == {}
+        spec = perf.system.accelerator.op["matmul"]
+        assert "hot_key" not in spec.accurate_efficient_factor
+        assert len(diag.errors) == 1
+
+
+class TestProvenance:
+    def test_stamp_matches_fingerprint(self):
+        sysc = get_system_config("tpu_v5e_256")
+        stamp = sysc.stamp_provenance()
+        assert stamp["system_hash"] == sysc.fingerprint()
+        assert set(stamp) == {"system_hash", "created", "version"}
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            sysc._check_provenance()  # fresh + matching: silent
+
+    def test_fingerprint_excludes_calibrated_tables(self):
+        a = get_system_config("tpu_v5e_256")
+        b = get_system_config("tpu_v5e_256")
+        b.accelerator.op["matmul"].accurate_efficient_factor["k"] = 0.9
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_fingerprint_survives_calibration_added_bandwidth_class(self):
+        # calibration synthesizes a 'fused_adam' bandwidth class (same
+        # physical HBM as 'default'); a calibrated config must keep the
+        # pristine config's fingerprint or its stamp reads as stale
+        from simumax_tpu.core.config import BandwidthSpec
+
+        a = get_system_config("tpu_v5e_256")
+        b = get_system_config("tpu_v5e_256")
+        base = b.accelerator.bandwidth["default"]
+        b.accelerator.bandwidth["fused_adam"] = BandwidthSpec(
+            gbps=base.gbps, efficient_factor=0.42,
+            latency_us=base.latency_us,
+        )
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_fingerprint_tracks_hardware_identity(self):
+        a = get_system_config("tpu_v5e_256")
+        b = get_system_config("tpu_v5e_256")
+        b.accelerator.mem_gbs *= 2
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_mismatched_hash_warns_stale(self):
+        sysc = get_system_config("tpu_v5e_256")
+        sysc.provenance = {"system_hash": "deadbeefdeadbeef"}
+        with pytest.warns(UserWarning, match="stale"):
+            sysc._check_provenance()
+
+    def test_old_stamp_warns(self):
+        sysc = get_system_config("tpu_v5e_256")
+        sysc.provenance = {
+            "system_hash": sysc.fingerprint(), "created": "2020-01-01",
+        }
+        with pytest.warns(UserWarning, match="days old"):
+            sysc._check_provenance()

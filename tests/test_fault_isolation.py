@@ -1,0 +1,416 @@
+"""Fault-isolated sweep tests (L7 resilience layer).
+
+A sweep containing injected crashing / infeasible / hanging candidates
+must complete, quarantine the bad cells as ``status=error`` CSV rows +
+journal entries, and a ``--resume`` run must re-evaluate zero
+already-journaled cells. See docs/diagnostics.md.
+"""
+
+import csv
+import json
+import time
+
+import pytest
+
+import simumax_tpu.search.searcher as searcher_mod
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.core.errors import (
+    CandidateTimeoutError,
+    ConfigError,
+    FeasibilityError,
+    UnknownConfigError,
+)
+from simumax_tpu.core.records import Diagnostics
+from simumax_tpu.search import SweepJournal, search_best_parallel_strategy
+
+
+def setup():
+    m = get_model_config("llama2-tiny")
+    sysc = get_system_config("tpu_v5e_256")
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = 8
+    return m, sysc, st
+
+
+def _sweep(m, sysc, st, gbs=8, **kw):
+    """Small 3-cell grid: tp in {1, 2, 4}, one recompute family."""
+    kw.setdefault("tp_list", (1, 2, 4))
+    kw.setdefault("pp_list", (1,))
+    kw.setdefault("recompute_types", ("none",))
+    return search_best_parallel_strategy(st, m, sysc, gbs, **kw)
+
+
+def _inject(monkeypatch, failures):
+    """Replace ``_evaluate_sweep_cell`` with a wrapper that injects the
+    failure keyed by (tp_size, recompute family) and delegates the rest.
+    Returns the call log so tests can assert what was (re-)evaluated."""
+    real = searcher_mod._evaluate_sweep_cell
+    calls = []
+
+    def fake(st, rc, model, system, gbs, cache, project_dualpp):
+        calls.append((st.tp_size, rc))
+        action = failures.get((st.tp_size, rc))
+        if action == "feasibility":
+            raise FeasibilityError("injected: does not fit", phase="search")
+        if action == "runtime":
+            raise RuntimeError("injected crash")
+        if action == "hang":
+            time.sleep(30)
+        return real(st, rc, model, system, gbs, cache, project_dualpp)
+
+    monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
+    return calls
+
+
+class TestQuarantine:
+    def test_crashing_candidates_do_not_kill_the_sweep(
+        self, monkeypatch, tmp_path
+    ):
+        m, sysc, st = setup()
+        _inject(monkeypatch, {
+            (2, "none"): "feasibility",
+            (4, "none"): "runtime",
+        })
+        csv_path = tmp_path / "sweep.csv"
+        diag = Diagnostics()
+        rows = _sweep(m, sysc, st, csv_path=str(csv_path), diagnostics=diag)
+        # the healthy tp=1 cell still produced a ranked row
+        assert rows and all(r["status"] == "ok" for r in rows)
+        # both failures were quarantined, with the exception class visible
+        assert len(diag.quarantined) == 2
+        with open(csv_path) as f:
+            by_status = {}
+            for r in csv.DictReader(f):
+                by_status.setdefault(r["status"], []).append(r)
+        assert len(by_status["error"]) == 2
+        kinds = {r["error_type"] for r in by_status["error"]}
+        assert kinds == {"FeasibilityError", "RuntimeError"}
+        assert any("injected" in r["error_msg"] for r in by_status["error"])
+
+    def test_candidate_timeout_quarantines_hung_cell(
+        self, monkeypatch, tmp_path
+    ):
+        m, sysc, st = setup()
+        _inject(monkeypatch, {(2, "none"): "hang"})
+        diag = Diagnostics()
+        t0 = time.monotonic()
+        rows = _sweep(
+            m, sysc, st, tp_list=(1, 2), candidate_timeout=0.5,
+            diagnostics=diag,
+        )
+        assert time.monotonic() - t0 < 20  # did not wait out the 30s hang
+        assert rows  # tp=1 survived
+        assert len(diag.quarantined) == 1
+        assert diag.quarantined[0].context["exception"] == (
+            "CandidateTimeoutError"
+        )
+
+
+class TestJournalResume:
+    def test_journal_records_every_cell(self, tmp_path):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, journal_path=str(journal))
+        entries = SweepJournal.load(str(journal))
+        assert len(entries) == 3  # one per (tp, recompute) cell
+        assert all(e["status"] in ("ok", "empty", "error")
+                   for e in entries.values())
+
+    def test_resume_skips_journaled_cells(self, monkeypatch, tmp_path):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        first = _sweep(m, sysc, st, journal_path=str(journal))
+        calls = _inject(monkeypatch, {})
+        resumed = _sweep(
+            m, sysc, st, journal_path=str(journal), resume=str(journal),
+        )
+        assert calls == []  # zero re-evaluations
+        assert [(r["tp"], r["mfu"]) for r in resumed] == [
+            (r["tp"], r["mfu"]) for r in first
+        ]
+
+    def test_resume_replays_quarantined_cells(self, monkeypatch, tmp_path):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        calls = _inject(monkeypatch, {(4, "none"): "runtime"})
+        _sweep(m, sysc, st, journal_path=str(journal))
+        n_first = len(calls)
+        csv_path = tmp_path / "resumed.csv"
+        diag = Diagnostics()
+        _sweep(
+            m, sysc, st, resume=str(journal), csv_path=str(csv_path),
+            diagnostics=diag,
+        )
+        assert len(calls) == n_first  # error cells replayed, not re-run
+        with open(csv_path) as f:
+            errors = [r for r in csv.DictReader(f) if r["status"] == "error"]
+        assert len(errors) == 1 and errors[0]["error_type"] == "RuntimeError"
+        # the resumed run's report counts the journaled failure too —
+        # strict mode cannot be defeated by resuming
+        assert len(diag.quarantined) == 1
+        assert diag.quarantined[0].context["replayed"] is True
+
+    def test_resume_refuses_foreign_journal(self, tmp_path):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, journal_path=str(journal))
+        with pytest.raises(ConfigError, match="different run"):
+            _sweep(m, sysc, st, resume=str(journal), gbs=16)
+
+    def test_headerless_journal_still_resumes(self, monkeypatch, tmp_path):
+        # pre-header journals (and hand-built fixtures) have no identity
+        # stamp: accepted as-is for backward compatibility
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, journal_path=str(journal))
+        lines = journal.read_text().splitlines()
+        journal.write_text("\n".join(
+            ln for ln in lines if "header" not in json.loads(ln)
+        ) + "\n")
+        calls = _inject(monkeypatch, {})
+        _sweep(m, sysc, st, resume=str(journal))
+        assert calls == []
+
+    def test_partial_journal_only_skips_its_prefix(
+        self, monkeypatch, tmp_path
+    ):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        # simulate an interrupted sweep: only the tp=1 cell finished
+        _sweep(m, sysc, st, tp_list=(1,), journal_path=str(journal))
+        calls = _inject(monkeypatch, {})
+        rows = _sweep(
+            m, sysc, st, journal_path=str(journal), resume=str(journal),
+        )
+        assert sorted(calls) == [(2, "none"), (4, "none")]
+        assert {r["tp"] for r in rows} >= {1}
+
+    def test_resume_into_new_journal_carries_replayed_cells(
+        self, monkeypatch, tmp_path
+    ):
+        # --journal pointing elsewhere than --resume starts a fresh
+        # checkpoint: replayed cells must be carried over so the new
+        # journal resumes on its own
+        m, sysc, st = setup()
+        old = tmp_path / "old.jsonl"
+        _sweep(m, sysc, st, journal_path=str(old))
+        new = tmp_path / "new.jsonl"
+        _sweep(m, sysc, st, resume=str(old), journal_path=str(new))
+        assert len(SweepJournal.load(str(new))) == 3
+        calls = _inject(monkeypatch, {})
+        _sweep(m, sysc, st, resume=str(new))
+        assert calls == []  # new journal is complete on its own
+
+    def test_unrecognized_journal_entry_is_reevaluated(
+        self, monkeypatch, tmp_path
+    ):
+        # a hand-built line with no recognizable status must not crash
+        # the sweep — the cell is re-evaluated instead
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, tp_list=(1,), journal_path=str(journal))
+        with open(journal, "a") as f:
+            f.write(json.dumps(
+                {"key": "tp2_cp1_ep1_pp1_z1_none", "row": {}}
+            ) + "\n")
+        calls = _inject(monkeypatch, {})
+        rows = _sweep(m, sysc, st, resume=str(journal))
+        assert rows
+        assert sorted(calls) == [(2, "none"), (4, "none")]
+
+    def test_torn_tail_line_is_tolerated(self, tmp_path):
+        journal = tmp_path / "sweep.jsonl"
+        good = {"key": "tp1_cp1_ep1_pp1_z1_none", "status": "empty",
+                "row": None, "error": None}
+        journal.write_text(json.dumps(good) + "\n" + '{"key": "tp2_cp')
+        entries = SweepJournal.load(str(journal))
+        assert list(entries) == ["tp1_cp1_ep1_pp1_z1_none"]
+
+
+class TestDiagnosticsCollector:
+    def test_report_schema_and_counts(self):
+        diag = Diagnostics()
+        diag.warn("config", "something odd", detail=1)
+        diag.error("quarantine", "candidate died", candidate="tp2")
+        d = diag.to_dict()
+        assert d["schema"] == "simumax-diagnostics-v1"
+        assert d["counts"] == {
+            "warnings": 1, "errors": 1, "quarantined": 1,
+        }
+        json.dumps(d)  # machine-readable end to end
+
+    def test_capture_funnels_warnings(self):
+        import warnings
+
+        diag = Diagnostics()
+        with diag.capture(category="estimate"):
+            warnings.warn("table looks stale")
+        assert len(diag.warnings) == 1
+        assert diag.warnings[0].category == "estimate"
+        assert "stale" in diag.warnings[0].message
+
+    def test_record_exception_merges_taxonomy_context(self):
+        diag = Diagnostics()
+        exc = FeasibilityError("won't fit", phase="search", candidate="x")
+        diag.record_exception(exc, category="quarantine")
+        evt = diag.quarantined[0]
+        assert evt.context["phase"] == "search"
+        assert evt.context["exception"] == "FeasibilityError"
+
+    def test_strict_violations(self):
+        diag = Diagnostics(strict=True)
+        assert diag.violations() == []
+        diag.warn("config", "x")
+        assert diag.violations() == ["1 warning(s)"]
+
+    def test_activate_routes_perf_into_run_collector(self):
+        from simumax_tpu import PerfLLM
+
+        diag = Diagnostics()
+        with diag.activate():
+            assert PerfLLM().diagnostics is diag
+        assert PerfLLM().diagnostics is not diag
+
+    def test_sweep_merges_efficiency_across_candidates(self):
+        m, sysc, st = setup()
+        diag = Diagnostics()
+        _sweep(m, sysc, st, tp_list=(1, 2), diagnostics=diag)
+        # coverage is the union over all candidates, not a snapshot of
+        # whichever candidate ran last (run_estimate resets per cell)
+        assert diag.hit_count + diag.miss_count > 0
+        per_candidate = len(sysc.hit_efficiency.get("matmul", {})) + len(
+            sysc.miss_efficiency.get("matmul", {})
+        )
+        merged = diag.efficiency.get("matmul", {})
+        assert merged.get("hits", 0) + merged.get("misses", 0) \
+            >= per_candidate
+
+    def test_identical_facts_collapse_with_count(self):
+        diag = Diagnostics()
+        for _ in range(5):
+            diag.warn("estimate", "same warning, thousands of candidates")
+        diag.warn("estimate", "different warning")
+        assert len(diag.warnings) == 2
+        assert diag.warnings[0].context["count"] == 5
+
+    def test_distinct_candidates_never_collapse(self):
+        diag = Diagnostics()
+        diag.error("quarantine", "crash", candidate="tp2")
+        diag.error("quarantine", "crash", candidate="tp4")
+        assert len(diag.quarantined) == 2
+
+    def test_capture_does_not_record_escaping_errors(self):
+        # an error escaping a capture block may still be handled
+        # upstream (sweeps reject infeasible candidates by design);
+        # recording is the job of whoever decides its fate
+        diag = Diagnostics()
+        with pytest.raises(FeasibilityError):
+            with diag.capture(category="simulate"):
+                raise FeasibilityError("won't fit", phase="simulate")
+        assert diag.errors == []
+
+    def test_infeasible_grid_points_are_not_run_errors(self):
+        # tp=16 exceeds llama2-tiny's head count: every such cell is
+        # rejected silently, and the report must stay clean so --strict
+        # remains usable for search
+        m, sysc, st = setup()
+        st.world_size = 16
+        diag = Diagnostics()
+        rows = _sweep(m, sysc, st, gbs=16, tp_list=(1, 16), diagnostics=diag)
+        assert rows
+        # efficiency misses still count (they are real coverage gaps);
+        # the rejected candidates must not
+        assert diag.errors == [] and diag.quarantined == []
+
+
+class TestErrorTaxonomy:
+    def test_hierarchy_and_backcompat(self):
+        # pre-taxonomy callers caught ValueError / KeyError / RuntimeError
+        assert issubclass(ConfigError, ValueError)
+        assert issubclass(FeasibilityError, ConfigError)
+        assert issubclass(UnknownConfigError, KeyError)
+        from simumax_tpu.core.errors import SimulationError
+        from simumax_tpu.simulator.engine import DeadlockError
+
+        assert issubclass(SimulationError, RuntimeError)
+        assert issubclass(DeadlockError, SimulationError)
+
+    def test_to_dict_and_context(self):
+        exc = FeasibilityError(
+            "no fit", model="m", strategy=("tp", 2), phase="search",
+        )
+        d = exc.to_dict()
+        assert d["error"] == "FeasibilityError"
+        assert d["context"]["strategy"] == ["tp", 2]  # JSON-safe
+        exc.with_context(candidate="tp2", phase="ignored-not-overwritten")
+        assert exc.context["candidate"] == "tp2"
+        assert exc.context["phase"] == "search"
+        json.dumps(exc.to_dict())
+
+    def test_unknown_config_lists_available(self):
+        with pytest.raises(UnknownConfigError) as ei:
+            get_model_config("no-such-model")
+        assert "llama2-tiny" in str(ei.value)
+        assert "no-such-model" in str(ei.value)
+
+
+class TestCLIErrorSurface:
+    def test_unknown_config_exits_2_with_one_liner(self, capsys):
+        from simumax_tpu.cli import EXIT_CONFIG, main
+
+        with pytest.raises(SystemExit) as ei:
+            main(["perf", "--model", "no-such-model",
+                  "--strategy", "tp1_pp2_dp4_mbs1",
+                  "--system", "tpu_v5e_256"])
+        assert ei.value.code == EXIT_CONFIG
+        err = capsys.readouterr().err
+        assert "unknown model" in err and "llama2-tiny" in err
+        assert "Traceback" not in err
+
+    def test_perf_emits_diagnostics_json(self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        report = tmp_path / "diag.json"
+        main(["perf", "--model", "llama2-tiny",
+              "--strategy", "tp1_pp2_dp4_mbs1", "--system", "tpu_v5e_256",
+              "--diagnostics", str(report)])
+        d = json.loads(report.read_text())
+        assert d["schema"] == "simumax-diagnostics-v1"
+        eff = d["efficiency"]
+        assert eff["hits"] + eff["misses"] > 0
+        assert 0.0 <= eff["coverage"] <= 1.0
+
+    def test_report_emitted_even_when_command_aborts(
+        self, tmp_path, capsys
+    ):
+        from simumax_tpu.cli import EXIT_CONFIG, main
+
+        report = tmp_path / "diag.json"
+        with pytest.raises(SystemExit) as ei:
+            main(["perf", "--model", "no-such-model",
+                  "--strategy", "tp1_pp2_dp4_mbs1",
+                  "--system", "tpu_v5e_256",
+                  "--diagnostics", str(report)])
+        assert ei.value.code == EXIT_CONFIG
+        # the aborted run still wrote its report, and it explains why
+        d = json.loads(report.read_text())
+        assert d["schema"] == "simumax-diagnostics-v1"
+        assert d["counts"]["errors"] >= 1
+        assert any(e["context"].get("exception") == "UnknownConfigError"
+                   for e in d["errors"])
+
+    def test_strict_promotes_misses_to_nonzero_exit(self, capsys):
+        from simumax_tpu.cli import EXIT_STRICT, main
+
+        # the uncalibrated v5e table misses on llama2-tiny's shapes,
+        # so strict mode must refuse the estimate
+        with pytest.raises(SystemExit) as ei:
+            main(["perf", "--model", "llama2-tiny",
+                  "--strategy", "tp1_pp2_dp4_mbs1",
+                  "--system", "tpu_v5e_256", "--strict"])
+        assert ei.value.code == EXIT_STRICT
+        assert "strict mode" in capsys.readouterr().err

@@ -1,0 +1,73 @@
+"""Lint-style guard for the resilience layer's discipline: no bare
+``except:`` and no silently-swallowing ``except Exception: pass`` in
+``simumax_tpu/``. Every handler must either name the exception kinds it
+understands (the ``core/errors.py`` taxonomy) or actually do something
+with what it caught — record it, re-raise it, substitute a value."""
+
+import ast
+import os
+
+import simumax_tpu
+
+PKG_ROOT = os.path.dirname(os.path.abspath(simumax_tpu.__file__))
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body swallows the exception without a
+    trace: only ``pass``, ``...``, or a bare docstring."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # `...` or a string literal
+        return False
+    return True
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """True for ``except:`` and ``except (Base)Exception``."""
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(
+        isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+        for n in names
+    )
+
+
+def _scan(path: str):
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield f"{path}:{node.lineno}: bare `except:`"
+        elif _is_broad(node) and _is_silent(node):
+            yield (f"{path}:{node.lineno}: "
+                   "`except Exception: pass` swallows failures silently")
+
+
+def test_no_bare_or_silent_broad_except():
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                offenders.extend(_scan(os.path.join(dirpath, fn)))
+    assert not offenders, (
+        "broad exception handlers must record or re-raise, not swallow "
+        "(see simumax_tpu/core/errors.py):\n" + "\n".join(offenders)
+    )
+
+
+def test_the_linter_itself_catches_offenders(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    x = 1\nexcept:\n    pass\n"
+        "try:\n    y = 2\nexcept Exception:\n    pass\n"
+        "try:\n    z = 3\nexcept Exception as e:\n    print(e)\n"
+    )
+    found = list(_scan(str(bad)))
+    assert len(found) == 2
