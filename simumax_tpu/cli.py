@@ -18,8 +18,10 @@ Resilience surface (see ``docs/diagnostics.md``): ``perf`` / ``search``
 / ``calibrate`` accept ``--diagnostics PATH`` (write the JSON report)
 and ``--strict`` (exit 3 on any warning / efficiency miss / quarantined
 failure); ``search`` additionally takes ``--journal`` / ``--resume``
-(JSONL sweep checkpointing) and ``--candidate-timeout``. Config-family
-errors exit 2 with a one-line message instead of a traceback.
+(JSONL sweep checkpointing), ``--candidate-timeout``, ``--jobs N``
+(process-pool cell evaluation, default ``os.cpu_count()``) and
+``--no-prune`` (see ``docs/search.md``). Config-family errors exit 2
+with a one-line message instead of a traceback.
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import sys
 
 #: exit codes: 2 = bad config / usage, 3 = --strict violation
@@ -150,6 +153,12 @@ def _run_search(args, diag):
     # --resume without an explicit --journal extends the same journal,
     # so repeated interrupted runs keep one continuous checkpoint
     journal_path = args.journal or args.resume
+    if args.jobs is not None and args.jobs < 1:
+        raise SystemExit(
+            f"invalid --jobs {args.jobs}: expected a positive worker "
+            f"count (1 = serial; omit for os.cpu_count())"
+        )
+    jobs = args.jobs if args.jobs else (os.cpu_count() or 1)
     with diag.capture(category="search"):
         rows = search_best_parallel_strategy(
             base, model, system, args.gbs,
@@ -162,6 +171,16 @@ def _run_search(args, diag):
             journal_path=journal_path,
             resume=args.resume,
             diagnostics=diag,
+            jobs=jobs,
+            prune=not args.no_prune,
+        )
+    counters = diag.counters
+    if counters.get("sweep_cells_pruned"):
+        print(
+            f"[sweep] pruned {int(counters['sweep_cells_pruned'])}/"
+            f"{int(counters['sweep_cells_total'])} cells before "
+            f"evaluation (status=pruned rows in the CSV; --no-prune to "
+            f"evaluate everything)"
         )
     for r in rows:
         dual = ""
@@ -358,6 +377,18 @@ def main(argv=None):
         "--candidate-timeout", type=float, default=None, metavar="SECONDS",
         help="per-candidate deadline; slower candidates are quarantined "
              "as status=error rows instead of stalling the sweep",
+    )
+    ps.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="evaluate sweep cells across N worker processes "
+             "(default: os.cpu_count(); 1 = serial)",
+    )
+    ps.add_argument(
+        "--no-prune", action="store_true",
+        help="disable the closed-form memory prune and the recording "
+             "of status=pruned CSV rows; structurally impossible "
+             "layouts (divisibility) are still skipped, silently, as "
+             "the sweep always has",
     )
     _add_diag_args(ps)
     ps.set_defaults(fn=cmd_search)

@@ -264,6 +264,10 @@ class Diagnostics:
         self._dedup: Dict[tuple, DiagnosticEvent] = {}
         self._eff_hits: Dict[str, set] = {}
         self._eff_misses: Dict[str, set] = {}
+        #: free-form numeric counters (sweep cell accounting: total /
+        #: pruned / evaluated / replayed / quarantined cells, worker
+        #: count, pool restarts, ...) — reported, never a violation
+        self.counters: Dict[str, float] = {}
 
     @classmethod
     def active(cls) -> Optional["Diagnostics"]:
@@ -280,17 +284,21 @@ class Diagnostics:
             Diagnostics._active.pop()
 
     # -- recording ---------------------------------------------------------
-    def _record(self, event: DiagnosticEvent):
+    def _record(self, event: DiagnosticEvent, n: int = 1):
         # a sweep repeats the same warning for thousands of candidates:
         # collapse identical facts into one event with a `count`, but
-        # never collapse across distinct coordinates (candidate / table key)
+        # never collapse across distinct coordinates (candidate / table
+        # key). ``n > 1`` merges an already-collapsed fact (a worker's
+        # deduped event) without losing its count.
         ctx = event.context
         key = (event.severity, event.category, event.message,
                ctx.get("candidate"), ctx.get("op_key"), ctx.get("shape_key"))
         prior = self._dedup.get(key)
         if prior is not None:
-            prior.context["count"] = prior.context.get("count", 1) + 1
+            prior.context["count"] = prior.context.get("count", 1) + n
             return
+        if n > 1:
+            event.context["count"] = n
         self._dedup[key] = event
         self.events.append(event)
 
@@ -312,6 +320,35 @@ class Diagnostics:
             ctx.update(exc.context)
         ctx["exception"] = type(exc).__name__
         self.error(category, str(exc) or type(exc).__name__, **ctx)
+
+    def count(self, name: str, n: float = 1):
+        """Bump a numeric counter (sweep cell accounting etc.)."""
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def merge_coverage(self, hits: Dict[str, set], misses: Dict[str, set]):
+        """Union raw efficiency-coverage sets into this collector —
+        the merge-back path for coverage measured inside sweep worker
+        processes (the in-process path is :meth:`record_efficiency`)."""
+        for op_key, keys in hits.items():
+            self._eff_hits.setdefault(op_key, set()).update(keys)
+        for op_key, keys in misses.items():
+            self._eff_misses.setdefault(op_key, set()).update(keys)
+
+    def merge_events(self, events: List[Dict[str, Any]]):
+        """Re-record serialized :class:`DiagnosticEvent` dicts (from
+        ``to_dict``) shipped back by a sweep worker process, preserving
+        the same dedup-by-coordinates collapsing as local recording —
+        including each event's accumulated ``count`` (a worker may have
+        already collapsed thousands of occurrences into one event)."""
+        for ev in events:
+            ctx = dict(ev.get("context") or {})
+            n = ctx.pop("count", 1) or 1
+            self._record(DiagnosticEvent(
+                ev.get("severity", "warning"),
+                ev.get("category", ""),
+                ev.get("message", ""),
+                ctx,
+            ), n=int(n))
 
     def record_efficiency(self, system):
         """Merge efficiency-table coverage from a ``SystemConfig`` after
@@ -389,6 +426,7 @@ class Diagnostics:
                 "errors": len(self.errors),
                 "quarantined": len(self.quarantined),
             },
+            "counters": dict(self.counters),
             "efficiency": {
                 "hits": hits,
                 "misses": misses,

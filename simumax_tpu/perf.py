@@ -320,7 +320,6 @@ class PerfLLM(PerfBase):
     def run_estimate(self, capture_graph: bool = False,
                      debug: bool = False):
         assert self.strategy is not None, "call configure() first"
-        self.system.reset_status()
         with self.diagnostics.capture(category="placement"):
             self.build()
         env_graph = os.environ.get("ENABLE_SIMU_GRAPH", "").lower()
@@ -332,6 +331,16 @@ class PerfLLM(PerfBase):
         env_debug = os.environ.get("SIMU_DEBUG", "").lower()
         if debug or env_debug in ("1", "true", "yes", "on"):
             self.ctx.debug.enabled = True
+        return self.estimate()
+
+    def estimate(self):
+        """Symbolic estimate over the already-built chunk graph (the
+        estimate half of the build/estimate split; ``run_estimate`` is
+        ``build() + estimate()``). Separated so the strategy sweep can
+        re-estimate a layout under a new batch split (:meth:`rebatch`)
+        without reconstructing the module tree."""
+        assert self.ctx is not None, "call build() first"
+        self.system.reset_status()
         with self.diagnostics.capture(category="estimate"):
             self._run()
         # merge (not snapshot) so a sweep's run-level collector
@@ -341,6 +350,50 @@ class PerfLLM(PerfBase):
         self._cost_result = None
         self._interleaved_result = None
         self._dp_time_cache = {}
+        return self
+
+    #: strategy fields the built chunk graph does NOT depend on — they
+    #: only enter at estimate/analysis time (input shapes, schedule
+    #: replay), so :meth:`rebatch` may change them without a rebuild
+    BATCH_ONLY_FIELDS = frozenset({"micro_batch_size", "micro_batch_num"})
+
+    def rebatch(self, strategy: StrategyConfig):
+        """Swap in a strategy differing from the current one only in
+        :attr:`BATCH_ONLY_FIELDS` and re-estimate, reusing the built
+        chunk graph (recompute wiring, stage split, mesh placement are
+        all batch-independent). A micro_batch_num-only change skips even
+        the symbolic re-run — only the schedule/memory analyses read it.
+
+        This is the sweep's per-layout build cache fast path: the
+        (mbs, mbc) searches inside one layout call this instead of
+        rebuilding via ``configure() + run_estimate()``."""
+        assert self.ctx is not None, "call build()/run_estimate() first"
+        import dataclasses
+
+        for f in dataclasses.fields(StrategyConfig):
+            if f.name in self.BATCH_ONLY_FIELDS:
+                continue
+            if getattr(strategy, f.name) != getattr(self.strategy, f.name):
+                raise ValueError(
+                    f"rebatch: field {f.name!r} differs from the built "
+                    f"strategy — only {sorted(self.BATCH_ONLY_FIELDS)} may "
+                    f"change without a rebuild; call configure() instead"
+                )
+        # validate BEFORE mutating: a failed sanity check must leave the
+        # built estimate untouched (the caller may retry another split)
+        with self.diagnostics.capture(category="config"):
+            strategy.sanity_check()
+        rerun = (
+            strategy.micro_batch_size != self.strategy.micro_batch_size
+        )
+        self.strategy = strategy
+        self.ctx.strategy = strategy
+        self._mem_result = None
+        self._cost_result = None
+        self._interleaved_result = None
+        self._dp_time_cache = {}
+        if rerun:
+            return self.estimate()
         return self
 
     # ------------------------------------------------------------------

@@ -1,3 +1,14 @@
+from simumax_tpu.search.executor import (  # noqa: F401
+    BoundedCache,
+    CellOutcome,
+    run_cells,
+)
+from simumax_tpu.search.prune import (  # noqa: F401
+    SweepCell,
+    enumerate_cells,
+    memory_lower_bound,
+    make_cell_strategy,
+)
 from simumax_tpu.search.searcher import (  # noqa: F401
     StrategySearcher,
     SweepJournal,

@@ -1,0 +1,216 @@
+"""Sweep-cell pruning (L7): decide, *before building anything*, which
+grid cells cannot possibly produce a feasible result row.
+
+Two families of prunes, both recorded as auditable ``status=pruned`` CSV
+rows instead of silent skips:
+
+* **dominance / divisibility** — layouts whose tp*cp*pp or ep*pp does
+  not divide the world size, expert parallelism on a dense model,
+  ZeRO levels that duplicate the representative level when there are no
+  data-parallel replicas, and global batch sizes that do not divide over
+  dp. These mirror the historical silent ``continue`` guards of the
+  sweep loop.
+* **memory lower bound** — a closed-form per-device bound on the peak
+  HBM a cell can ever reach: parameter + gradient + optimizer-state
+  bytes under the cell's sharding (the components ``analysis_mem``
+  reports per stage), plus the smallest possible activation footprint
+  (one transformer-block input at micro_batch_size=1). If even that
+  floor exceeds usable HBM, no batch split or recompute family can make
+  the cell fit, so the entire ``PerfLLM`` build is skipped.
+
+The bound must be a *true* lower bound — pruning a feasible cell would
+change sweep results. It therefore under-counts on purpose (even layer
+split across stages, tied embeddings counted once, replicated norms and
+pipeline-replica weights ignored) and applies ``PRUNE_SAFETY`` headroom
+on the parameter term to absorb model-accounting skew.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from simumax_tpu.core.config import (
+    GiB,
+    ModelConfig,
+    StrategyConfig,
+    SystemConfig,
+)
+
+#: headroom on the closed-form parameter bound: prune only when the
+#: floor exceeds usable HBM by >10%, so modest accounting skew between
+#: the closed form and the built model can never prune a feasible cell
+PRUNE_SAFETY = 0.9
+
+
+@dataclass(frozen=True)
+class SweepCell:
+    """One (layout, recompute-family) sweep cell scheduled for
+    evaluation. ``idx`` is the cell's position in deterministic grid
+    order — results are merged back in ``idx`` order so parallel and
+    serial sweeps rank and dedup identically."""
+
+    idx: int
+    key: str
+    tp: int
+    cp: int
+    ep: int
+    pp: int
+    zero: int
+    rc: str
+
+
+def make_cell_strategy(
+    base: StrategyConfig, tp: int, cp: int, ep: int, pp: int, zero: int
+) -> StrategyConfig:
+    """The candidate strategy for one grid layout — the single source
+    for both the serial loop and pool workers, so they cannot diverge."""
+    st = copy.deepcopy(base)
+    st.tp_size, st.cp_size = tp, cp
+    st.ep_size, st.pp_size = ep, pp
+    st.zero_state = zero
+    st.etp_size = min(st.etp_size, tp) or 1
+    return st
+
+
+def model_param_split(model: ModelConfig) -> Tuple[int, int]:
+    """(dense_elements, expert_elements) for the whole model, counted
+    the lower-bound way: unpadded vocab, tied embedding once."""
+    dense = model.vocab_size * model.hidden_size  # embedding
+    if model.untie_embeddings:
+        dense += model.vocab_size * model.hidden_size  # lm head
+    dense += model.hidden_size  # final norm
+    expert = 0
+    for i in range(model.layer_num):
+        d, e = model.layer_param_elements(i)
+        dense += d
+        expert += e
+    return dense, expert
+
+
+def memory_lower_bound(st: StrategyConfig, model: ModelConfig) -> float:
+    """Closed-form lower bound (bytes) on the max per-device stage peak
+    of this layout, at micro_batch_size=1 under full recompute — the
+    cheapest configuration any batch/recompute search could reach.
+
+    Mirrors ``MetaModule.make_param_info`` byte accounting: weight at
+    ``element_size`` (sharded by dp*cp under ZeRO-3), grad at
+    ``grad_element_size`` (sharded under ZeRO>=2, absent for the
+    functional optimizer), optimizer state at 12 B/elem megatron-style
+    or 8 B/elem functional (sharded under ZeRO>=1). Dense params shard
+    over tp, expert params over etp*ep; the per-stage floor is the
+    even-split mean (max stage >= mean)."""
+    dense, expert = model_param_split(model)
+    dshard = max(1, st.dp_size * st.cp_size)
+    eshard = max(1, st.edp_size)
+    e = st.element_size
+    if st.optimizer_style == "functional":
+        g, s = 0.0, 8.0
+    else:
+        g, s = st.grad_element_size, 12.0
+
+    def per_elem(shard: int) -> float:
+        return (
+            e / (shard if st.zero_state >= 3 else 1)
+            + g / (shard if st.zero_state >= 2 else 1)
+            + s / (shard if st.zero_state >= 1 else 1)
+        )
+
+    params = (
+        dense / max(1, st.tp_size) * per_elem(dshard)
+        + expert / max(1, st.etp_size * st.ep_size) * per_elem(eshard)
+    ) / max(1, st.pp_size)
+    # minimum activation floor: one block input at mbs=1 (sp-sharded)
+    act_seq = st.seq_len // max(1, st.cp_size)
+    if st.enable_sequence_parallel:
+        act_seq //= max(1, st.tp_size)
+    act = act_seq * model.hidden_size * e
+    return PRUNE_SAFETY * params + act
+
+
+def base_cell_row(st: StrategyConfig, rc: str, status: str) -> dict:
+    """The shared CSV row skeleton for non-result rows (pruned /
+    quarantined cells): layout coordinates + zeroed metrics. One
+    source, so the merged CSV's columns cannot drift between the two
+    row families."""
+    return {
+        "tp": st.tp_size, "cp": st.cp_size, "pp": st.pp_size,
+        "dp": st.dp_size, "ep": st.ep_size, "etp": st.etp_size,
+        "vp": st.vp_size, "mbs": st.micro_batch_size,
+        "mbc": st.micro_batch_num, "zero": st.zero_state,
+        "recompute": rc, "recompute_layers": 0,
+        "mfu": 0.0, "iter_ms": 0.0, "tgs": 0.0, "peak_gib": 0.0,
+        "fits": False, "dcn_dims": "",
+        "status": status,
+    }
+
+
+def pruned_row(st: StrategyConfig, rc: str, reason: str,
+               bound_bytes: Optional[float] = None) -> dict:
+    """A CSV-compatible ``status=pruned`` row; ``peak_gib`` carries the
+    memory floor when the prune was memory-based."""
+    row = base_cell_row(st, rc, "pruned")
+    if bound_bytes:
+        row["peak_gib"] = bound_bytes / GiB
+    row["prune_reason"] = reason
+    return row
+
+
+def enumerate_cells(
+    base_strategy: StrategyConfig,
+    model: ModelConfig,
+    system: SystemConfig,
+    global_batch_size: int,
+    tp_list: Sequence[int],
+    cp_list: Sequence[int],
+    ep_list: Sequence[int],
+    pp_list: Sequence[int],
+    zero_list: Sequence[int],
+    recompute_types: Sequence[str],
+    prune: bool = True,
+) -> Tuple[List[SweepCell], List[dict]]:
+    """Expand the sweep grid into (cells to evaluate, pruned rows).
+
+    With ``prune=False`` the divisibility guards still skip impossible
+    layouts (exactly the historical sweep behavior — they could never
+    produce a row) but nothing is recorded and the memory bound is not
+    applied, so the evaluated cell set matches the legacy sweep
+    bit-for-bit."""
+    world = base_strategy.world_size
+    cells: List[SweepCell] = []
+    pruned: List[dict] = []
+    idx = 0
+    for tp, cp, ep, pp, zero in itertools.product(
+        tp_list, cp_list, ep_list, pp_list, zero_list
+    ):
+        reason = None
+        if world % (tp * cp * pp) or world % (ep * pp):
+            reason = "layout_indivisible"
+        elif model.model_type != "moe" and ep > 1:
+            reason = "ep_on_dense_model"
+        st = make_cell_strategy(base_strategy, tp, cp, ep, pp, zero)
+        if reason is None and zero > min(zero_list) \
+                and st.dp_size * st.cp_size == 1:
+            # ZeRO has no effect without data-parallel replicas; the
+            # representative (minimum) level dominates the duplicates
+            reason = "zero_dominated"
+        if reason is None and (
+            st.dp_size < 1 or global_batch_size % st.dp_size
+        ):
+            reason = "gbs_indivisible"
+        bound = None
+        if reason is None and prune:
+            floor = memory_lower_bound(st, model)
+            if floor > system.mem_bytes * st.mem_factor:
+                reason = "memory_lower_bound"
+                bound = floor
+        for rc in recompute_types:
+            key = f"tp{tp}_cp{cp}_ep{ep}_pp{pp}_z{zero}_{rc}"
+            if reason is None:
+                cells.append(SweepCell(idx, key, tp, cp, ep, pp, zero, rc))
+                idx += 1
+            elif prune:
+                pruned.append(pruned_row(st, rc, reason, bound_bytes=bound))
+    return cells, pruned

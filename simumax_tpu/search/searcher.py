@@ -17,11 +17,11 @@ from __future__ import annotations
 import contextlib
 import copy
 import csv
-import itertools
 import json
 import os
 import signal
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
@@ -35,6 +35,12 @@ from simumax_tpu.core.config import (
 from simumax_tpu.core.errors import CandidateTimeoutError, FeasibilityError
 from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.perf import PerfLLM
+from simumax_tpu.search.executor import BoundedCache, run_cells
+from simumax_tpu.search.prune import (
+    base_cell_row,
+    enumerate_cells,
+    make_cell_strategy,
+)
 
 #: result-cache key: the strategy fields that affect estimates
 _KEY_FIELDS = (
@@ -47,15 +53,25 @@ _KEY_FIELDS = (
     "optimizer_style", "enable_recompute", "recompute_granularity",
     "recompute_layer_num", "attn_recompute", "attn_norm_recompute",
     "mla_rms_recompute", "mlp_recompute", "mlp_rms_recompute",
-    "sdp_recompute", "recompute_variance", "moe_capacity_factor",
+    "sdp_recompute", "recompute_variance", "moe_act_recompute",
+    "mla_up_proj_recompute", "megatron_recompute",
+    "megatron_recompute_modules", "moe_capacity_factor",
     "dispatch_probs", "mesh_order", "group_linear_mode",
     "offload_groupgemm_col_inputs", "mem_factor",
     "enable_straggler_model", "num_layers_in_first_pipeline_stage",
     "num_layers_in_last_pipeline_stage",
     "account_for_embedding_in_pipeline_split",
     "account_for_loss_in_pipeline_split", "use_math_sdp", "quant_dtype",
+    "sdp_backend", "overlap_grad_reduce", "overlap_param_gather",
     "moe_dispatcher_policy", "attention_sparse_ratio", "enable_dropout",
 )
+
+
+def _key_value(st: StrategyConfig, field_name: str):
+    """Hashable cache-key value for one strategy field
+    (megatron_recompute_modules is a list)."""
+    v = getattr(st, field_name)
+    return tuple(v) if isinstance(v, list) else v
 
 
 #: _KEY_FIELDS the parallel-strategy sweep overrides per cell — the
@@ -67,33 +83,68 @@ _SWEPT_FIELDS = frozenset({
 })
 
 
+def _model_system_key(model, system) -> tuple:
+    """Stable content-ish identity of a (model, system) pair — not
+    id() (which CPython reuses after GC). Shared by the result cache
+    and the build cache so the two can never desynchronize."""
+    return (
+        (model.model_name, model.layer_num, model.hidden_size,
+         model.vocab_size, model.expert_num, model.attention_type),
+        (system.sys_name, system.accelerator.mem_gbs,
+         tuple(system.ici.axes), system.num_slices),
+    )
+
+
 def _strategy_key(st: StrategyConfig, model, system, gib_margin) -> tuple:
     # model/system identity + margin are part of the verdict, not just
-    # the strategy fields; use stable content-ish keys, not id() (which
-    # CPython reuses after GC)
-    model_key = (model.model_name, model.layer_num, model.hidden_size,
-                 model.vocab_size, model.expert_num, model.attention_type)
-    system_key = (system.sys_name, system.accelerator.mem_gbs,
-                  tuple(system.ici.axes), system.num_slices)
-    return (
-        model_key, system_key, gib_margin,
-        tuple(getattr(st, f) for f in _KEY_FIELDS),
+    # the strategy fields
+    return _model_system_key(model, system) + (
+        gib_margin,
+        tuple(_key_value(st, f) for f in _KEY_FIELDS),
     )
 
 
 @contextlib.contextmanager
-def _candidate_deadline(seconds: Optional[float], candidate: str):
+def _candidate_deadline(seconds: Optional[float], candidate: str,
+                        diagnostics: Optional[Diagnostics] = None):
     """Interrupt a candidate evaluation that runs past ``seconds`` with a
-    :class:`CandidateTimeoutError` (SIGALRM-based; best-effort no-op off
-    the main thread or on platforms without ``setitimer``)."""
+    :class:`CandidateTimeoutError` (SIGALRM-based on the main thread —
+    including each pool worker's main thread).
+
+    Off the main thread, or without ``setitimer``, enforcement degrades
+    to a monotonic post-hoc check: the cell cannot be interrupted
+    mid-flight, but an overrunning candidate is still quarantined once
+    it returns, and a Diagnostics warning records the degraded mode
+    (previously this silently disabled the timeout altogether)."""
+    if seconds is None or seconds <= 0:
+        yield
+        return
     usable = (
-        seconds is not None
-        and seconds > 0
-        and hasattr(signal, "setitimer")
+        hasattr(signal, "setitimer")
         and threading.current_thread() is threading.main_thread()
     )
     if not usable:
+        if diagnostics is not None:
+            diagnostics.warn(
+                "search",
+                "per-candidate timeout enforced post-hoc: SIGALRM is "
+                "only available on the main thread, so a hung candidate "
+                "cannot be interrupted mid-flight (it is quarantined "
+                "after it returns)",
+                timeout_s=seconds,
+            )
+        start = time.monotonic()
         yield
+        elapsed = time.monotonic() - start
+        if elapsed > seconds:
+            raise CandidateTimeoutError(
+                f"candidate {candidate} took {elapsed:.2f}s, exceeding "
+                f"the {seconds:g}s per-candidate timeout (post-hoc "
+                f"monotonic check; SIGALRM unavailable off the main "
+                f"thread)",
+                candidate=candidate, timeout_s=seconds, phase="search",
+                elapsed_s=round(elapsed, 3), enforcement="post_hoc",
+            )
         return
 
     def _on_alarm(signum, frame):
@@ -187,6 +238,39 @@ class SweepJournal:
         return None
 
 
+#: builds kept alive per build cache — the current wiring plus a couple
+#: of recompute-layer binary-search neighbours
+BUILD_CACHE_MAX = 4
+
+
+def _layout_build_key(st: StrategyConfig, model, system) -> tuple:
+    """Like :func:`_strategy_key` minus the batch-split fields
+    (``PerfLLM.BATCH_ONLY_FIELDS`` — the single source ``rebatch``
+    validates against): two strategies with the same build key share a
+    built chunk graph."""
+    return _model_system_key(model, system) + (
+        tuple(_key_value(st, f) for f in _KEY_FIELDS
+              if f not in PerfLLM.BATCH_ONLY_FIELDS),
+    )
+
+
+def _identity_mismatch(stamped: dict, identity: dict) -> List[str]:
+    """Keys on which a journal's run-identity header actually disagrees
+    with this run. ``base_strategy`` is compared only over the keys
+    BOTH sides stamped: a newer release may key additional strategy
+    fields, and a journal recorded before that must still resume (the
+    run it describes has not changed)."""
+    diff = [
+        k for k in set(stamped) | set(identity)
+        if k != "base_strategy" and stamped.get(k) != identity.get(k)
+    ]
+    sb = stamped.get("base_strategy") or {}
+    ib = identity.get("base_strategy") or {}
+    if any(sb[k] != ib[k] for k in set(sb) & set(ib)):
+        diff.append("base_strategy")
+    return sorted(diff)
+
+
 def evaluate_strategy(
     strategy: StrategyConfig,
     model: ModelConfig,
@@ -194,6 +278,7 @@ def evaluate_strategy(
     cache: Optional[Dict] = None,
     gib_margin: float = 0.0,
     project_dualpp: bool = False,
+    build_cache: Optional[Dict] = None,
 ) -> Optional[dict]:
     """Estimate one candidate; returns a flat result row or None when
     the candidate is invalid or does not fit in HBM (reference
@@ -201,7 +286,12 @@ def evaluate_strategy(
 
     ``project_dualpp`` adds a DualPipe projection column for eligible
     layouts (even pp, no VPP) — opt-in because it costs ~8% sweep
-    throughput."""
+    throughput.
+
+    ``build_cache`` (dict-like) enables the per-layout build reuse fast
+    path: candidates differing only in the batch split rebatch a cached
+    built ``PerfLLM`` (``PerfLLM.rebatch``) instead of rebuilding the
+    whole chunk graph."""
     key = _strategy_key(strategy, model, system, gib_margin) + (
         project_dualpp,
     )
@@ -211,8 +301,24 @@ def evaluate_strategy(
     try:
         strategy = copy.deepcopy(strategy)
         strategy.__post_init__()
-        perf = PerfLLM().configure(strategy, model, system)
-        perf.run_estimate()
+        perf = None
+        if build_cache is not None:
+            bkey = _layout_build_key(strategy, model, system)
+            built = build_cache.get(bkey)
+            if built is not None:
+                try:
+                    perf = built.rebatch(strategy)
+                except ValueError:
+                    # the build key abstracts over _KEY_FIELDS; a field
+                    # outside it differing fails rebatch's exhaustive
+                    # check — fall back to a fresh build rather than
+                    # crashing the cell
+                    perf = None
+        if perf is None:
+            perf = PerfLLM().configure(strategy, model, system)
+            perf.run_estimate()
+            if build_cache is not None:
+                build_cache[bkey] = perf
         mem = perf.analysis_mem()
         cost = perf.analysis_cost()
         fits = mem["max_peak_bytes"] + gib_margin * GiB <= (
@@ -279,6 +385,7 @@ def search_max_micro_batch_size(
     system: SystemConfig,
     limit: int = 64,
     cache: Optional[Dict] = None,
+    build_cache: Optional[Dict] = None,
 ) -> int:
     """Binary-search the largest feasible micro_batch_size
     (reference ``perf_llm.py:3080``)."""
@@ -287,7 +394,8 @@ def search_max_micro_batch_size(
         mid = (lo + hi) // 2
         st = copy.deepcopy(strategy)
         st.micro_batch_size = mid
-        row = evaluate_strategy(st, model, system, cache)
+        row = evaluate_strategy(st, model, system, cache,
+                                build_cache=build_cache)
         if row is not None and row["fits"]:
             best = mid
             lo = mid + 1
@@ -304,6 +412,7 @@ def search_micro_batch_config(
     gib_margin: float = 1.0,
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
+    build_cache: Optional[Dict] = None,
 ) -> Optional[dict]:
     """Fixed-GBS (mbs, mbc) search with a GiB safety margin
     (reference ``perf_llm.py:3111-3167``, ``gmi_error``)."""
@@ -325,7 +434,8 @@ def search_micro_batch_config(
         if st.vp_size > 1 and st.micro_batch_num % st.vpp_group_size:
             continue
         row = evaluate_strategy(st, model, system, cache, gib_margin,
-                                project_dualpp=project_dualpp)
+                                project_dualpp=project_dualpp,
+                                build_cache=build_cache)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -348,6 +458,7 @@ def search_best_selective_recompute(
     system: SystemConfig,
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
+    build_cache: Optional[Dict] = None,
 ) -> Optional[dict]:
     best = None
     for combo in _SELECTIVE_COMBOS:
@@ -358,7 +469,8 @@ def search_best_selective_recompute(
         for k, v in combo.items():
             setattr(st, k, v)
         row = evaluate_strategy(st, model, system, cache,
-                                project_dualpp=project_dualpp)
+                                project_dualpp=project_dualpp,
+                                build_cache=build_cache)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -372,6 +484,7 @@ def search_best_recompute_layer_num(
     system: SystemConfig,
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
+    build_cache: Optional[Dict] = None,
 ) -> Optional[dict]:
     """Binary-search the fewest full-recompute layers that still fit
     (reference ``perf_llm.py:3270-3328``) — fewer recomputed layers is
@@ -386,7 +499,8 @@ def search_best_recompute_layer_num(
         st.recompute_granularity = "full_block"
         st.recompute_layer_num = mid
         row = evaluate_strategy(st, model, system, cache,
-                                project_dualpp=project_dualpp)
+                                project_dualpp=project_dualpp,
+                                build_cache=build_cache)
         if row is not None and row["fits"]:
             best = row
             hi = mid - 1
@@ -399,13 +513,29 @@ def _evaluate_sweep_cell(
     st, rc, model, system, global_batch_size, cache, project_dualpp
 ) -> Optional[dict]:
     """Evaluate one (layout, recompute-family) sweep cell: search the
-    batch split, then the recompute family; at most one result row."""
+    batch split, then the recompute family; at most one result row.
+
+    The cell-local ``build_cache`` lets the batch searches inside this
+    cell rebatch one built chunk graph per recompute wiring instead of
+    re-running ``PerfLLM.build()`` per candidate split."""
+    build_cache = BoundedCache(maxsize=BUILD_CACHE_MAX)
+    if st.dp_size < 1 or global_batch_size % st.dp_size:
+        # every family below synthesizes an (mbs, mbc) split from
+        # global_batch_size // dp — with a non-dividing gbs that split
+        # would silently train a different global batch size
+        raise FeasibilityError(
+            f"global_batch_size {global_batch_size} does not divide over "
+            f"dp {st.dp_size}: no (mbs, mbc) split reproduces it",
+            phase="search", global_batch_size=global_batch_size,
+            dp=st.dp_size,
+        )
     st_rc = copy.deepcopy(st)
     if rc == "none":
         st_rc.enable_recompute = False
         return search_micro_batch_config(
             st_rc, model, system, global_batch_size,
             cache=cache, project_dualpp=project_dualpp,
+            build_cache=build_cache,
         )
     if rc == "selective":
         # pick the batch split under selective-recompute memory,
@@ -415,14 +545,18 @@ def _evaluate_sweep_cell(
         st_rc.recompute_layer_num = -1
         st_rc.sdp_recompute = True
         base_batch = search_micro_batch_config(
-            st_rc, model, system, global_batch_size, cache=cache
+            st_rc, model, system, global_batch_size, cache=cache,
+            build_cache=build_cache,
         )
+        # the guard above makes the mbs=1 fallback split exact; no
+        # silently-wrong-GBS row is possible
         bs = base_batch or {"mbs": 1, "mbc": global_batch_size // st.dp_size}
         st_rc.micro_batch_size = bs["mbs"]
         st_rc.micro_batch_num = bs["mbc"]
         return search_best_selective_recompute(
             st_rc, model, system, cache=cache,
             project_dualpp=project_dualpp,
+            build_cache=build_cache,
         )
     if rc == "full_block":
         st_rc.micro_batch_size = 1
@@ -430,6 +564,7 @@ def _evaluate_sweep_cell(
         return search_best_recompute_layer_num(
             st_rc, model, system, cache=cache,
             project_dualpp=project_dualpp,
+            build_cache=build_cache,
         )
     raise ConfigError(f"unknown recompute family {rc!r}", phase="search")
 
@@ -454,23 +589,33 @@ def search_best_parallel_strategy(
     journal_path: Optional[str] = None,
     resume: Optional[str] = None,
     diagnostics: Optional[Diagnostics] = None,
+    jobs: int = 1,
+    prune: bool = True,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
-    ``search_best_parallel_strategy`` perf_llm.py:3355-3578): for each
-    layout, search the batch split, then each recompute family; rank by
-    MFU.
+    ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
+    the grid, prune cells that cannot possibly fit (``search/prune.py``
+    — recorded as auditable ``status=pruned`` CSV rows), evaluate the
+    rest (serially, or fanned out over ``jobs`` worker processes via
+    ``search/executor.py``), merge results back in deterministic grid
+    order, and rank by MFU — so serial and parallel sweeps produce
+    identical top-k rows and identical CSV row sets.
 
     Fault isolation: each (layout, recompute) cell is evaluated under an
     optional ``candidate_timeout`` (seconds), and any exception —
     invariant failure, timeout, crash — quarantines just that cell: it
     lands in the CSV as a ``status=error`` row carrying the exception
-    class and in ``diagnostics``, while the sweep continues.
+    class and in ``diagnostics``, while the sweep continues. In pool
+    mode the deadline runs on each worker's main thread (SIGALRM), with
+    a pool-level hard backstop that kills wedged workers.
     ``journal_path`` checkpoints every finished cell to a JSONL journal;
     ``resume`` replays a journal so an interrupted sweep continues
     without re-evaluating the journaled prefix (pass the same path as
-    both to extend one journal across runs). A journal stamped for a
-    different run identity (model / system / gbs / world) is refused."""
-    cache = {} if cache is None else cache
+    both to extend one journal across runs) — in any mix of serial and
+    parallel runs. A journal stamped for a different run identity
+    (model / system / gbs / world) is refused. ``prune=False`` restores
+    the evaluate-everything legacy behavior (``--no-prune``)."""
+    cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     # run identity for the journal: everything a cell row depends on
     # besides the swept dims themselves — model, hardware fingerprint,
@@ -497,11 +642,9 @@ def search_best_parallel_strategy(
                 phase="search", journal=resume,
             )
         stamped = SweepJournal.read_header(resume)
-        if stamped is not None and stamped != identity:
-            diff = sorted(
-                k for k in set(stamped) | set(identity)
-                if stamped.get(k) != identity.get(k)
-            )
+        diff = _identity_mismatch(stamped, identity) \
+            if stamped is not None else []
+        if diff:
             raise ConfigError(
                 f"journal {resume} was recorded for a different run "
                 f"(mismatched: {', '.join(diff)}); refusing to replay "
@@ -518,102 +661,112 @@ def search_best_parallel_strategy(
         journal is not None and resume is not None
         and os.path.abspath(journal_path) != os.path.abspath(resume)
     )
+    # grid expansion + dominance / memory-lower-bound pruning: cells
+    # carry a deterministic grid index so results merge back in the
+    # same order serial evaluation would have produced them
+    cells, pruned_rows = enumerate_cells(
+        base_strategy, model, system, global_batch_size,
+        tp_list, cp_list, ep_list, pp_list, zero_list, recompute_types,
+        prune=prune,
+    )
     rows: List[dict] = []
     quarantine: List[dict] = []
-    world = base_strategy.world_size
+    replayed: Dict[int, dict] = {}
+    to_run = []
+    for cell in cells:
+        prior = resumed.get(cell.key)
+        if prior is not None \
+                and prior.get("status") not in ("ok", "empty", "error"):
+            # hand-built or torn entry with no recognizable status:
+            # re-evaluate rather than guess
+            prior = None
+        if prior is not None:
+            replayed[cell.idx] = prior
+        else:
+            to_run.append(cell)
+    diagnostics.count("sweep_cells_total", len(cells) + len(pruned_rows))
+    diagnostics.count("sweep_cells_pruned", len(pruned_rows))
+    diagnostics.count("sweep_cells_replayed", len(replayed))
+    diagnostics.count("sweep_cells_evaluated", len(to_run))
+    diagnostics.counters["sweep_jobs"] = max(1, int(jobs or 1))
     # every PerfLLM built under a candidate reports into this run's
     # collector (Diagnostics.active()) instead of a throwaway one
     try:
         with diagnostics.activate():
-            for tp, cp, ep, pp, zero in itertools.product(
-                tp_list, cp_list, ep_list, pp_list, zero_list
-            ):
-                if world % (tp * cp * pp) or world % (ep * pp):
+
+            def _checkpoint(outcome):
+                # journal as soon as each cell finishes (completion
+                # order in pool mode) — a killed sweep loses at most
+                # the in-flight candidates
+                if journal:
+                    journal.append(outcome.cell.key, outcome.status,
+                                   row=outcome.row, error=outcome.error)
+                row = outcome.row
+                if verbose and row and row.get("fits"):
+                    # progress streams as cells finish, like the old
+                    # serial loop (completion order under --jobs)
+                    print(
+                        f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
+                        f"pp{row['pp']} {row['recompute']}: "
+                        f"mfu {row['mfu']*100:.2f}% "
+                        f"peak {row['peak_gib']:.1f} GiB"
+                    )
+
+            # replayed cells ride the journal, not the executor —
+            # processed (and re-journaled) BEFORE the long evaluation
+            # phase, so a sweep killed mid-run keeps its resumed prefix
+            # in the new journal
+            for cell in cells:
+                prior = replayed.get(cell.idx)
+                if prior is None:
                     continue
-                if model.model_type != "moe" and ep > 1:
-                    continue
-                st = copy.deepcopy(base_strategy)
-                st.tp_size, st.cp_size = tp, cp
-                st.ep_size, st.pp_size = ep, pp
-                st.zero_state = zero
-                # ZeRO has no effect without data-parallel replicas; keep one
-                # representative level to avoid duplicate candidates
-                if zero > min(zero_list) and st.dp_size * st.cp_size == 1:
-                    continue
-                st.etp_size = min(st.etp_size, tp) or 1
-                if st.dp_size < 1 or global_batch_size % st.dp_size:
-                    continue
-                for rc in recompute_types:
-                    cell_key = f"tp{tp}_cp{cp}_ep{ep}_pp{pp}_z{zero}_{rc}"
-                    prior = resumed.get(cell_key)
-                    if prior is not None \
-                            and prior.get("status") not in ("ok", "empty",
-                                                            "error"):
-                        # hand-built or torn entry with no recognizable
-                        # status: re-evaluate rather than guess
-                        prior = None
-                    if prior is not None:
-                        # journaled in a previous run: replay, don't re-evaluate
-                        status = prior["status"]
-                        if (status == "ok" and prior.get("row")
-                                and prior["row"].get("fits")):
-                            rows.append(prior["row"])
-                        elif status == "error":
-                            err = prior.get("error") or {}
-                            quarantine.append(_quarantine_row(st, rc, err))
-                            # the resumed run's report must count this
-                            # failure just like the run that journaled it
-                            diagnostics.error(
-                                "quarantine",
-                                err.get("error_msg") or "journaled failure",
-                                candidate=cell_key, phase="search",
-                                exception=err.get("error_type", ""),
-                                replayed=True,
-                            )
-                        if rejournal:
-                            journal.append(cell_key, status,
-                                           row=prior.get("row"),
-                                           error=prior.get("error"))
-                        continue
-                    try:
-                        with _candidate_deadline(candidate_timeout, cell_key):
-                            row = _evaluate_sweep_cell(
-                                st, rc, model, system, global_batch_size,
-                                cache, project_dualpp,
-                            )
-                    except Exception as exc:  # quarantine, keep sweeping
-                        err = {
-                            "error_type": type(exc).__name__,
-                            "error_msg": str(exc)[:500],
-                        }
-                        quarantine.append(_quarantine_row(st, rc, err))
-                        diagnostics.record_exception(
-                            exc, category="quarantine",
-                            candidate=cell_key, phase="search",
-                        )
-                        if journal:
-                            journal.append(cell_key, "error", error=err)
-                        continue
-                    if row is not None:
-                        row.setdefault("status", "ok")
-                    if journal:
-                        journal.append(
-                            cell_key,
-                            "ok" if row is not None else "empty",
-                            row=row,
-                        )
-                    if row is not None and row["fits"]:
-                        rows.append(row)
-                        if verbose:
-                            print(
-                                f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
-                                f"pp{row['pp']} {row['recompute']}: "
-                                f"mfu {row['mfu']*100:.2f}% "
-                                f"peak {row['peak_gib']:.1f} GiB"
-                            )
+                status = prior["status"]
+                if status == "error":
+                    err = prior.get("error") or {}
+                    # the resumed run's report must count this failure
+                    # just like the run that journaled it
+                    diagnostics.error(
+                        "quarantine",
+                        err.get("error_msg") or "journaled failure",
+                        candidate=cell.key, phase="search",
+                        exception=err.get("error_type", ""),
+                        replayed=True,
+                    )
+                if rejournal:
+                    journal.append(cell.key, status,
+                                   row=prior.get("row"),
+                                   error=prior.get("error"))
+            outcomes = run_cells(
+                to_run,
+                base_strategy=base_strategy, model=model, system=system,
+                global_batch_size=global_batch_size,
+                project_dualpp=project_dualpp,
+                candidate_timeout=candidate_timeout,
+                cache=cache, diagnostics=diagnostics, jobs=jobs,
+                on_done=_checkpoint,
+            )
     finally:
         if journal:
             journal.close()
+    # merge outcomes back in deterministic grid order so ranking and
+    # dedup are identical however the cells were scheduled
+    for cell in cells:
+        prior = replayed.get(cell.idx)
+        if prior is not None:
+            status, row = prior["status"], prior.get("row")
+            err = prior.get("error")
+        else:
+            out = outcomes.get(cell.idx)
+            if out is None:  # defensive: executor lost a cell
+                continue
+            status, row, err = out.status, out.row, out.error
+        if status == "error":
+            st = make_cell_strategy(base_strategy, cell.tp, cell.cp,
+                                    cell.ep, cell.pp, cell.zero)
+            quarantine.append(_quarantine_row(st, cell.rc, err or {}))
+        elif status == "ok" and row and row.get("fits"):
+            rows.append(row)
+    diagnostics.count("sweep_cells_quarantined", len(quarantine))
     # dedup: the recompute-layer search bottoming out at 0 layers is the
     # same candidate as the no-recompute row
     seen = set()
@@ -629,7 +782,7 @@ def search_best_parallel_strategy(
     rows = uniq
     rows.sort(key=lambda r: r["mfu"], reverse=True)
     if csv_path:
-        csv_rows = rows + quarantine
+        csv_rows = rows + quarantine + pruned_rows
         fields: List[str] = []
         for r in csv_rows:
             for k in r:
@@ -644,18 +797,10 @@ def search_best_parallel_strategy(
 
 def _quarantine_row(st, rc: str, err: dict) -> dict:
     """A CSV-compatible ``status=error`` row for a failed sweep cell."""
-    return {
-        "tp": st.tp_size, "cp": st.cp_size, "pp": st.pp_size,
-        "dp": st.dp_size, "ep": st.ep_size, "etp": st.etp_size,
-        "vp": st.vp_size, "mbs": st.micro_batch_size,
-        "mbc": st.micro_batch_num, "zero": st.zero_state,
-        "recompute": rc, "recompute_layers": 0,
-        "mfu": 0.0, "iter_ms": 0.0, "tgs": 0.0, "peak_gib": 0.0,
-        "fits": False, "dcn_dims": "",
-        "status": "error",
-        "error_type": err.get("error_type", ""),
-        "error_msg": err.get("error_msg", ""),
-    }
+    row = base_cell_row(st, rc, "error")
+    row["error_type"] = err.get("error_type", "")
+    row["error_msg"] = err.get("error_msg", "")
+    return row
 
 
 @dataclass
@@ -666,7 +811,7 @@ class StrategySearcher:
     model: ModelConfig
     system: SystemConfig
     base_strategy: StrategyConfig
-    cache: Dict = field(default_factory=dict)
+    cache: Dict = field(default_factory=BoundedCache)
 
     def search(
         self,

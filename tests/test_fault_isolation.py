@@ -8,6 +8,7 @@ already-journaled cells. See docs/diagnostics.md.
 
 import csv
 import json
+import multiprocessing
 import time
 
 import pytest
@@ -110,6 +111,98 @@ class TestQuarantine:
         )
 
 
+requires_fork = pytest.mark.skipif(
+    "fork" not in multiprocessing.get_all_start_methods(),
+    reason="pool injection tests need fork (monkeypatch inheritance)",
+)
+
+
+class TestPoolQuarantine:
+    """The serial fault-isolation guarantees must hold under the
+    process pool (--jobs): a crashing or hanging worker cell becomes a
+    status=error CSV row + Diagnostics entry, never a dead sweep."""
+
+    @requires_fork
+    def test_worker_crash_quarantined(self, monkeypatch, tmp_path):
+        m, sysc, st = setup()
+        _inject(monkeypatch, {
+            (2, "none"): "feasibility",
+            (4, "none"): "runtime",
+        })
+        csv_path = tmp_path / "sweep.csv"
+        diag = Diagnostics()
+        rows = _sweep(m, sysc, st, csv_path=str(csv_path), jobs=2,
+                      diagnostics=diag)
+        assert rows and all(r["status"] == "ok" for r in rows)
+        assert len(diag.quarantined) == 2
+        with open(csv_path) as f:
+            errors = [r for r in csv.DictReader(f)
+                      if r["status"] == "error"]
+        assert {r["error_type"] for r in errors} == {
+            "FeasibilityError", "RuntimeError",
+        }
+
+    @requires_fork
+    def test_worker_hang_interrupted_inside_worker(
+        self, monkeypatch, tmp_path
+    ):
+        """The per-candidate SIGALRM deadline runs on each worker
+        process's main thread, so a hung cell is interrupted inside the
+        worker without killing the pool."""
+        m, sysc, st = setup()
+        _inject(monkeypatch, {(2, "none"): "hang"})
+        diag = Diagnostics()
+        t0 = time.monotonic()
+        rows = _sweep(
+            m, sysc, st, tp_list=(1, 2), candidate_timeout=0.5,
+            jobs=2, diagnostics=diag,
+        )
+        assert time.monotonic() - t0 < 25  # not the 30s injected hang
+        assert rows  # tp=1 survived
+        assert len(diag.quarantined) == 1
+        evt = diag.quarantined[0]
+        assert evt.context["exception"] == "CandidateTimeoutError"
+        # the typed exception's structured context crosses the process
+        # boundary, like serial record_exception would have recorded
+        assert evt.context["timeout_s"] == 0.5
+        assert evt.context["phase"] == "search"
+
+    @requires_fork
+    def test_worker_death_isolated_not_collateral(self, monkeypatch):
+        """A cell that kills its worker outright (os._exit) breaks the
+        whole pool; the crash suspect is re-tried in an isolated
+        single-worker pool and quarantined, while every healthy cell is
+        retried and still produces its row."""
+        import os
+
+        real = searcher_mod._evaluate_sweep_cell
+
+        def fake(st, rc, model, system, gbs, cache, project_dualpp):
+            if st.tp_size == 2:
+                os._exit(1)  # hard death: no exception, no result
+            return real(st, rc, model, system, gbs, cache, project_dualpp)
+
+        monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
+        m, sysc, st = setup()
+        diag = Diagnostics()
+        rows = _sweep(m, sysc, st, jobs=2, diagnostics=diag)
+        assert {r["tp"] for r in rows} == {1, 4}  # healthy cells survive
+        assert len(diag.quarantined) == 1
+        assert "worker process died" in diag.quarantined[0].message
+
+    @requires_fork
+    def test_pool_journal_records_errors(self, monkeypatch, tmp_path):
+        m, sysc, st = setup()
+        _inject(monkeypatch, {(4, "none"): "runtime"})
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, journal_path=str(journal), jobs=2)
+        entries = SweepJournal.load(str(journal))
+        assert len(entries) == 3
+        bad = entries["tp4_cp1_ep1_pp1_z1_none"]
+        assert bad["status"] == "error"
+        assert bad["error"]["error_type"] == "RuntimeError"
+
+
 class TestJournalResume:
     def test_journal_records_every_cell(self, tmp_path):
         m, sysc, st = setup()
@@ -153,6 +246,24 @@ class TestJournalResume:
         # strict mode cannot be defeated by resuming
         assert len(diag.quarantined) == 1
         assert diag.quarantined[0].context["replayed"] is True
+
+    def test_resume_accepts_journal_from_older_identity_schema(
+        self, monkeypatch, tmp_path
+    ):
+        # a release may add newly-keyed base-strategy fields to the run
+        # identity; a journal stamped before that must still resume —
+        # only keys stamped by BOTH sides are compared
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, journal_path=str(journal))
+        lines = journal.read_text().splitlines()
+        header = json.loads(lines[0])["header"]
+        header["base_strategy"].pop("sdp_backend")  # "older" journal
+        lines[0] = json.dumps({"header": header})
+        journal.write_text("\n".join(lines) + "\n")
+        calls = _inject(monkeypatch, {})
+        _sweep(m, sysc, st, resume=str(journal))
+        assert calls == []  # fully replayed, not refused
 
     def test_resume_refuses_foreign_journal(self, tmp_path):
         m, sysc, st = setup()
@@ -296,6 +407,20 @@ class TestDiagnosticsCollector:
         diag.warn("estimate", "different warning")
         assert len(diag.warnings) == 2
         assert diag.warnings[0].context["count"] == 5
+
+    def test_merge_events_preserves_collapsed_counts(self):
+        # a worker ships an already-collapsed event (count=5); merging
+        # into a parent that saw the same fact 3 times must total 8,
+        # keeping --jobs N reports identical to serial ones
+        worker = Diagnostics()
+        for _ in range(5):
+            worker.warn("estimate", "same warning")
+        parent = Diagnostics()
+        for _ in range(3):
+            parent.warn("estimate", "same warning")
+        parent.merge_events([e.to_dict() for e in worker.events])
+        assert len(parent.warnings) == 1
+        assert parent.warnings[0].context["count"] == 8
 
     def test_distinct_candidates_never_collapse(self):
         diag = Diagnostics()

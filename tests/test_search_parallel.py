@@ -1,0 +1,438 @@
+"""Parallel / pruned sweep-engine tests (L7 perf layer).
+
+Covers the PR-2 execution engine: process-pool cell evaluation must be
+bit-compatible with the serial sweep (identical top-k, identical CSV
+row sets, identical journal/resume semantics), pruning must never drop
+a feasible cell, and the per-layout build cache (``PerfLLM.rebatch``)
+must produce estimates identical to a fresh build. See docs/search.md.
+"""
+
+import copy
+import csv
+import multiprocessing
+import threading
+import time
+
+import pytest
+
+import simumax_tpu.search.searcher as searcher_mod
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.core.errors import CandidateTimeoutError, FeasibilityError
+from simumax_tpu.core.records import Diagnostics
+from simumax_tpu.search import (
+    BoundedCache,
+    SweepJournal,
+    enumerate_cells,
+    evaluate_strategy,
+    memory_lower_bound,
+    search_best_parallel_strategy,
+)
+
+requires_fork = pytest.mark.skipif(
+    "fork" not in multiprocessing.get_all_start_methods(),
+    reason="pool injection tests need fork (monkeypatch inheritance)",
+)
+
+
+def setup():
+    m = get_model_config("llama2-tiny")
+    sysc = get_system_config("tpu_v5e_256")
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = 8
+    return m, sysc, st
+
+
+def _sweep(m, sysc, st, gbs=8, **kw):
+    kw.setdefault("tp_list", (1, 2, 4))
+    kw.setdefault("pp_list", (1,))
+    kw.setdefault("recompute_types", ("none",))
+    return search_best_parallel_strategy(st, m, sysc, gbs, **kw)
+
+
+def _row_key(r):
+    """Order-insensitive identity of a CSV row (net column excluded)."""
+    return tuple(sorted((k, str(v)) for k, v in r.items() if k != "net"))
+
+
+def _csv_rows(path):
+    with open(path) as f:
+        return [dict(r) for r in csv.DictReader(f)]
+
+
+def _inject_logged(monkeypatch, failures, log_path):
+    """Like test_fault_isolation._inject, but logs every evaluation to a
+    file so calls made inside fork workers are visible to the parent."""
+    real = searcher_mod._evaluate_sweep_cell
+
+    def fake(st, rc, model, system, gbs, cache, project_dualpp):
+        with open(log_path, "a") as f:
+            f.write(f"tp{st.tp_size}:{rc}\n")
+        action = failures.get((st.tp_size, rc))
+        if action == "runtime":
+            raise RuntimeError("injected crash")
+        if action == "hang":
+            time.sleep(30)
+        if action == "sleep":
+            time.sleep(1.0)
+        return real(st, rc, model, system, gbs, cache, project_dualpp)
+
+    monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
+
+
+def _read_log(log_path):
+    try:
+        with open(log_path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+class TestParallelDeterminism:
+    @requires_fork
+    def test_jobs_and_serial_identical_topk_and_csv(
+        self, monkeypatch, tmp_path
+    ):
+        """--jobs N and serial sweeps must produce identical top-k rows
+        and identical CSV row sets (order-insensitive), including
+        quarantined and pruned rows."""
+        m, sysc, st = setup()
+        _inject_logged(
+            monkeypatch, {(2, "none"): "runtime"}, tmp_path / "log"
+        )
+        grids = dict(
+            tp_list=(1, 2, 3, 4),  # tp=3: dominance-pruned layout
+            recompute_types=("none", "full_block"),
+            topk=10,
+        )
+        csv_s = tmp_path / "serial.csv"
+        csv_p = tmp_path / "parallel.csv"
+        diag_s, diag_p = Diagnostics(), Diagnostics()
+        ser = _sweep(m, sysc, st, csv_path=str(csv_s), jobs=1,
+                     diagnostics=diag_s, **grids)
+        par = _sweep(m, sysc, st, csv_path=str(csv_p), jobs=2,
+                     diagnostics=diag_p, **grids)
+        assert ser  # healthy cells produced ranked rows
+        assert [
+            (r["tp"], r["pp"], r["mbs"], r["mbc"], r["recompute"], r["mfu"])
+            for r in ser
+        ] == [
+            (r["tp"], r["pp"], r["mbs"], r["mbc"], r["recompute"], r["mfu"])
+            for r in par
+        ]
+        rows_s, rows_p = _csv_rows(csv_s), _csv_rows(csv_p)
+        assert sorted(map(_row_key, rows_s)) == sorted(map(_row_key, rows_p))
+        by_status = {}
+        for r in rows_p:
+            by_status.setdefault(r["status"], []).append(r)
+        assert len(by_status["error"]) == 1  # the injected (tp2, none)
+        assert len(by_status["pruned"]) == 2  # tp=3 x two families
+        assert len(diag_s.quarantined) == len(diag_p.quarantined) == 1
+
+    def test_pool_smoke_tiny_grid(self):
+        """Tier-1 smoke: a tiny grid through the real worker pool."""
+        m, sysc, st = setup()
+        rows = _sweep(m, sysc, st, jobs=2)
+        assert rows and all(r["fits"] for r in rows)
+        assert rows == sorted(rows, key=lambda r: r["mfu"], reverse=True)
+
+    @requires_fork
+    def test_pool_merges_worker_caches_and_coverage(self):
+        m, sysc, st = setup()
+        cache = BoundedCache()
+        diag = Diagnostics()
+        _sweep(m, sysc, st, jobs=2, cache=cache, diagnostics=diag)
+        assert len(cache) > 0  # worker results merged back
+        assert diag.hit_count + diag.miss_count > 0  # coverage merged
+        assert diag.counters["sweep_jobs"] == 2
+        assert diag.counters["sweep_cells_evaluated"] == 3
+
+    @requires_fork
+    def test_pool_workers_seeded_from_warm_cache(self, monkeypatch,
+                                                 tmp_path):
+        """A cache warmed by a serial sweep must serve pool workers:
+        the repeated parallel sweep performs zero fresh estimates."""
+        from simumax_tpu import perf as perf_mod
+
+        m, sysc, st = setup()
+        cache = BoundedCache()
+        _sweep(m, sysc, st, cache=cache)  # serial warm-up
+        log = tmp_path / "estimates.log"
+        real = perf_mod.PerfLLM.estimate
+
+        def counting(self):
+            with open(log, "a") as f:
+                f.write("estimate\n")
+            return real(self)
+
+        monkeypatch.setattr(perf_mod.PerfLLM, "estimate", counting)
+        rows = _sweep(m, sysc, st, jobs=2, cache=cache)
+        assert rows
+        assert _read_log(log) == []  # every candidate was a cache hit
+
+
+class TestParallelResume:
+    @requires_fork
+    def test_resume_round_trip_under_pool(self, monkeypatch, tmp_path):
+        """Kill-and-resume semantics under --jobs: a journaled prefix is
+        never re-evaluated, the remainder is evaluated exactly once."""
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        log = tmp_path / "calls.log"
+        _inject_logged(monkeypatch, {}, log)
+        # "killed" first run: only the tp=1 cell finished
+        first = _sweep(m, sysc, st, tp_list=(1,), journal_path=str(journal),
+                       jobs=2)
+        assert _read_log(log) == ["tp1:none"]
+        resumed = _sweep(
+            m, sysc, st, journal_path=str(journal), resume=str(journal),
+            jobs=2,
+        )
+        calls = _read_log(log)
+        assert sorted(calls) == ["tp1:none", "tp2:none", "tp4:none"]
+        assert len(calls) == 3  # no cell evaluated twice, ever
+        assert {r["tp"] for r in resumed} >= {r["tp"] for r in first}
+        # a second parallel resume replays everything: zero evaluations
+        again = _sweep(m, sysc, st, resume=str(journal), jobs=2)
+        assert len(_read_log(log)) == 3
+        assert [(r["tp"], r["mfu"]) for r in again] == [
+            (r["tp"], r["mfu"]) for r in resumed
+        ]
+
+    @requires_fork
+    def test_serial_journal_resumes_under_pool_and_back(
+        self, monkeypatch, tmp_path
+    ):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        serial = _sweep(m, sysc, st, journal_path=str(journal), jobs=1)
+        log = tmp_path / "calls.log"
+        _inject_logged(monkeypatch, {}, log)
+        parallel = _sweep(m, sysc, st, resume=str(journal), jobs=2)
+        assert _read_log(log) == []  # fully replayed
+        assert [(r["tp"], r["mfu"]) for r in serial] == [
+            (r["tp"], r["mfu"]) for r in parallel
+        ]
+
+
+class TestPruning:
+    def test_oversubscribed_grid_prunes_without_changing_topk(
+        self, tmp_path
+    ):
+        """On 16 GiB chips most replication-heavy layouts of an 8B model
+        cannot fit at any batch split: the closed-form bound must skip
+        >= 30% of cells while leaving top-k identical to an unpruned
+        run."""
+        m = get_model_config("llama3-8b")
+        sysc = get_system_config("tpu_v5e_256")
+        st = get_strategy_config("tp1_pp1_dp8_mbs1")
+        st.world_size = 64
+        grids = dict(tp_list=(1, 2), pp_list=(1,), zero_list=(0, 1, 3),
+                     recompute_types=("none",), topk=5)
+        csv_path = tmp_path / "sweep.csv"
+        diag = Diagnostics()
+        pruned_rows = search_best_parallel_strategy(
+            st, m, sysc, 128, csv_path=str(csv_path), prune=True,
+            diagnostics=diag, **grids,
+        )
+        full_rows = search_best_parallel_strategy(
+            st, m, sysc, 128, prune=False, **grids,
+        )
+        total = diag.counters["sweep_cells_total"]
+        pruned = diag.counters["sweep_cells_pruned"]
+        assert pruned / total >= 0.3
+        assert [
+            (r["tp"], r["zero"], r["mbs"], r["mbc"], r["mfu"])
+            for r in pruned_rows
+        ] == [
+            (r["tp"], r["zero"], r["mbs"], r["mbc"], r["mfu"])
+            for r in full_rows
+        ]
+        in_csv = [r for r in _csv_rows(csv_path)
+                  if r["status"] == "pruned"]
+        assert len(in_csv) == pruned
+        assert all(r["prune_reason"] == "memory_lower_bound"
+                   for r in in_csv)
+        assert all(float(r["peak_gib"]) > 0 for r in in_csv)
+
+    def test_memory_bound_is_a_true_lower_bound(self):
+        """The closed-form floor must never exceed the evaluated peak —
+        otherwise pruning could drop feasible cells."""
+        cases = [
+            ("llama2-tiny", "tp1_pp1_dp8_mbs1", 0),
+            ("llama2-tiny", "tp1_pp1_dp8_mbs1", 1),
+            ("llama2-tiny", "tp1_pp1_dp8_mbs1", 3),
+            ("llama2-tiny", "tp1_pp2_dp4_mbs1", 1),
+            ("llama3-8b", "tp2_pp1_dp4_mbs1_full_recompute", 3),
+        ]
+        sysc = get_system_config("tpu_v5p_256")
+        for model_name, strat, zero in cases:
+            m = get_model_config(model_name)
+            st = get_strategy_config(strat)
+            st.zero_state = zero
+            row = evaluate_strategy(st, m, sysc)
+            assert row is not None, (model_name, strat, zero)
+            bound = memory_lower_bound(st, m)
+            actual = row["peak_gib"] * (1024 ** 3)
+            assert bound <= actual, (model_name, strat, zero)
+
+    def test_dominance_prunes_recorded(self, tmp_path):
+        m, sysc, st = setup()
+        csv_path = tmp_path / "sweep.csv"
+        _sweep(m, sysc, st, tp_list=(1, 3), csv_path=str(csv_path))
+        reasons = {r["prune_reason"] for r in _csv_rows(csv_path)
+                   if r["status"] == "pruned"}
+        assert reasons == {"layout_indivisible"}
+
+    def test_gbs_indivisible_pruned(self):
+        m, sysc, st = setup()
+        cells, pruned = enumerate_cells(
+            st, m, sysc, 9, (1, 2), (1,), (1,), (1,), (1,), ("none",),
+        )
+        # neither dp=8 nor dp=4 divides gbs=9
+        assert cells == []
+        assert {r["prune_reason"] for r in pruned} == {"gbs_indivisible"}
+
+    def test_no_prune_keeps_legacy_silent_skips(self, tmp_path):
+        m, sysc, st = setup()
+        csv_path = tmp_path / "sweep.csv"
+        _sweep(m, sysc, st, tp_list=(1, 3), csv_path=str(csv_path),
+               prune=False)
+        assert all(r["status"] != "pruned" for r in _csv_rows(csv_path))
+
+    def test_pruned_cells_not_journaled(self, tmp_path):
+        m, sysc, st = setup()
+        journal = tmp_path / "sweep.jsonl"
+        _sweep(m, sysc, st, tp_list=(1, 3), journal_path=str(journal))
+        assert len(SweepJournal.load(str(journal))) == 1  # tp=1 only
+
+
+class TestBuildCacheParity:
+    CASES = [
+        # (strategy overrides applied on top of tp1_pp1_dp8_mbs1)
+        dict(),
+        dict(pp_size=2, world_size=8),
+        dict(enable_recompute=True, recompute_granularity="selective",
+             sdp_recompute=True),
+        dict(zero_state=3),
+        dict(pp_size=2, interleaving_size=2, world_size=8),
+    ]
+
+    @pytest.mark.parametrize("overrides", CASES)
+    def test_rebatch_matches_fresh_build(self, overrides):
+        """Evaluating a series of batch splits through the build cache
+        must produce rows identical to fresh builds."""
+        m = get_model_config("llama2-tiny")
+        sysc = get_system_config("tpu_v5e_256")
+        base = get_strategy_config("tp1_pp1_dp8_mbs1")
+        for k, v in overrides.items():
+            setattr(base, k, v)
+        base.__post_init__()
+        splits = [(1, 8), (2, 4), (1, 4), (4, 2)]
+        build_cache = BoundedCache(maxsize=4)
+        for mbs, mbc in splits:
+            st = copy.deepcopy(base)
+            st.micro_batch_size, st.micro_batch_num = mbs, mbc
+            fresh = evaluate_strategy(st, m, sysc)
+            cached = evaluate_strategy(st, m, sysc,
+                                       build_cache=build_cache)
+            assert (fresh is None) == (cached is None)
+            if fresh is None:
+                continue
+            for key in ("mfu", "iter_ms", "tgs", "peak_gib", "fits",
+                        "mbs", "mbc"):
+                assert fresh[key] == cached[key], (overrides, mbs, mbc, key)
+
+    def test_rebatch_rejects_non_batch_changes(self):
+        from simumax_tpu import PerfLLM
+
+        perf = PerfLLM().configure(
+            "tp1_pp1_dp8_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        perf.run_estimate()
+        st = copy.deepcopy(perf.strategy)
+        st.tp_size = 2
+        with pytest.raises(ValueError, match="rebatch"):
+            perf.rebatch(st)
+
+    def test_mbc_only_rebatch_skips_rerun(self):
+        from simumax_tpu import PerfLLM
+
+        perf = PerfLLM().configure(
+            "tp1_pp1_dp8_mbs1", "llama2-tiny", "tpu_v5e_256"
+        )
+        perf.run_estimate()
+        cost8 = perf.analysis_cost()["iter_time"]
+        st = copy.deepcopy(perf.strategy)
+        st.micro_batch_num = 4
+        chunks_before = perf.chunks
+        perf.rebatch(st)
+        assert perf.chunks is chunks_before  # no rebuild
+        cost4 = perf.analysis_cost()["iter_time"]
+        assert cost4 < cost8  # fewer microbatches -> shorter iteration
+
+
+class TestDeadlineFallback:
+    def test_off_main_thread_post_hoc_timeout(self, monkeypatch, tmp_path):
+        """Off the main thread SIGALRM is unavailable: the serial sweep
+        must quarantine an overrunning candidate post-hoc and warn about
+        the degraded enforcement, instead of silently disabling it."""
+        m, sysc, st = setup()
+        _inject_logged(
+            monkeypatch, {(2, "none"): "sleep"}, tmp_path / "log"
+        )
+        diag = Diagnostics()
+        result = {}
+
+        def run():
+            result["rows"] = _sweep(
+                m, sysc, st, tp_list=(1, 2), candidate_timeout=0.25,
+                diagnostics=diag,
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert result["rows"]  # tp=1 survived
+        assert len(diag.quarantined) == 1
+        evt = diag.quarantined[0]
+        assert evt.context["exception"] == "CandidateTimeoutError"
+        assert evt.context["enforcement"] == "post_hoc"
+        assert any("post-hoc" in w.message for w in diag.warnings)
+
+
+class TestSelectiveFallbackGuard:
+    def test_indivisible_gbs_raises_feasibility(self):
+        """The selective family's mbs=1 fallback must not synthesize a
+        wrong-GBS split when gbs does not divide over dp."""
+        m, sysc, st = setup()
+        st.tp_size = 1
+        with pytest.raises(FeasibilityError, match="does not divide"):
+            searcher_mod._evaluate_sweep_cell(
+                st, "selective", m, sysc, 12, {}, False,
+            )
+
+    def test_divisible_gbs_still_evaluates(self):
+        m, sysc, st = setup()
+        row = searcher_mod._evaluate_sweep_cell(
+            st, "selective", m, sysc, 8, {}, False,
+        )
+        assert row is None or row["mbs"] * row["mbc"] * row["dp"] == 8
+
+
+class TestBoundedCache:
+    def test_fifo_eviction(self):
+        c = BoundedCache(maxsize=3)
+        for i in range(5):
+            c[i] = i
+        assert len(c) == 3
+        assert list(c) == [2, 3, 4]
+
+    def test_update_respects_bound(self):
+        c = BoundedCache(maxsize=2)
+        c.update({1: 1, 2: 2, 3: 3})
+        assert len(c) == 2
