@@ -18,6 +18,14 @@ Usage::
     python bench_sweep.py --jobs 4        # pool run + serial baseline
     python bench_sweep.py --grid oversubscribed   # prune-heavy grid
     python bench_sweep.py --no-prune
+    python bench_sweep.py --jobs 4 --baseline BENCH_prev.json \
+        --max-regression 0.05     # regression gate (exit 1 on breach)
+
+The sweep always runs with the cost-attribution ledger OFF (sweeps never
+collect it — ledger collection is post-hoc and opt-in, see
+``docs/observability.md``); ``--baseline`` gates that the ledger-off
+throughput has not regressed more than ``--max-regression`` (default
+5%) against a previously saved bench JSON line.
 """
 
 import argparse
@@ -91,6 +99,16 @@ def main(argv=None):
                     help="pool width for the measured run (1 = serial)")
     ap.add_argument("--grid", choices=sorted(GRIDS), default="standard")
     ap.add_argument("--no-prune", action="store_true")
+    ap.add_argument(
+        "--baseline", metavar="JSON",
+        help="previously saved bench JSON line to gate against "
+             "(compares cells/sec at the same grid)",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=0.05, metavar="FRAC",
+        help="fail (exit 1) when cells/sec drops more than this "
+             "fraction below the baseline (default 0.05)",
+    )
     args = ap.parse_args(argv)
     spec = GRIDS[args.grid]
     prune = not args.no_prune
@@ -100,6 +118,9 @@ def main(argv=None):
         "metric": "sweep_cells_per_sec",
         "value": round(measured["cells_per_sec"], 2),
         "unit": "cells/s",
+        # sweeps never collect the attribution ledger; this run measures
+        # the ledger-off path the --baseline gate protects
+        "ledger": "off",
         "grid": args.grid,
         "cells": measured["cells"],
         "evaluated_cells": measured["evaluated"],
@@ -108,6 +129,7 @@ def main(argv=None):
             measured["pruned"] / measured["cells"], 3
         ) if measured["cells"] else 0.0,
         "jobs": args.jobs,
+        "prune": prune,
         "elapsed_s": round(measured["elapsed_s"], 3),
     }
     if args.jobs > 1:
@@ -127,8 +149,43 @@ def main(argv=None):
              r["recompute"]) for r in serial["rows"]
         ]
         result["topk_matches_serial"] = same
+    ok = True
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if "value" not in base or not isinstance(
+            base.get("value"), (int, float)
+        ):
+            # e.g. a saved {"error": ...} line from a prior failed gate
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field; re-record it with a plain "
+                         f"bench run",
+            }))
+            return 2
+        # the gate compares like with like: a --jobs 4 baseline vs a
+        # serial run (or prune on vs off) differs by 1.5-3x for reasons
+        # that have nothing to do with a code regression
+        for key, ours in (("grid", args.grid), ("jobs", args.jobs),
+                          ("prune", prune)):
+            theirs = base.get(key, ours)  # older baselines: assume ours
+            if theirs != ours:
+                print(json.dumps({
+                    "error": f"baseline {key} {theirs!r} != this run's "
+                             f"{ours!r}; not comparable — re-record the "
+                             f"baseline with matching flags",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression"] = (
+            round(1.0 - measured["cells_per_sec"] / base["value"], 4)
+            if base["value"] else 0.0
+        )
+        ok = measured["cells_per_sec"] >= floor
+        result["regression_ok"] = ok
     print(json.dumps(result))
-    return 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

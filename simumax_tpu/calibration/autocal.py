@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from simumax_tpu.calibration.timing import time_fn
 from simumax_tpu.core.errors import CalibrationError
 from simumax_tpu.core.records import Diagnostics
+from simumax_tpu.observe.report import get_reporter
 
 _DTYPES = {
     "bf16": jnp.bfloat16,
@@ -436,7 +437,8 @@ def calibrate_bandwidth_classes(system, verbose: bool = False,
         spec.efficient_factor = eff
         out[key] = eff
         if verbose:
-            print(f"[cal] bandwidth {key}: eff {eff:.3f}")
+            get_reporter().info(f"[cal] bandwidth {key}: eff {eff:.3f}",
+                                event="calibrate_bw", key=key, eff=eff)
     return out
 
 
@@ -531,13 +533,21 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
                         op_key=op_key, shape_key=shape_key,
                     )
                 if verbose:
-                    print(f"[cal] SKIP {op_key}: {shape_key} ({exc})")
+                    get_reporter().info(
+                        f"[cal] SKIP {op_key}: {shape_key} ({exc})",
+                        event="calibrate_skip", op_key=op_key,
+                        shape_key=shape_key,
+                    )
                 continue
             spec.accurate_efficient_factor[shape_key] = eff
             measured.setdefault(op_key, {})[shape_key] = eff
             count += 1
             if verbose:
-                print(f"[cal] {op_key}: {shape_key} -> {eff:.3f}")
+                get_reporter().info(
+                    f"[cal] {op_key}: {shape_key} -> {eff:.3f}",
+                    event="calibrate_key", op_key=op_key,
+                    shape_key=shape_key, eff=eff,
+                )
     # the functional optimizer is ~20-25% of a single-chip step: measure
     # its fused-update bandwidth class whenever the estimate relies on
     # an unmeasured fallback (miss-driven, same as the shape keys)
@@ -559,7 +569,11 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
                     shape_key="fused_adam",
                 )
             if verbose:
-                print(f"[cal] SKIP bandwidth fused_adam ({exc})")
+                get_reporter().info(
+                    f"[cal] SKIP bandwidth fused_adam ({exc})",
+                    event="calibrate_skip", op_key="bandwidth",
+                    shape_key="fused_adam",
+                )
             eff = None
         if eff is not None:
             system.accelerator.bandwidth["fused_adam"] = BandwidthSpec(
@@ -568,7 +582,10 @@ def calibrate_for_perf(perf, max_keys: Optional[int] = None,
             )
             measured.setdefault("bandwidth", {})["fused_adam"] = eff
             if verbose:
-                print(f"[cal] bandwidth fused_adam -> {eff:.3f}")
+                get_reporter().info(
+                    f"[cal] bandwidth fused_adam -> {eff:.3f}",
+                    event="calibrate_bw", key="fused_adam", eff=eff,
+                )
     return measured
 
 

@@ -22,6 +22,14 @@ failure); ``search`` additionally takes ``--journal`` / ``--resume``
 (process-pool cell evaluation, default ``os.cpu_count()``) and
 ``--no-prune`` (see ``docs/search.md``). Config-family errors exit 2
 with a one-line message instead of a traceback.
+
+Observability surface (see ``docs/observability.md``): ``explain``
+renders the MFU-loss waterfall + top-N op table from the
+cost-attribution ledger (``--json`` saves the full ledger, ``--csv``
+the op table, ``--trace`` a Chrome trace of the analytical schedule);
+``diff`` compares two saved ledgers. Every subcommand accepts
+``--log-level`` and ``--log-json`` (structured JSONL lines with a
+run_id instead of the human format).
 """
 
 from __future__ import annotations
@@ -41,6 +49,12 @@ def _ints(s: str):
     return tuple(int(x) for x in s.split(","))
 
 
+def _log():
+    from simumax_tpu.observe.report import get_reporter
+
+    return get_reporter()
+
+
 def _emit_diagnostics(diag, args):
     """Emit the diagnostics report — also on the failure path (a run
     that aborted is exactly the run the report must explain).
@@ -48,13 +62,21 @@ def _emit_diagnostics(diag, args):
     Writes the JSON to ``--diagnostics PATH`` when given (a compact
     summary goes to stdout), otherwise prints the full report as one
     ``[diagnostics]``-prefixed JSON line."""
+    if not diag.run_id:
+        # commands that never compute a content identity (perf,
+        # calibrate, dualpp, straggler) still get one joinable id:
+        # adopt the process reporter's, so --log-json lines and this
+        # report cross-reference by run_id like explain/search do
+        diag.adopt_run_id(_log().run_id)
     path = getattr(args, "diagnostics", None)
     if path:
         diag.write(path)
-        print(f"[diagnostics] {diag.summary_line()} -> {path}")
+        _log().info(f"[diagnostics] {diag.summary_line()} -> {path}",
+                    event="diagnostics", path=path)
     else:
-        print("[diagnostics] "
-              + json.dumps(diag.to_dict(), separators=(",", ":")))
+        _log().info("[diagnostics] "
+                    + json.dumps(diag.to_dict(), separators=(",", ":")),
+                    event="diagnostics")
 
 
 def _check_strict(diag, args):
@@ -95,10 +117,11 @@ def _diagnosed(diag, args):
 def cmd_list(args):
     from simumax_tpu.core.config import list_configs
 
+    log = _log()
     for kind, names in list_configs().items():
-        print(f"{kind}:")
+        log.info(f"{kind}:", event="config_kind", kind=kind)
         for n in names:
-            print(f"  {n}")
+            log.info(f"  {n}", event="config_name", kind=kind, name=n)
 
 
 def cmd_perf(args):
@@ -113,9 +136,11 @@ def cmd_perf(args):
         if args.simulate:
             with perf.diagnostics.capture(category="simulate"):
                 result = perf.simulate(args.simulate)
-            print(
+            _log().info(
                 f"simulated: {result['end_time_ms']:.2f} ms, "
-                f"trace at {result.get('trace_path')}"
+                f"trace at {result.get('trace_path')}",
+                event="simulate", end_time_ms=result["end_time_ms"],
+                trace_path=result.get("trace_path"),
             )
 
 
@@ -176,24 +201,29 @@ def _run_search(args, diag):
         )
     counters = diag.counters
     if counters.get("sweep_cells_pruned"):
-        print(
+        _log().info(
             f"[sweep] pruned {int(counters['sweep_cells_pruned'])}/"
             f"{int(counters['sweep_cells_total'])} cells before "
             f"evaluation (status=pruned rows in the CSV; --no-prune to "
-            f"evaluate everything)"
+            f"evaluate everything)",
+            event="sweep_pruned",
+            pruned=int(counters["sweep_cells_pruned"]),
+            total=int(counters["sweep_cells_total"]),
         )
     for r in rows:
         dual = ""
         if r.get("dualpp_mfu") is not None:
             fits = "fits" if r["dualpp_fits"] else "OOM"
             dual = f"  [DualPipe: {r['dualpp_mfu']*100:.2f}% {fits}]"
-        print(
+        _log().info(
             f"tp{r['tp']} cp{r['cp']} ep{r['ep']} pp{r['pp']} dp{r['dp']} "
             f"z{r['zero']} mbs{r['mbs']} mbc{r['mbc']} {r['recompute']}: "
             f"MFU {r['mfu']*100:.2f}%  iter {r['iter_ms']:.0f} ms  "
             f"peak {r['peak_gib']:.1f} GiB"
             + (f"  [DCN: {r['dcn_dims']}]" if r.get("dcn_dims") else "")
-            + dual
+            + dual,
+            event="search_row", mfu=r["mfu"], iter_ms=r["iter_ms"],
+            attribution=r.get("attribution"),
         )
 
 
@@ -229,22 +259,106 @@ def _run_calibrate(args, perf):
 
         n = len(jax.devices())
         if n < 2:
-            print("[cal] collectives: need >1 device, skipping")
+            _log().info("[cal] collectives: need >1 device, skipping",
+                        event="calibrate")
         else:
             mesh = make_mesh(n, tp=n)
             sweep = sweep_axis(mesh, "tp")
             update_system_from_sweep(perf.system, n, sweep)
             for op, fit in sweep.items():
-                print(f"[cal] {op}: {fit['fitted_bw_gbps']:.1f} GB/s, "
-                      f"{fit['fitted_latency_us']:.1f} us")
+                _log().info(
+                    f"[cal] {op}: {fit['fitted_bw_gbps']:.1f} GB/s, "
+                    f"{fit['fitted_latency_us']:.1f} us",
+                    event="calibrate_collective", op=op,
+                )
     measured = calibrate_system(
         perf, save_path=args.save, max_keys=args.max_keys, verbose=True,
         diagnostics=perf.diagnostics,
     )
     n = sum(len(v) for v in measured.values())
-    print(f"calibrated {n} shape keys"
-          + (f"; wrote {args.save}" if args.save else ""))
+    _log().info(f"calibrated {n} shape keys"
+                + (f"; wrote {args.save}" if args.save else ""),
+                event="calibrate_done", keys=n, save=args.save)
     perf.analysis()
+
+
+def cmd_explain(args):
+    from simumax_tpu import PerfLLM
+
+    perf = PerfLLM()
+    perf.diagnostics.strict = args.strict
+    with _diagnosed(perf.diagnostics, args):
+        _run_explain(args, perf)
+
+
+def _run_explain(args, perf):
+    import csv as _csv
+
+    from simumax_tpu.observe.trace import write_analytical_trace
+
+    log = _log()
+    perf.configure(args.strategy, args.model, args.system)
+    perf.run_estimate()
+    led = perf.ledger()
+    for line in led.waterfall_lines():
+        log.info(line, event="waterfall")
+    for line in led.top_op_lines(args.top):
+        log.info(line, event="top_ops")
+    miss = led.efficiency["miss_count"]
+    if miss:
+        log.info(
+            f"[calibration] {miss} efficiency-table misses contribute to "
+            f"these rows (MISS); `simumax_tpu calibrate` refines them",
+            event="explain_misses", misses=miss,
+        )
+    if args.json:
+        led.save(args.json)
+        log.info(f"ledger -> {args.json}", event="explain_ledger",
+                 path=args.json, run_id=led.meta["run_id"])
+    if args.csv:
+        rows = led.op_rows()
+        fields = [
+            "path", "category", "module_type", "stage", "chunk",
+            "fwd_time", "bwd_time", "net_exposed_time", "net_hidden_time",
+            "time", "share", "flops", "bytes_accessed", "efficiency",
+            "calibrated", "regime", "recompute",
+        ]
+        with open(args.csv, "w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            w.writeheader()
+            w.writerows(rows)
+        log.info(f"op table -> {args.csv}", event="explain_csv",
+                 path=args.csv, rows=len(rows))
+    if args.trace:
+        write_analytical_trace(perf, args.trace)
+        log.info(
+            f"analytical Chrome trace -> {args.trace} "
+            f"(load in chrome://tracing or ui.perfetto.dev)",
+            event="explain_trace", path=args.trace,
+        )
+
+
+def cmd_diff(args):
+    from simumax_tpu.observe.ledger import (
+        Ledger,
+        diff_ledgers,
+        format_diff_lines,
+    )
+
+    try:
+        a = Ledger.load(args.ledger_a)
+        b = Ledger.load(args.ledger_b)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        raise SystemExit(f"error: {exc}")
+    d = diff_ledgers(a, b, top=args.top)
+    log = _log()
+    for line in format_diff_lines(d, top=args.top):
+        log.info(line, event="diff")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(d, f, indent=1)
+        log.info(f"diff report -> {args.json}", event="diff_report",
+                 path=args.json)
 
 
 def cmd_dualpp(args):
@@ -263,24 +377,28 @@ def cmd_dualpp(args):
         )
     perf.run_estimate()
     res = perf.analysis_dualpp(save_path=args.plot)
-    print(
+    log = _log()
+    log.info(
         f"1F1B baseline  {res['baseline_iter_time'] * 1e3:9.1f} ms  "
-        f"peak {res['baseline_peak_gib']:.1f} GiB"
+        f"peak {res['baseline_peak_gib']:.1f} GiB",
+        event="dualpp_baseline",
     )
-    print(
+    log.info(
         f"DualPipe       {res['dualpp_iter_time'] * 1e3:9.1f} ms  "
         f"peak {res['max_peak_gib']:.1f} GiB  "
         f"(speedup {res['speedup']:.3f}x, projected MFU "
-        f"{res['projected_mfu'] * 100:.2f}%)"
+        f"{res['projected_mfu'] * 100:.2f}%)",
+        event="dualpp_projection", speedup=res["speedup"],
     )
     for r in res["ranks"]:
-        print(
+        log.info(
             f"  rank {r['rank']}: stages {r['stages']}  "
             f"bubble {r['bubble'] * 1e3:7.1f} ms  "
-            f"peak {r['peak_gib']:.1f} GiB"
+            f"peak {r['peak_gib']:.1f} GiB",
+            event="dualpp_rank", rank=r["rank"],
         )
     if args.plot:
-        print(f"F&B cell timeline -> {args.plot}")
+        log.info(f"F&B cell timeline -> {args.plot}", event="dualpp_plot")
 
 
 def cmd_straggler(args):
@@ -306,10 +424,11 @@ def cmd_straggler(args):
         )
     perf.run_estimate()
     res = analyze_stragglers(perf, slow)
-    print(
+    _log().info(
         f"baseline {res['baseline_ms']:.1f} ms -> perturbed "
         f"{res['perturbed_ms']:.1f} ms  (inflation {res['inflation']:.3f}, "
-        f"worst injected multiplier {res['worst_multiplier']:.2f})"
+        f"worst injected multiplier {res['worst_multiplier']:.2f})",
+        event="straggler", inflation=res["inflation"],
     )
 
 
@@ -320,9 +439,25 @@ def main(argv=None):
     )
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("list", help="list available configs").set_defaults(
-        fn=cmd_list
-    )
+    def _add_log_args(parser):
+        parser.add_argument(
+            "--log-level", default="info",
+            choices=("debug", "info", "warning", "error"),
+            help="minimum level for report lines (default: info). "
+                 "Results are emitted at info, so warning/error "
+                 "suppress the normal output too — useful only for "
+                 "fully quiet automation that reads --json/--csv/"
+                 "--diagnostics artifacts instead of stdout",
+        )
+        parser.add_argument(
+            "--log-json", action="store_true",
+            help="emit structured JSONL report lines (ts/level/run_id/"
+                 "msg + fields) instead of the human format",
+        )
+
+    pl = sub.add_parser("list", help="list available configs")
+    _add_log_args(pl)
+    pl.set_defaults(fn=cmd_list)
 
     def _add_diag_args(parser):
         parser.add_argument(
@@ -344,7 +479,42 @@ def main(argv=None):
     pp.add_argument("--simulate", help="run the event simulator; dir for trace")
     pp.add_argument("--graph", action="store_true", help="capture op graph")
     _add_diag_args(pp)
+    _add_log_args(pp)
     pp.set_defaults(fn=cmd_perf)
+
+    pe = sub.add_parser(
+        "explain",
+        help="MFU-loss waterfall + top-N op attribution for one config",
+    )
+    pe.add_argument("--model", required=True)
+    pe.add_argument("--strategy", required=True)
+    pe.add_argument("--system", required=True)
+    pe.add_argument("--top", type=int, default=10,
+                    help="rows in the top-op table (default 10)")
+    pe.add_argument("--json", metavar="PATH",
+                    help="save the full attribution ledger JSON "
+                         "(the input format of `simumax_tpu diff`)")
+    pe.add_argument("--csv", metavar="PATH",
+                    help="save the per-op attribution table as CSV")
+    pe.add_argument("--trace", metavar="PATH",
+                    help="save a Chrome/Perfetto trace of the analytical "
+                         "schedule (same UI as simulate() traces)")
+    _add_diag_args(pe)
+    _add_log_args(pe)
+    pe.set_defaults(fn=cmd_explain)
+
+    pdf = sub.add_parser(
+        "diff",
+        help="compare two saved attribution ledgers (explain --json)",
+    )
+    pdf.add_argument("ledger_a", help="baseline ledger JSON")
+    pdf.add_argument("ledger_b", help="comparison ledger JSON")
+    pdf.add_argument("--top", type=int, default=20,
+                     help="max per-op deltas to report (default 20)")
+    pdf.add_argument("--json", metavar="PATH",
+                     help="also save the structured diff report")
+    _add_log_args(pdf)
+    pdf.set_defaults(fn=cmd_diff)
 
     ps = sub.add_parser("search", help="sweep parallel strategies")
     ps.add_argument("--model", required=True)
@@ -391,6 +561,7 @@ def main(argv=None):
              "the sweep always has",
     )
     _add_diag_args(ps)
+    _add_log_args(ps)
     ps.set_defaults(fn=cmd_search)
 
     pc = sub.add_parser(
@@ -406,6 +577,7 @@ def main(argv=None):
     pc.add_argument("--collectives", action="store_true",
                     help="also sweep+fit collectives (needs >1 device)")
     _add_diag_args(pc)
+    _add_log_args(pc)
     pc.set_defaults(fn=cmd_calibrate)
 
     pd = sub.add_parser(
@@ -416,6 +588,7 @@ def main(argv=None):
     pd.add_argument("--strategy", required=True)
     pd.add_argument("--system", required=True)
     pd.add_argument("--plot", help="PNG path for the F&B cell timeline")
+    _add_log_args(pd)
     pd.set_defaults(fn=cmd_dualpp)
 
     pst = sub.add_parser(
@@ -429,9 +602,20 @@ def main(argv=None):
         "--ranks", required=True,
         help="rank:multiplier list, e.g. 0:1.2,5:1.5",
     )
+    _add_log_args(pst)
     pst.set_defaults(fn=cmd_straggler)
 
     args = p.parse_args(argv)
+    # the process-wide reporter carries the CLI's log surface; default
+    # settings keep the human output byte-identical to the bare prints
+    # it replaced
+    from simumax_tpu.observe.report import configure_reporter
+
+    configure_reporter(
+        level=getattr(args, "log_level", "info"),
+        json_lines=getattr(args, "log_json", False),
+        run_id="",
+    )
     # One-line actionable messages instead of tracebacks for the whole
     # anticipated-failure taxonomy (core/errors.py). Unanticipated bugs
     # still traceback — that is the right behavior for them.

@@ -1050,9 +1050,16 @@ class SystemConfig(ConfigBase):
     # Cost primitive (a): compute time with per-shape efficiency lookup
     # (reference ``compute_op_accuracy_time`` config.py:815-861)
     # ----------------------------------------------------------------------
-    def compute_op_accuracy_time(
-        self, op_key: str, flops: float, shape_key: Optional[str] = None
-    ) -> float:
+    def resolve_op_efficiency(
+        self, op_key: str, shape_key: Optional[str] = None,
+        record: bool = True,
+    ) -> Tuple[float, bool, Any]:
+        """The efficiency lookup of :meth:`compute_op_accuracy_time`:
+        ``(efficiency_used, calibrated_hit, spec)``. ``record=False``
+        skips the hit/miss bookkeeping — the side-effect-free variant
+        the cost-attribution ledger uses to re-derive exactly the
+        provenance the estimate charged (one lookup implementation, so
+        the two can never disagree)."""
         spec: CompOpSpec = self.accelerator.op.get(op_key) or self.accelerator.op["default"]
         eff = spec.efficient_factor
         hit = False
@@ -1060,7 +1067,14 @@ class SystemConfig(ConfigBase):
             if shape_key in spec.accurate_efficient_factor:
                 eff = spec.accurate_efficient_factor[shape_key]
                 hit = True
-            self._record_eff(op_key, shape_key, eff, hit)
+            if record:
+                self._record_eff(op_key, shape_key, eff, hit)
+        return eff, hit, spec
+
+    def compute_op_accuracy_time(
+        self, op_key: str, flops: float, shape_key: Optional[str] = None
+    ) -> float:
+        eff, _hit, spec = self.resolve_op_efficiency(op_key, shape_key)
         if flops <= 0:
             return 0.0
         return flops / (spec.tflops * 1e12 * eff)
@@ -1172,10 +1186,31 @@ class SystemConfig(ConfigBase):
         scaling a 2D torus actually provides. p2p is a single-link
         neighbour transfer (XLA collective-permute).
         """
+        bw_t, lat_t = self.compute_net_op_terms(op, size_bytes, path,
+                                                comm_num)
+        t = bw_t + lat_t
+        if t > 0:
+            self._record_bw(path.dim, op, size_bytes / t / 1e9)
+        return t
+
+    def compute_net_op_terms(
+        self,
+        op: str,
+        size_bytes: float,
+        path: CommPath,
+        comm_num: Optional[int] = None,
+    ) -> Tuple[float, float]:
+        """The collective cost model, decomposed into its
+        ``(bandwidth_time, latency_time)`` terms — the single
+        implementation :meth:`compute_net_op_time` sums (plus its
+        ``real_comm_bw`` recording side effect), and the per-collective
+        provenance the cost-attribution ledger records so a mispredicted
+        collective can be triaged to the wire rate vs the hop/launch
+        latency model. Side-effect free."""
         assert op in NET_OPS, op
         n = path.group_size if comm_num is None else comm_num
         if n <= 1 or size_bytes <= 0 or not path.spans:
-            return 0.0
+            return 0.0, 0.0
         spans = path.spans
 
         def stage_bw(span: Span) -> float:
@@ -1186,16 +1221,18 @@ class SystemConfig(ConfigBase):
             spec = self._op_spec(span, op)
             return (span.latency_us * hops + spec.latency_us) * 1e-6
 
-        t = 0.0
+        bw_t = lat_t = 0.0
         if op in ("all_gather", "reduce_scatter", "all_reduce"):
             phases = 2 if op == "all_reduce" else 1
             # hierarchical AG: volume per chip grows axis by axis
             held = size_bytes / n
             for span in spans:
                 recv = held * (span.extent - 1)
-                t += recv / stage_bw(span) + stage_lat(span, span.extent - 1)
+                bw_t += recv / stage_bw(span)
+                lat_t += stage_lat(span, span.extent - 1)
                 held *= span.extent
-            t *= phases
+            bw_t *= phases
+            lat_t *= phases
         elif op == "all2all":
             # each chip holds size/n and re-shards it along every axis in
             # turn; a ring a2a of per-chip volume v over e chips costs
@@ -1203,17 +1240,16 @@ class SystemConfig(ConfigBase):
             # torus via the hierarchical decomposition)
             local = size_bytes / n
             for span in spans:
-                t += (local * span.extent / 4.0) / stage_bw(span)
-                t += stage_lat(span, span.extent / 2.0)
+                bw_t += (local * span.extent / 4.0) / stage_bw(span)
+                lat_t += stage_lat(span, span.extent / 2.0)
         elif op == "p2p":
-            span = spans[0]
             # neighbour transfer rides one link direction
+            span = spans[0]
             spec = self._op_spec(span, op)
             link = (span.gbps / (2.0 if span.wrap else 1.0)) * 1e9
-            t = size_bytes / (link * spec.efficient_factor) + stage_lat(span, 1.0)
-        if t > 0:
-            self._record_bw(path.dim, op, size_bytes / t / 1e9)
-        return t
+            bw_t = size_bytes / (link * spec.efficient_factor)
+            lat_t = stage_lat(span, 1.0)
+        return bw_t, lat_t
 
     # ----------------------------------------------------------------------
     # Cost primitive (d): roofline combiner

@@ -69,6 +69,12 @@ class MetaModule:
     the leaf template methods (ops)."""
 
     is_leaf = False
+    #: op-family tag for the cost-attribution ledger (``observe/ledger``):
+    #: leaf classes override at class level (gemm / attention / norm /
+    #: moe_dispatch / ...); composites may re-tag child instances (e.g.
+    #: MLA marks its up-projections so the ``mla_up_proj`` recompute
+    #: knob's target is visible in ``explain`` output)
+    op_category = "other"
 
     def __init__(self, ctx: BuildContext, name: str = ""):
         self.ctx = ctx
@@ -442,6 +448,8 @@ class GemmBase(LeafModule):
     efficiency-lookup keys per backprop stage. On TPU the layout tag
     records the contraction structure XLA sees, and the low-precision path
     is int8 (native MXU) rather than fp8."""
+
+    op_category = "gemm"
 
     def __init__(self, ctx, name="", quantized: bool = False):
         super().__init__(ctx, name)

@@ -12,9 +12,11 @@ from __future__ import annotations
 
 import contextlib
 import enum
+import hashlib
 import json
+import time as _time
 import warnings as _warnings
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
 from simumax_tpu.core.errors import SimuMaxError, _json_safe
@@ -219,15 +221,80 @@ class CostInfo:
 
 
 @dataclass
+class OpSpan:
+    """One cost decision of the analytical estimate: a leaf op in one
+    backprop phase, with full provenance — enough to audit the predicted
+    time against a real run (the cost-attribution ledger's compute-side
+    record, see ``observe/ledger.py`` and ``docs/observability.md``).
+
+    Times are per-microbatch, per-device seconds, exactly the numbers
+    ``PerfLLM`` summed into the headline estimate."""
+
+    path: str  # module path, e.g. stage0_chunk0.layer0.attention.qkv_proj
+    module_type: str  # leaf class name (LinearCol, CoreAttention, ...)
+    category: str  # op family tag (gemm | attention | norm | ...)
+    stage: int
+    chunk: int
+    phase: str  # fwd | bwd_act | bwd_w
+    op_key: str  # efficiency table consulted (matmul, sdp_fwd, default...)
+    shape_key: Optional[str]  # canonical shape key, None for flat ops
+    flops: float
+    bytes_accessed: float
+    comp_time: float  # FLOPs / (peak * efficiency)
+    mem_time: float  # bytes / (bw * efficiency) + latency
+    time: float  # rooflined max(comp, mem) — what the estimate charged
+    efficiency: float  # the factor actually used
+    calibrated: bool  # True = per-shape calibrated hit, False = table miss
+    regime: str  # compute | memory — which roofline side bound the op
+    recompute: bool  # leaf belongs to a checkpointed segment
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+@dataclass
+class CollectiveSpan:
+    """One collective issued by a leaf, with its cost decomposed into
+    bandwidth and latency terms and exposed-vs-overlapped accounting
+    (the ledger's comm-side record)."""
+
+    path: str
+    stage: int
+    chunk: int
+    phase: str
+    op: str  # all_gather | reduce_scatter | all_reduce | all2all | p2p
+    dim: str  # parallel dim (tp/cp/dp_cp/ep/etp/edp/pp)
+    size_bytes: float  # full logical tensor (net-op contract)
+    time: float  # total collective time
+    exposed_time: float  # serialized portion on the critical path
+    hidden_time: float  # overlapped portion
+    bw_time: float  # bandwidth-proportional term
+    lat_time: float  # hop/launch latency term
+    on_dcn: bool  # path crosses the data-center network
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+@dataclass
 class DiagnosticEvent:
     """One diagnostic fact: a funneled warning, a quarantined candidate,
     a calibration skip. ``context`` carries structured coordinates
-    (candidate key, op/shape key, phase...)."""
+    (candidate key, op/shape key, phase...).
+
+    ``ts`` is ``time.monotonic()`` at creation — CLOCK_MONOTONIC is
+    system-wide on Linux, so events merged from sweep worker processes
+    on the same host order correctly. ``run_id`` is the run identity the
+    owning collector was stamped with (the same identity the sweep
+    journal carries), so merged cross-process diagnostics stay
+    attributable to their run."""
 
     severity: str  # "warning" | "error"
     category: str  # e.g. "config", "placement", "calibration", "quarantine"
     message: str
     context: Dict[str, Any] = field(default_factory=dict)
+    ts: float = field(default_factory=_time.monotonic)
+    run_id: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -235,6 +302,8 @@ class DiagnosticEvent:
             "category": self.category,
             "message": self.message,
             "context": _json_safe(self.context),
+            "ts": self.ts,
+            "run_id": self.run_id,
         }
 
 
@@ -258,8 +327,12 @@ class Diagnostics:
     #: collector without threading it through every call signature
     _active: List["Diagnostics"] = []
 
-    def __init__(self, strict: bool = False):
+    def __init__(self, strict: bool = False, run_id: str = ""):
         self.strict = strict
+        #: run identity stamped onto every recorded event (see
+        #: :meth:`set_run_identity`); empty until a run claims the
+        #: collector (the CLI, a sweep, a worker merging upstream)
+        self.run_id = run_id
         self.events: List[DiagnosticEvent] = []
         self._dedup: Dict[tuple, DiagnosticEvent] = {}
         self._eff_hits: Dict[str, set] = {}
@@ -283,6 +356,39 @@ class Diagnostics:
         finally:
             Diagnostics._active.pop()
 
+    @staticmethod
+    def identity_hash(identity: Any) -> str:
+        """Stable short hash of a run-identity payload (e.g. the sweep
+        journal's header dict): the same identity always maps to the
+        same ``run_id``, so a resumed sweep's events merge with the
+        original run's under one identity."""
+        blob = json.dumps(_json_safe(identity), sort_keys=True,
+                          default=str).encode()
+        return hashlib.sha256(blob).hexdigest()[:12]
+
+    def adopt_run_id(self, run_id: str) -> str:
+        """Take over an externally chosen run_id (e.g. the process
+        reporter's, for commands that never compute a content
+        identity), backfilling events recorded before it was known."""
+        self.run_id = run_id
+        for e in self.events:
+            if not e.run_id:
+                e.run_id = run_id
+        return run_id
+
+    def set_run_identity(self, identity: Any) -> str:
+        """Stamp this collector with the hash of ``identity``. Events
+        recorded before the identity was known (config capture happens
+        before a sweep computes its identity) are backfilled, and the
+        process-wide reporter joins the same identity so ``--log-json``
+        lines, the diagnostics report, and the attribution ledger of
+        one run all cross-reference by run_id. Returns the run_id."""
+        self.adopt_run_id(self.identity_hash(identity))
+        from simumax_tpu.observe.report import get_reporter
+
+        get_reporter().configure(run_id=self.run_id)
+        return self.run_id
+
     # -- recording ---------------------------------------------------------
     def _record(self, event: DiagnosticEvent, n: int = 1):
         # a sweep repeats the same warning for thousands of candidates:
@@ -290,6 +396,8 @@ class Diagnostics:
         # never collapse across distinct coordinates (candidate / table
         # key). ``n > 1`` merges an already-collapsed fact (a worker's
         # deduped event) without losing its count.
+        if not event.run_id:
+            event.run_id = self.run_id
         ctx = event.context
         key = (event.severity, event.category, event.message,
                ctx.get("candidate"), ctx.get("op_key"), ctx.get("shape_key"))
@@ -343,11 +451,17 @@ class Diagnostics:
         for ev in events:
             ctx = dict(ev.get("context") or {})
             n = ctx.pop("count", 1) or 1
+            # keep the worker's own timestamp (CLOCK_MONOTONIC is
+            # system-wide: cross-process events stay orderable) and its
+            # run identity when it stamped one; otherwise the merged
+            # event inherits this collector's identity via _record
             self._record(DiagnosticEvent(
                 ev.get("severity", "warning"),
                 ev.get("category", ""),
                 ev.get("message", ""),
                 ctx,
+                ts=ev.get("ts") or _time.monotonic(),
+                run_id=ev.get("run_id", ""),
             ), n=int(n))
 
     def record_efficiency(self, system):
@@ -421,6 +535,7 @@ class Diagnostics:
         return {
             "schema": self.SCHEMA,
             "strict": self.strict,
+            "run_id": self.run_id,
             "counts": {
                 "warnings": len(self.warnings),
                 "errors": len(self.errors),

@@ -39,6 +39,8 @@ def _st(ctx: BuildContext):
 class AddFunction(LeafModule):
     """Residual add: memory-bound, no cache (bwd is fan-out passthrough)."""
 
+    op_category = "elementwise"
+
     def forward_spec(self, a: TensorSpec, b: TensorSpec) -> TensorSpec:
         assert a.shape == b.shape, (a.shape, b.shape)
         return a.with_shape(*a.shape)
@@ -51,6 +53,8 @@ class AddFunction(LeafModule):
 class SplitFunction(LeafModule):
     """Split last dim into parts; zero-cost shape op."""
 
+    op_category = "elementwise"
+
     def __init__(self, ctx, sizes, name=""):
         super().__init__(ctx, name)
         self.sizes = sizes
@@ -61,6 +65,8 @@ class SplitFunction(LeafModule):
 
 
 class ConcatFunction(LeafModule):
+    op_category = "elementwise"
+
     def __init__(self, ctx, dim=-1, name=""):
         super().__init__(ctx, name)
         self.dim = dim
@@ -84,6 +90,8 @@ class Embedding(LeafModule):
     """TP-sharded vocab embedding (reference ``dense_module.py:18-193``):
     fwd TP all-reduce (or SP reduce-scatter); bwd-W all-gather under SP;
     ZeRO-1 state sharding."""
+
+    op_category = "embedding"
 
     def __init__(self, ctx, name="embedding"):
         super().__init__(ctx, name)
@@ -139,6 +147,8 @@ class Embedding(LeafModule):
 class LayerNorm(LeafModule):
     """RMS/LayerNorm (reference ``dense_module.py:784-995``): memory-bound,
     caches its input; weight is dense state."""
+
+    op_category = "norm"
 
     def __init__(self, ctx, hidden=None, name="norm"):
         super().__init__(ctx, name)
@@ -407,6 +417,8 @@ class RotaryEmbedding(LeafModule):
     """RoPE application to q,k: memory-bound (reference
     ``dense_module.py:1806-1873``)."""
 
+    op_category = "rope"
+
     def forward_spec(self, q: TensorSpec, k: TensorSpec):
         return q, k
 
@@ -423,6 +435,8 @@ class CoreAttention(LeafModule):
 
     Inputs q,k,v are per-device: ``[b, sq, hl, d]`` / ``[b, skv, kvl, d]``.
     """
+
+    op_category = "attention"
 
     def __init__(self, ctx, head_dim_v=None, name="core_attention"):
         super().__init__(ctx, name)
@@ -532,6 +546,8 @@ class ContextParallelA2A(LeafModule):
     each is the opposite a2a with the same volume, so fwd/bwd sizes match.
     """
 
+    op_category = "comm"
+
     def __init__(self, ctx, direction="scatter_heads", name="cp_a2a"):
         super().__init__(ctx, name)
         self.direction = direction
@@ -599,6 +615,8 @@ class Dropout(LeafModule):
     embedding-output + both residual-branch sites, the standard
     Megatron recipe.)"""
 
+    op_category = "elementwise"
+
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         return x
 
@@ -615,6 +633,8 @@ class SeqAllGather(LeafModule):
     """Gather a seq-sharded tensor over a parallel dim (fwd all-gather,
     bwd-act reduce-scatter) — used for e.g. the MLA RoPE branch whose
     producer is a replicated linear outside the column-parallel gather."""
+
+    op_category = "comm"
 
     def __init__(self, ctx, dim="tp", name="seq_allgather"):
         super().__init__(ctx, name)
@@ -663,6 +683,8 @@ class Swiglu(LeafModule):
     one extra per-token fp32 prob is read each phase and cached for the
     backward's dL/dprob term."""
 
+    op_category = "activation"
+
     def __init__(self, ctx, name="swiglu", weighted: bool = False):
         super().__init__(ctx, name)
         self.weighted = weighted
@@ -688,6 +710,8 @@ class Swiglu(LeafModule):
 
 
 class Gelu(LeafModule):
+    op_category = "activation"
+
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         return x
 
@@ -704,6 +728,8 @@ class ParallelCE(LeafModule):
     three TP all-reduces of ``[b, s]`` fp32 scalars (max, predicted logit,
     sum-exp); the fused variant batches two into one collective and keeps
     only the bf16 logits cached."""
+
+    op_category = "loss"
 
     def forward_spec(self, logits: TensorSpec) -> TensorSpec:
         b, s, v = logits.shape

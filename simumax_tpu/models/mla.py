@@ -57,6 +57,15 @@ class MLAAttention(MetaModule):
             "kv_up",
             quantized=quantized,
         )
+        # ledger tags: keep the low-rank latent path distinguishable from
+        # generic GEMMs in `explain` output — the mla_up_proj recompute
+        # knob targets exactly the "mla_up_proj" rows, and the down/up
+        # split is the first thing a DeepSeek-shape misprediction triage
+        # looks at (docs/observability.md)
+        for mod in ([self.q_up] if m.q_lora_rank else []) + [self.kv_up]:
+            mod.op_category = "mla_up_proj"
+        for mod in ([self.q_down] if m.q_lora_rank else []) + [self.kv_down]:
+            mod.op_category = "mla_down_proj"
         if st.enable_sequence_parallel and st.tp_size > 1:
             self.rope_gather = SeqAllGather(ctx, "tp", "rope_k_gather")
         self.rope = RotaryEmbedding(ctx, name="rope")

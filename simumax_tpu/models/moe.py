@@ -44,6 +44,8 @@ class Router(LeafModule):
     """MoE gating (reference ``moe_module.py:20-213``): replicated linear
     ``h -> E`` + top-k; logits/probs kept fp32."""
 
+    op_category = "router"
+
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         b, s, h = x.shape
         return TensorSpec((b, s, self.ctx.model.expert_num), "fp32")
@@ -77,6 +79,8 @@ class Permutation(LeafModule):
     expert order (memory-bound, ``permute_fwd`` bandwidth class) + EP
     all-to-all; ETP all-gather when experts are tensor-parallel with SP.
     """
+
+    op_category = "moe_dispatch"
 
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         st = _st(self.ctx)
@@ -137,6 +141,8 @@ class Permutation(LeafModule):
 class UnPermutation(LeafModule):
     """Token combine (reference ``moe_module.py:531-834``): inverse EP
     all-to-all + weighted unpermute back to the original order."""
+
+    op_category = "moe_dispatch"
 
     def forward_spec(self, x: TensorSpec) -> TensorSpec:
         st = _st(self.ctx)

@@ -1,0 +1,20 @@
+"""Observability layer (L8-adjacent): the cost-attribution ledger, the
+MFU-loss waterfall, ledger diffing, the analytical Chrome-trace export,
+and the shared structured reporter.
+
+See ``docs/observability.md`` for the ledger schema, the waterfall
+bucket definitions, and a worked misprediction-triage example.
+"""
+
+from simumax_tpu.observe.ledger import Ledger, attribution_line, build_waterfall, diff_ledgers
+from simumax_tpu.observe.report import Reporter, configure_reporter, get_reporter
+
+__all__ = [
+    "Ledger",
+    "Reporter",
+    "attribution_line",
+    "build_waterfall",
+    "configure_reporter",
+    "diff_ledgers",
+    "get_reporter",
+]

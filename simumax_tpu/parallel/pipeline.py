@@ -29,6 +29,19 @@ def one_f_one_b_order(pp: int, stage: int, mbc: int) -> List[Tuple[str, int]]:
     return ops
 
 
+def single_stage_order(mbc: int) -> List[Tuple[str, int]]:
+    """Degenerate pp=1 "schedule": each microbatch's backward follows
+    its forward immediately (no inter-stage dependencies, so 1F1B
+    reduces to F0 B0 F1 B1 ...). Shared by the analytical-trace export
+    and the pp=1 fast path of ``PerfLLM.calculate_1f1b_bubble`` so the
+    trace lays out exactly the op stream the estimate charged."""
+    ops: List[Tuple[str, int]] = []
+    for i in range(mbc):
+        ops.append(("F", i))
+        ops.append(("B", i))
+    return ops
+
+
 def interleaved_order(
     pp: int, stage: int, mbc: int, vp: int, group_size: int = 0
 ) -> List[Tuple[str, int, int]]:

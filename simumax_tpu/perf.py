@@ -185,6 +185,12 @@ class PerfLLM(PerfBase):
         self._cost_result = None
         self._interleaved_result = None
         self._dp_time_cache: Dict[int, dict] = {}
+        #: per-op schedule intervals of the last analysis_cost replay:
+        #: [(stage, kind, chunk, mb, start, end)] — the analytical
+        #: trace export (observe/trace.py) lays these out as Chrome
+        #: trace slices; kept off the result dict so saved JSONs stay
+        #: headline-sized
+        self._schedule_events: List[tuple] = []
 
     # ------------------------------------------------------------------
     # Net placement (reference ``analysis_net`` perf_llm.py:369-474)
@@ -350,6 +356,7 @@ class PerfLLM(PerfBase):
         self._cost_result = None
         self._interleaved_result = None
         self._dp_time_cache = {}
+        self._schedule_events = []
         return self
 
     #: strategy fields the built chunk graph does NOT depend on — they
@@ -392,6 +399,7 @@ class PerfLLM(PerfBase):
         self._cost_result = None
         self._interleaved_result = None
         self._dp_time_cache = {}
+        self._schedule_events = []
         if rerun:
             return self.estimate()
         return self
@@ -480,9 +488,17 @@ class PerfLLM(PerfBase):
         st = self.strategy
         pp, mbc = st.pp_size, st.micro_batch_num
         if pp == 1:
+            from simumax_tpu.parallel.pipeline import single_stage_order
+
             ph = phase_inputs[0]
+            events, t = [], 0.0
+            for kind, i in single_stage_order(mbc):
+                d = ph["fwd"] if kind == "F" else ph["bwd"]
+                events.append((0, kind, 0, i, t, t + d))
+                t += d
             total = mbc * (ph["fwd"] + ph["bwd"])
-            return {"total": total, "bubble": 0.0, "per_stage_end": [total]}
+            return {"total": total, "bubble": 0.0, "per_stage_end": [total],
+                    "events": events}
 
         # standard Megatron 1F1B op order per stage (shared with the
         # event simulator so the cross-check cannot desynchronize)
@@ -497,6 +513,7 @@ class PerfLLM(PerfBase):
         F_end = [[None] * mbc for _ in range(pp)]
         B_end = [[None] * mbc for _ in range(pp)]
         stage_clock = [0.0] * pp
+        events: List[tuple] = []  # (stage, kind, chunk, mb, start, end)
         # iterate op queues round-robin until all done (dependencies always
         # resolvable because 1F1B is deadlock-free)
         idx = [0] * pp
@@ -517,6 +534,7 @@ class PerfLLM(PerfBase):
                         start = max(stage_clock[s], dep + (ph["p2p"] if s > 0 else 0.0))
                         end = start + ph["fwd"]
                         F_end[s][i] = end
+                        events.append((s, "F", 0, i, start, end))
                         if s < pp - 1:
                             end += blocking  # blocking isend stalls sender
                     else:
@@ -528,6 +546,7 @@ class PerfLLM(PerfBase):
                         )
                         end = start + ph["bwd"]
                         B_end[s][i] = end
+                        events.append((s, "B", 0, i, start, end))
                         if s > 0:
                             end += blocking
                     stage_clock[s] = end
@@ -543,6 +562,7 @@ class PerfLLM(PerfBase):
             "total": total,
             "bubble": total - work0,
             "per_stage_end": per_stage_end,
+            "events": events,
         }
 
     def calculate_interleaved_schedule(self) -> dict:
@@ -584,6 +604,7 @@ class PerfLLM(PerfBase):
         F_end: Dict[tuple, float] = {}
         B_end: Dict[tuple, float] = {}
         clock = [0.0] * pp
+        events: List[tuple] = []  # (stage, kind, chunk, mb, start, end)
         idx = [0] * pp
         remaining = sum(len(o) for o in orders)
         while remaining:
@@ -604,6 +625,7 @@ class PerfLLM(PerfBase):
                         start = max(clock[s], dep + (p2p if (s > 0 or c > 0) else 0.0))
                         end = start + fwd_t[(s, c)]
                         F_end[(s, c, mb)] = end
+                        events.append((s, "F", c, mb, start, end))
                         if s < pp - 1 or c < vp - 1:
                             end += blocking  # blocking isend stalls sender
                     else:
@@ -621,6 +643,7 @@ class PerfLLM(PerfBase):
                         )
                         end = start + bwd_t[(s, c)]
                         B_end[(s, c, mb)] = end
+                        events.append((s, "B", c, mb, start, end))
                         if s > 0 or c > 0:
                             end += blocking
                     clock[s] = end
@@ -637,6 +660,7 @@ class PerfLLM(PerfBase):
             "bubble": total - work0,
             "per_stage_end": clock,
             "orders": orders,
+            "events": events,
         }
         return self._interleaved_result
 
@@ -907,6 +931,9 @@ class PerfLLM(PerfBase):
             pp_res.pop("orders", None)
         else:
             pp_res = self.calculate_1f1b_bubble(phase_inputs)
+        # per-op intervals feed the analytical trace export, not the
+        # (JSON-saved) result dict
+        self._schedule_events = pp_res.pop("events", [])
         # stages differ in params (embedding/head, MoE dense_layers), so
         # the iteration ends on the *max path*: each stage finishes its
         # backward, exposes its grad comm, all ranks barrier before the
@@ -973,6 +1000,14 @@ class PerfLLM(PerfBase):
             "stage_phase_inputs": phase_inputs,
             "net_exposed_per_microbatch": net_exposed,
             "time_breakdown": breakdown,
+            # attribution provenance (observe/ledger.py waterfall): the
+            # schedule's per-stage finish times and the two binding
+            # (max-path) stages the iteration end actually rode on
+            "per_stage_end": list(ends),
+            "binding_stage_rs": s_rs,
+            "binding_stage_tail": s_tail,
+            "exposed_rs_time": dp_by_stage[s_rs]["exposed_rs"],
+            "exposed_ag_time": dp_by_stage[s_tail]["exposed_ag"],
         }
         self._cost_result = result
         return result
@@ -1051,29 +1086,51 @@ class PerfLLM(PerfBase):
         return result
 
     def _print_summary(self, result: dict):
+        from simumax_tpu.observe.report import get_reporter
+
+        log = get_reporter()
         cost, mem = result["compute_result"], result["mem_result"]
         info = result["base_info"]
         p = info["parallelism"]
-        print(
+        log.info(
             f"== {info['model']} on {info['system']} "
             f"(world={info['world_size']} tp={p['tp']} cp={p['cp']} "
-            f"pp={p['pp']} dp={p['dp']} ep={p['ep']}) =="
+            f"pp={p['pp']} dp={p['dp']} ep={p['ep']}) ==",
+            event="perf_header", model=info["model"], system=info["system"],
         )
-        print(
+        log.info(
             f"iter time {human_time(cost['iter_time'])}  "
             f"MFU {cost['mfu']*100:.2f}%  "
             f"TFLOPS/chip {cost['tflops_per_chip']:.1f}  "
-            f"TGS {cost['tgs']:.1f}"
+            f"TGS {cost['tgs']:.1f}",
+            event="perf_cost", iter_time_ms=cost["iter_time_ms"],
+            mfu=cost["mfu"], tgs=cost["tgs"],
         )
-        print(
+        log.info(
             f"peak HBM {mem['max_peak_gib']:.2f} GiB / "
-            f"{mem['hbm_capacity_gib']:.0f} GiB  fits={mem['fits']}"
+            f"{mem['hbm_capacity_gib']:.0f} GiB  fits={mem['fits']}",
+            event="perf_mem", peak_gib=mem["max_peak_gib"],
+            fits=mem["fits"],
         )
         misses = result["efficiency_misses"]
         if misses:
             nmiss = sum(len(v) for v in misses.values())
-            print(f"[calibration] {nmiss} efficiency-table misses "
-                  f"(run simumax_tpu.calibration to refine)")
+            log.info(
+                f"[calibration] {nmiss} efficiency-table misses "
+                f"(run simumax_tpu.calibration to refine)",
+                event="perf_misses", misses=nmiss,
+            )
+
+    def ledger(self):
+        """Collect the cost-attribution ledger of the current estimate
+        (see ``observe/ledger.py`` / ``docs/observability.md``): per-op
+        and per-collective spans with efficiency provenance, the
+        MFU-loss waterfall, and the headline summary. Post-hoc over the
+        retained symbolic tree — calling it never changes the estimate
+        (ledger-on and ledger-off predictions are bit-identical)."""
+        from simumax_tpu.observe.ledger import Ledger
+
+        return Ledger.collect(self)
 
     # simulate() is provided by L5 (simulator package); bound lazily
     def simulate(self, save_path: Optional[str] = None, **kwargs):

@@ -324,6 +324,8 @@ def evaluate_strategy(
         fits = mem["max_peak_bytes"] + gib_margin * GiB <= (
             system.mem_bytes * strategy.mem_factor
         )
+        from simumax_tpu.observe.ledger import attribution_line
+
         row = {
             "tp": strategy.tp_size, "cp": strategy.cp_size,
             "pp": strategy.pp_size, "dp": strategy.dp_size,
@@ -347,6 +349,12 @@ def evaluate_strategy(
             "dcn_dims": ",".join(
                 d for d, p in perf.ctx.paths.items() if p.on_dcn
             ),
+            # one-line MFU-loss attribution (observe/ledger.py): where
+            # this candidate's step time went, so a sweep CSV row can be
+            # triaged without re-running `explain` on it. Derived from
+            # the already-cached analyses — no ledger is built (sweeps
+            # stay on the zero-cost path).
+            "attribution": attribution_line(perf),
         }
         # DualPipe projection for eligible layouts (reuses the cached
         # analyses; no re-estimate) — lets a sweep surface candidates
@@ -633,6 +641,11 @@ def search_best_parallel_strategy(
             for f in _KEY_FIELDS if f not in _SWEPT_FIELDS
         },
     }, default=str, sort_keys=True))
+    # events recorded from here on (and merged back from workers) carry
+    # the sweep's run identity — the same identity the journal header
+    # stamps, so diagnostics and journal rows cross-attribute
+    if not diagnostics.run_id:
+        diagnostics.set_run_identity(identity)
     resumed: Dict[str, dict] = {}
     if resume:
         if not os.path.exists(resume):
@@ -703,13 +716,17 @@ def search_best_parallel_strategy(
                                    row=outcome.row, error=outcome.error)
                 row = outcome.row
                 if verbose and row and row.get("fits"):
+                    from simumax_tpu.observe.report import get_reporter
+
                     # progress streams as cells finish, like the old
                     # serial loop (completion order under --jobs)
-                    print(
+                    get_reporter().info(
                         f"tp{row['tp']} cp{row['cp']} ep{row['ep']} "
                         f"pp{row['pp']} {row['recompute']}: "
                         f"mfu {row['mfu']*100:.2f}% "
-                        f"peak {row['peak_gib']:.1f} GiB"
+                        f"peak {row['peak_gib']:.1f} GiB",
+                        event="sweep_cell", mfu=row["mfu"],
+                        attribution=row.get("attribution"),
                     )
 
             # replayed cells ride the journal, not the executor —

@@ -32,7 +32,17 @@ def to_chrome_trace(
     max_counter_samples: int = 4000,
 ) -> dict:
     out = []
-    for rank in sorted({e.rank for e in events}):
+    # a flow arrow needs both ends: a send whose recv never waited (data
+    # already arrived -> no wait event) must not emit a dangling `s`
+    # (Perfetto drops or mis-renders unpaired arrows)
+    send_ids = {e.flow_id for e in events
+                if e.kind == "p2p" and e.flow_id is not None}
+    wait_ids = {e.flow_id for e in events
+                if e.kind == "wait" and e.flow_id is not None}
+    paired_flows = send_ids & wait_ids
+    ranks = {e.rank for e in events}
+    ranks.update(tr.rank for tr in trackers or [] if tr.timeline)
+    for rank in sorted(ranks):
         out.append(
             {
                 "ph": "M", "pid": rank, "name": "process_name",
@@ -61,14 +71,14 @@ def to_chrome_trace(
                 "args": {"kind": e.kind},
             }
         )
-        if e.flow_id is not None and e.kind == "p2p":
+        if e.flow_id in paired_flows and e.kind == "p2p":
             out.append(
                 {
                     "ph": "s", "pid": e.rank, "tid": tid, "id": e.flow_id,
                     "name": "p2p", "ts": e.start * 1e6, "cat": "p2p",
                 }
             )
-        if e.flow_id is not None and e.kind == "wait":
+        if e.flow_id in paired_flows and e.kind == "wait":
             out.append(
                 {
                     "ph": "f", "pid": e.rank, "tid": tid, "id": e.flow_id,
@@ -78,9 +88,13 @@ def to_chrome_trace(
             )
     for tr in trackers or []:
         samples = tr.timeline
+        if not samples:
+            continue  # nothing tracked for this rank: no counter lane
         stride = max(1, len(samples) // max_counter_samples)
         kept = list(samples[::stride])
-        # never drop the peak or the final sample when downsampling
+        # never drop the peak or the final sample when downsampling: the
+        # stride cut keeps the first of every stride window, so both the
+        # peak and the step-end tail sample can otherwise vanish
         peak_sample = max(samples, key=lambda s: s.bytes)
         for extra in (peak_sample, samples[-1]):
             if extra not in kept:

@@ -1,0 +1,56 @@
+"""Lint-style guard for the observability layer's discipline (the
+``test_no_bare_except.py`` pattern): no bare ``print(...)`` calls in
+``simumax_tpu/`` library modules. User-facing report lines go through
+``observe/report.py`` (so ``--log-level`` / ``--log-json`` apply
+everywhere); the only modules allowed to call ``print`` are the
+reporter itself and the CLI boundary (which owns stderr error lines)."""
+
+import ast
+import os
+
+import simumax_tpu
+
+PKG_ROOT = os.path.dirname(os.path.abspath(simumax_tpu.__file__))
+
+#: modules allowed to print, relative to the package root
+ALLOWED = {"cli.py", os.path.join("observe", "report.py")}
+
+
+def _scan(path: str):
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield f"{path}:{node.lineno}: bare print() call"
+
+
+def test_no_bare_print_in_library_modules():
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, PKG_ROOT)
+            if rel in ALLOWED:
+                continue
+            offenders.extend(_scan(path))
+    assert not offenders, (
+        "library modules must report through observe/report.py "
+        "(get_reporter().info/...), not print:\n" + "\n".join(offenders)
+    )
+
+
+def test_the_linter_itself_catches_offenders(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "print('x')\n"
+        "fingerprint('not a print call')\n"
+        "def f():\n    print('y')\n"
+    )
+    found = list(_scan(str(bad)))
+    assert len(found) == 2

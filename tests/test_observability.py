@@ -1,0 +1,517 @@
+"""Cost-attribution ledger, MFU-loss waterfall, explain/diff tooling,
+and the shared reporter (see docs/observability.md).
+
+Acceptance invariants from the PR contract:
+* waterfall buckets sum to the headline step time within 1e-6 relative,
+  across dense / MoE / MLA x pp>1 x recompute configs;
+* ledger-on vs ledger-off predictions are bit-identical;
+* `diff` of a run against itself reports zero delta.
+"""
+
+import io
+import json
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.observe.ledger import (
+    Ledger,
+    attribution_line,
+    build_waterfall,
+    diff_ledgers,
+)
+
+
+def _run(strategy, model="llama3-8b", system="tpu_v5e_256",
+         model_tweak=None, **overrides):
+    st = get_strategy_config(strategy) if isinstance(strategy, str) else strategy
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    m = get_model_config(model)
+    for k, v in (model_tweak or {}).items():
+        setattr(m, k, v)
+    p = PerfLLM().configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+def _run_multislice(**overrides):
+    """2 x 256-chip v5p slices: dp spans DCN, hosts > 1 (the straggler
+    model activates)."""
+    from simumax_tpu.core.config import get_system_config
+
+    system = get_system_config("tpu_v5p_256")
+    system.num_slices = 2
+    st = get_strategy_config("tp4_pp4_dp32_multislice_dcn")
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    m = get_model_config("llama3-8b")
+    m.layer_num = 4
+    p = PerfLLM().configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+#: dense / MoE / MLA x pp>1 x recompute coverage (deepseekv2 is MLA+MoE)
+WATERFALL_CASES = [
+    ("dense_pp2", dict(strategy="tp1_pp2_dp4_mbs1")),
+    ("dense_pp2_recompute", dict(
+        strategy="tp1_pp2_dp4_mbs1", enable_recompute=True,
+        recompute_granularity="full_block")),
+    ("dense_pp4_vp2", dict(
+        strategy="tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")),
+    ("moe_mla_pp2", dict(
+        strategy="ep4_pp2_dp4_mbs1", model="deepseekv2",
+        system="tpu_v5p_256",
+        model_tweak=dict(layer_num=4, dense_layers=1))),
+    ("moe_mla_pp2_recompute", dict(
+        strategy="ep4_pp2_dp4_mbs1_full_recompute", model="deepseekv2",
+        system="tpu_v5p_256",
+        model_tweak=dict(layer_num=4, dense_layers=1))),
+    ("dense_fsdp_recompute_straggler", dict(
+        strategy="fsdp_dp64_recompute", enable_straggler_model=True)),
+]
+
+
+class TestWaterfall:
+    @pytest.mark.parametrize(
+        "case", [c[1] for c in WATERFALL_CASES],
+        ids=[c[0] for c in WATERFALL_CASES],
+    )
+    def test_buckets_sum_to_step_time(self, case):
+        p = _run(**case)
+        wf = build_waterfall(p)
+        total = sum(wf["buckets"].values())
+        assert total == pytest.approx(wf["total"], rel=1e-6)
+        assert wf["total"] == pytest.approx(
+            p.analysis_cost()["iter_time"], rel=0
+        )
+        # buckets are times: nothing meaningfully negative (calibrated
+        # efficiencies >1 may push compute_inefficiency epsilon-negative)
+        for key, v in wf["buckets"].items():
+            assert v >= -1e-9 * wf["total"], (key, v)
+        assert list(wf["buckets"]) == wf["order"]
+
+    def test_recompute_bucket_appears_with_recompute(self):
+        base = build_waterfall(_run("tp1_pp2_dp4_mbs1"))
+        rc = build_waterfall(_run(
+            "tp1_pp2_dp4_mbs1", enable_recompute=True,
+            recompute_granularity="full_block",
+        ))
+        assert base["buckets"]["recompute"] == 0.0
+        assert rc["buckets"]["recompute"] > 0.0
+
+    def test_straggler_bucket_tracks_ratio(self):
+        p = _run_multislice(enable_straggler_model=True)
+        wf = build_waterfall(p)
+        assert wf["straggle_ratio"] > 1.0
+        assert wf["buckets"]["straggler"] > 0.0
+        # the sum invariant survives the inflation too
+        assert sum(wf["buckets"].values()) == pytest.approx(
+            wf["total"], rel=1e-6
+        )
+
+    def test_attribution_line_has_every_bucket(self):
+        line = attribution_line(_run("tp1_pp2_dp4_mbs1"))
+        for tag in ("ideal", "ineff", "comm", "bubble", "recomp",
+                    "dp+opt", "strag"):
+            assert tag in line, line
+
+
+class TestLedger:
+    def test_ledger_on_off_bit_identical(self):
+        p_off = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        cost_off = p_off.analysis_cost()
+        mem_off = p_off.analysis_mem()
+
+        p_on = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        p_on.ledger()  # collect BEFORE reading the analyses
+        assert p_on.analysis_cost() == cost_off
+        assert p_on.analysis_mem() == mem_off
+
+    def test_op_spans_reproduce_charged_compute_time(self):
+        p = _run("ep4_pp2_dp4_mbs1", model="deepseekv2",
+                 system="tpu_v5p_256",
+                 model_tweak=dict(layer_num=4, dense_layers=1))
+        led = p.ledger()
+        for (stage, chunk), mc in p.chunks.items():
+            spans = [s for s in led.op_spans
+                     if s.stage == stage and s.chunk == chunk]
+            assert sum(s.time for s in spans) == pytest.approx(
+                mc.cost_info.compute.total, rel=1e-9
+            )
+            comm = [s for s in led.collective_spans
+                    if s.stage == stage and s.chunk == chunk]
+            assert sum(s.exposed_time for s in comm) == pytest.approx(
+                mc.cost_info.net_exposed.total, rel=1e-9, abs=1e-15
+            )
+
+    def test_span_provenance_fields(self):
+        p = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        led = p.ledger()
+        gemm = [s for s in led.op_spans if s.category == "gemm"]
+        assert gemm and all(s.shape_key for s in gemm)
+        # pristine system config: every shape-keyed op is a table miss
+        assert all(not s.calibrated for s in gemm)
+        assert {s.regime for s in led.op_spans} <= {"compute", "memory"}
+        assert all(0 < s.efficiency <= 1.05 for s in led.op_spans)
+        assert led.efficiency["miss_count"] > 0
+
+    def test_calibrated_hit_flips_span_provenance(self):
+        p = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        led = p.ledger()
+        target = next(s for s in led.op_spans
+                      if s.category == "gemm" and s.phase == "fwd")
+        spec = p.system.accelerator.op[target.op_key]
+        spec.accurate_efficient_factor[target.shape_key] = 0.93
+        p.estimate()
+        led2 = p.ledger()
+        again = next(s for s in led2.op_spans if s.path == target.path
+                     and s.phase == "fwd")
+        assert again.calibrated and again.efficiency == 0.93
+
+    def test_mla_categories_present(self):
+        p = _run("ep4_pp2_dp4_mbs1", model="deepseekv2",
+                 system="tpu_v5p_256",
+                 model_tweak=dict(layer_num=4, dense_layers=1))
+        cats = {s.category for s in p.ledger().op_spans}
+        assert {"mla_up_proj", "mla_down_proj", "moe_dispatch",
+                "router", "attention", "gemm"} <= cats
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        led = p.ledger()
+        path = led.save(str(tmp_path / "led.json"))
+        data = Ledger.load(path)
+        assert data["schema"] == "simumax-ledger-v1"
+        assert data["headline"]["iter_time"] == led.headline["iter_time"]
+        assert len(data["ops"]) == len(led.op_spans)
+
+    def test_load_rejects_non_ledger(self, tmp_path):
+        bad = tmp_path / "x.json"
+        bad.write_text('{"schema": "something-else"}')
+        with pytest.raises(ValueError, match="not a simumax ledger"):
+            Ledger.load(str(bad))
+
+
+class TestDiff:
+    def test_self_diff_is_zero(self, tmp_path):
+        p = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny")
+        led = p.ledger()
+        path = led.save(str(tmp_path / "a.json"))
+        d = diff_ledgers(Ledger.load(path), Ledger.load(path))
+        assert d["identical"]
+        assert all(v["delta"] == 0 for v in d["headline"].values())
+        assert all(v["delta"] == 0 for v in d["waterfall"].values())
+        assert all(x["delta"] == 0 for x in d["op_deltas"])
+        assert not d["ops_only_in_a"] and not d["ops_only_in_b"]
+
+    def test_ops_only_counts_survive_truncation(self):
+        a = _run("tp1_pp1_dp8_mbs1", model="llama2-tiny").ledger()
+        b = _run("tp1_pp1_dp8_mbs1", model="llama2-tiny",
+                 model_tweak=dict(layer_num=4)).ledger()
+        d = diff_ledgers(a.to_dict(), b.to_dict(), top=1)
+        # layers 2-3 exist only in b: many unique op paths, list capped
+        # at top=1 but the count field reports the real total
+        assert len(d["ops_only_in_b"]) == 1
+        assert d["ops_only_in_b_count"] > 1
+        from simumax_tpu.observe.ledger import format_diff_lines
+
+        rendered = "\n".join(format_diff_lines(d))
+        assert f"ops only in b: {d['ops_only_in_b_count']}" in rendered
+
+    def test_diff_attributes_a_real_change(self):
+        a = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny").ledger()
+        b = _run("tp1_pp2_dp4_mbs1", model="llama2-tiny",
+                 enable_recompute=True,
+                 recompute_granularity="full_block").ledger()
+        d = diff_ledgers(a.to_dict(), b.to_dict())
+        assert not d["identical"]
+        assert d["waterfall"]["recompute"]["delta"] > 0
+        assert d["headline"]["iter_time_ms"]["delta"] == pytest.approx(
+            b.headline["iter_time_ms"] - a.headline["iter_time_ms"]
+        )
+
+
+class TestNetOpTerms:
+    def test_terms_sum_to_net_op_time(self):
+        p = _run_multislice()
+        sysc = p.system
+        size = 64 * 2**20
+        for dim, path in p.ctx.paths.items():
+            for op in ("all_gather", "reduce_scatter", "all_reduce",
+                       "all2all", "p2p"):
+                total = sysc.compute_net_op_time(op, size, path)
+                bw, lat = sysc.compute_net_op_terms(op, size, path)
+                assert bw + lat == pytest.approx(total, rel=1e-9,
+                                                 abs=1e-18), (dim, op)
+
+    def test_dcn_collectives_flagged(self):
+        # dp outermost + ZeRO-3: the per-layer FSDP gathers ride dp_cp,
+        # which spans the cross-slice DCN -> leaf spans flag on_dcn
+        p = _run_multislice(mesh_order="tp,cp,pp,dp", zero_state=3)
+        led = p.ledger()
+        assert any(s.on_dcn for s in led.collective_spans)
+        assert all(s.time == pytest.approx(s.bw_time + s.lat_time,
+                                           rel=1e-9, abs=1e-18)
+                   for s in led.collective_spans)
+
+    def test_step_comm_detail_records_dcn_and_pp(self):
+        p = _run_multislice()
+        led = p.ledger()
+        st0 = led.step_comm["stage0"]
+        assert st0["pp_p2p_per_microbatch"] > 0
+        assert st0["pp_on_dcn"] is True  # pp is the dim crossing slices
+        assert "exposed_rs" in st0 and "exposed_ag" in st0
+
+
+class TestSweepAttribution:
+    def test_rows_and_csv_carry_attribution(self, tmp_path):
+        from simumax_tpu.search import search_best_parallel_strategy
+
+        base = get_strategy_config("tp1_pp1_dp8_mbs1")
+        model = get_model_config("llama2-tiny")
+        from simumax_tpu.core.config import get_system_config
+
+        system = get_system_config("tpu_v5e_256")
+        csv_path = tmp_path / "sweep.csv"
+        rows = search_best_parallel_strategy(
+            base, model, system, 8,
+            tp_list=(1,), pp_list=(1, 2), zero_list=(1,),
+            recompute_types=("none",), csv_path=str(csv_path),
+        )
+        assert rows
+        for r in rows:
+            assert "ideal" in r["attribution"]
+            assert "bubble" in r["attribution"]
+        import csv as _csv
+
+        with open(csv_path) as f:
+            got = list(_csv.DictReader(f))
+        assert "attribution" in got[0]
+        assert any(row["attribution"] for row in got)
+
+
+class TestReporter:
+    def _fresh(self, **kw):
+        from simumax_tpu.observe.report import Reporter
+
+        buf = io.StringIO()
+        return Reporter(stream=buf, **kw), buf
+
+    def test_human_mode_is_byte_identical_to_print(self):
+        log, buf = self._fresh()
+        log.info("iter time 1.23 ms  MFU 45.00%")
+        assert buf.getvalue() == "iter time 1.23 ms  MFU 45.00%\n"
+
+    def test_json_mode_emits_structured_lines_with_run_id(self):
+        log, buf = self._fresh(json_lines=True, run_id="abc123")
+        log.info("hello", event="test", value=3)
+        rec = json.loads(buf.getvalue())
+        assert rec["msg"] == "hello"
+        assert rec["level"] == "info"
+        assert rec["run_id"] == "abc123"
+        assert rec["event"] == "test" and rec["value"] == 3
+        assert rec["ts"] > 0
+
+    def test_level_filtering(self):
+        log, buf = self._fresh(level="warning")
+        log.info("dropped")
+        log.debug("dropped too")
+        log.warning("kept")
+        assert buf.getvalue() == "kept\n"
+
+    def test_unknown_level_rejected(self):
+        from simumax_tpu.observe.report import Reporter
+
+        with pytest.raises(ValueError, match="unknown log level"):
+            Reporter(level="loud")
+
+
+class TestDiagnosticEventStamping:
+    def test_events_carry_monotonic_ts_and_run_id(self):
+        from simumax_tpu.core.records import Diagnostics
+
+        diag = Diagnostics()
+        diag.set_run_identity({"model": "m", "gbs": 8})
+        diag.warn("config", "first")
+        diag.warn("config", "second")
+        e1, e2 = diag.events
+        assert e1.run_id == diag.run_id != ""
+        assert e2.ts >= e1.ts > 0
+        d = e1.to_dict()
+        assert d["run_id"] == diag.run_id and d["ts"] == e1.ts
+
+    def test_identity_hash_is_stable(self):
+        from simumax_tpu.core.records import Diagnostics
+
+        a = Diagnostics.identity_hash({"x": 1, "y": [1, 2]})
+        b = Diagnostics.identity_hash({"y": [1, 2], "x": 1})
+        assert a == b and len(a) == 12
+        assert Diagnostics.identity_hash({"x": 2}) != a
+
+    def test_set_run_identity_backfills_earlier_events(self):
+        from simumax_tpu.core.records import Diagnostics
+
+        diag = Diagnostics()
+        diag.warn("config", "recorded before identity known")
+        assert diag.events[0].run_id == ""
+        rid = diag.set_run_identity({"model": "m"})
+        assert diag.events[0].run_id == rid
+        diag.warn("config", "recorded after")
+        assert diag.events[1].run_id == rid
+
+    def test_set_run_identity_joins_process_reporter(self):
+        from simumax_tpu.core.records import Diagnostics
+        from simumax_tpu.observe.report import (
+            configure_reporter,
+            get_reporter,
+        )
+
+        try:
+            rid = Diagnostics().set_run_identity({"model": "m", "x": 1})
+            assert get_reporter().run_id == rid
+        finally:
+            configure_reporter(run_id="")  # restore a fresh process id
+
+    def test_merge_events_preserves_ts_and_run_id(self):
+        from simumax_tpu.core.records import Diagnostics
+
+        worker = Diagnostics()
+        worker.set_run_identity({"run": "sweep-1"})
+        worker.error("quarantine", "boom", candidate="tp1")
+        shipped = [e.to_dict() for e in worker.events]
+
+        parent = Diagnostics()
+        parent.set_run_identity({"run": "sweep-1"})
+        parent.merge_events(shipped)
+        merged = parent.events[0]
+        assert merged.ts == worker.events[0].ts
+        assert merged.run_id == worker.run_id
+
+    def test_sweep_stamps_run_identity(self, tmp_path):
+        from simumax_tpu.core.config import get_system_config
+        from simumax_tpu.core.records import Diagnostics
+        from simumax_tpu.search import search_best_parallel_strategy
+
+        diag = Diagnostics()
+        search_best_parallel_strategy(
+            get_strategy_config("tp1_pp1_dp8_mbs1"),
+            get_model_config("llama2-tiny"),
+            get_system_config("tpu_v5e_256"), 8,
+            tp_list=(1,), pp_list=(1,), zero_list=(1,),
+            recompute_types=("none",), diagnostics=diag,
+        )
+        assert diag.run_id
+        assert diag.to_dict()["run_id"] == diag.run_id
+
+
+class TestExplainCli:
+    def test_explain_prints_waterfall_and_saves_artifacts(self, tmp_path,
+                                                          capsys):
+        from simumax_tpu.cli import main
+
+        led = tmp_path / "led.json"
+        csvp = tmp_path / "ops.csv"
+        trace = tmp_path / "trace.json"
+        main(["explain", "--model", "llama2-tiny",
+              "--strategy", "tp1_pp2_dp4_mbs1",
+              "--system", "tpu_v5e_256",
+              "--top", "3", "--json", str(led), "--csv", str(csvp),
+              "--trace", str(trace)])
+        out = capsys.readouterr().out
+        assert "MFU-loss waterfall" in out
+        assert "pipeline_bubble" in out and "= step time" in out
+        assert "top ops by charged time" in out
+        data = Ledger.load(str(led))
+        assert data["meta"]["run_id"]
+        import csv as _csv
+
+        rows = list(_csv.DictReader(open(csvp)))
+        assert rows and "efficiency" in rows[0]
+        trace_data = json.load(open(trace))
+        assert trace_data["displayTimeUnit"] == "ms"
+
+    def test_waterfall_renders_sum_row_equal_to_iter(self, capsys):
+        from simumax_tpu.cli import main
+
+        main(["explain", "--model", "llama2-tiny",
+              "--strategy", "tp1_pp1_dp8_mbs1",
+              "--system", "tpu_v5e_256"])
+        out = capsys.readouterr().out
+        assert "100.00%" in out
+
+    def test_diff_cli_self_is_zero(self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        led = tmp_path / "led.json"
+        main(["explain", "--model", "llama2-tiny",
+              "--strategy", "tp1_pp1_dp8_mbs1",
+              "--system", "tpu_v5e_256", "--json", str(led)])
+        capsys.readouterr()
+        report = tmp_path / "diff.json"
+        main(["diff", str(led), str(led), "--json", str(report)])
+        out = capsys.readouterr().out
+        assert "identical: zero delta" in out
+        assert json.load(open(report))["identical"] is True
+
+    def test_perf_diagnostics_and_log_lines_share_run_id(self, tmp_path,
+                                                         capsys):
+        from simumax_tpu.cli import main
+        from simumax_tpu.observe.report import configure_reporter
+
+        diag_path = tmp_path / "d.json"
+        try:
+            main(["perf", "--model", "llama2-tiny",
+                  "--strategy", "tp1_pp1_dp8_mbs1",
+                  "--system", "tpu_v5e_256", "--log-json",
+                  "--diagnostics", str(diag_path)])
+            out = capsys.readouterr().out
+            recs = [json.loads(l) for l in out.splitlines() if l.strip()]
+            report = json.load(open(diag_path))
+            # perf has no content identity, but its report and its log
+            # lines still join on one (reporter-coined) run_id
+            assert report["run_id"]
+            assert all(r["run_id"] == report["run_id"] for r in recs)
+        finally:
+            configure_reporter(level="info", json_lines=False,
+                               run_id="")
+
+    def test_diff_cli_rejects_non_ledger(self, tmp_path):
+        from simumax_tpu.cli import main
+
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        with pytest.raises(SystemExit):
+            main(["diff", str(bad), str(bad)])
+
+    def test_log_json_mode_emits_jsonl_joined_to_ledger_run_id(
+            self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+        from simumax_tpu.observe.report import configure_reporter
+
+        led = tmp_path / "led.json"
+        try:
+            main(["explain", "--model", "llama2-tiny",
+                  "--strategy", "tp1_pp1_dp8_mbs1",
+                  "--system", "tpu_v5e_256", "--log-json",
+                  "--json", str(led)])
+            out = capsys.readouterr().out
+            lines = [l for l in out.splitlines() if l.strip()]
+            recs = [json.loads(l) for l in lines]
+            assert all("ts" in r and "run_id" in r and "msg" in r
+                       for r in recs)
+            wf = [r for r in recs if r.get("event") == "waterfall"]
+            assert wf
+            # log lines, the saved ledger, and the diagnostics report
+            # of one run cross-reference by the same run identity
+            ledger_rid = Ledger.load(str(led))["meta"]["run_id"]
+            assert all(r["run_id"] == ledger_rid for r in wf)
+        finally:
+            # the reporter is process-global: restore the human default
+            # for the rest of the suite
+            configure_reporter(level="info", json_lines=False,
+                               run_id="")

@@ -1,0 +1,136 @@
+"""Chrome-trace structural validity, applied to BOTH trace producers:
+the discrete-event ``simulate()`` export (``simulator/trace.py``) and
+the analytical-path export (``observe/trace.py``).
+
+Checks: every ``X`` slice lands on a metadata-declared pid/tid lane,
+flow arrows (``s``/``f``) pair up id-for-id, counter values are
+non-negative, the counter track keeps the peak AND the final sample
+through downsampling, and the root declares ``displayTimeUnit: ms``."""
+
+import json
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.observe.trace import analytical_chrome_trace
+from simumax_tpu.simulator.trace import to_chrome_trace
+
+
+def _perf(strategy="tp1_pp2_dp4_mbs1", model="llama2-tiny",
+          system="tpu_v5e_256"):
+    p = PerfLLM().configure(strategy, model, system)
+    p.run_estimate()
+    return p
+
+
+def check_chrome_trace(trace: dict):
+    assert trace["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    declared_pids = set()
+    declared_lanes = set()
+    for e in events:
+        if e["ph"] != "M":
+            continue
+        if e["name"] == "process_name":
+            declared_pids.add(e["pid"])
+        elif e["name"] == "thread_name":
+            declared_lanes.add((e["pid"], e["tid"]))
+    flows = {"s": [], "f": []}
+    for e in events:
+        if e["ph"] == "X":
+            assert e["pid"] in declared_pids, e
+            assert (e["pid"], e["tid"]) in declared_lanes, (
+                f"X event on undeclared lane: {e}"
+            )
+            assert e["dur"] >= 0.0, e
+        elif e["ph"] in ("s", "f"):
+            flows[e["ph"]].append(e["id"])
+        elif e["ph"] == "C":
+            assert e["pid"] in declared_pids, e
+            val = list(e["args"].values())[0]
+            assert val >= 0.0, f"negative counter value: {e}"
+    assert sorted(flows["s"]) == sorted(flows["f"]), (
+        "unpaired flow arrows: every `s` id needs its `f`"
+    )
+
+
+class TestSimulatorTrace:
+    def test_simulate_trace_is_structurally_valid(self, tmp_path):
+        p = _perf()
+        r = p.simulate(str(tmp_path))
+        trace = json.load(open(r["trace_path"]))
+        check_chrome_trace(trace)
+        # flow arrows actually exist at pp>1 (p2p send -> recv-wait)
+        assert any(e["ph"] == "s" for e in trace["traceEvents"])
+
+    def test_counter_downsampling_keeps_peak_and_final_sample(self):
+        from simumax_tpu.simulator.memory import MemSample
+
+        class Tracker:
+            rank = 0
+
+            def __init__(self, timeline):
+                self.timeline = timeline
+
+        # monotone ramp then a cliff: with stride-based cuts at
+        # max_counter_samples=4, both the peak (t=97) and the final
+        # sample (t=99, back to 0) are off-stride
+        timeline = [MemSample(float(t), float(t) if t < 98 else 0.0)
+                    for t in range(100)]
+        trace = to_chrome_trace([], [Tracker(timeline)],
+                                max_counter_samples=4)
+        counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        ts = [e["ts"] for e in counters]
+        vals = [e["args"]["allocated"] for e in counters]
+        assert max(vals) == 97.0, "peak sample dropped by downsampling"
+        assert ts[-1] == pytest.approx(99.0 * 1e6), "final sample dropped"
+        assert vals[-1] == 0.0
+        check_chrome_trace(trace)
+
+    def test_empty_timeline_tracker_is_skipped(self):
+        class Tracker:
+            rank = 0
+            timeline = []
+
+        trace = to_chrome_trace([], [Tracker()])
+        assert not [e for e in trace["traceEvents"] if e["ph"] == "C"]
+
+
+class TestAnalyticalTrace:
+    @pytest.mark.parametrize("strategy", [
+        "tp1_pp1_dp8_mbs1",
+        "tp1_pp2_dp4_mbs1",
+        "tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt",
+    ])
+    def test_analytical_trace_is_structurally_valid(self, strategy):
+        model = "llama2-tiny" if "vp2" not in strategy else "llama3-8b"
+        trace = analytical_chrome_trace(_perf(strategy, model))
+        check_chrome_trace(trace)
+        comp = [e for e in trace["traceEvents"]
+                if e["ph"] == "X" and e["name"].startswith(("fwd", "bwd"))]
+        assert comp, "no compute slices in the analytical trace"
+        assert any(e["ph"] == "C" for e in trace["traceEvents"]), (
+            "analytical trace must carry the hbm_bytes counter track"
+        )
+
+    def test_analytical_trace_spans_match_schedule_end(self):
+        p = _perf()
+        cost = p.analysis_cost()
+        trace = analytical_chrome_trace(p)
+        per_stage_last_comp = {}
+        for e in trace["traceEvents"]:
+            if e["ph"] == "X" and e["name"].startswith(("fwd", "bwd")):
+                end = e["ts"] + e["dur"]
+                per_stage_last_comp[e["pid"]] = max(
+                    per_stage_last_comp.get(e["pid"], 0.0), end
+                )
+        for s, end in enumerate(cost["per_stage_end"]):
+            assert per_stage_last_comp[s] == pytest.approx(end * 1e6)
+
+    def test_write_and_reload(self, tmp_path):
+        from simumax_tpu.observe.trace import write_analytical_trace
+
+        path = write_analytical_trace(_perf(), str(tmp_path / "t.json"))
+        trace = json.load(open(path))
+        check_chrome_trace(trace)
+        assert trace["otherData"]["straggle_ratio"] >= 1.0
