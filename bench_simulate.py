@@ -1,0 +1,196 @@
+"""Discrete-event-simulator micro-benchmark: events/sec and peak RSS of
+a pod-size world-rank simulation (no TPU required — the workload is the
+engine itself).
+
+Measures the ISSUE-4 perf stack end to end: the ready-heap scheduler
+with wake indexes (``simulator/engine.py``), rank-symmetry reduction
+(``simulator/reduce.py``) and the bounded-memory streaming trace writer
+(``simulator/trace.py``).
+
+Prints exactly ONE JSON line::
+
+    {"metric": "simulate_events_per_sec", "value": ..., "unit":
+     "events/s", "world": ..., "mode": "reduced"|"full", "granularity":
+     ..., "events": ..., "n_classes": ..., "elapsed_s": ...,
+     "peak_rss_mib": ..., "end_time_ms": ...}
+
+``value`` counts *expanded* (full-world-equivalent) events per second
+of engine wall time, so reduced and full runs are comparable: both
+report how fast the tool answers the same 1024-rank question.
+
+Usage::
+
+    python bench_simulate.py                        # reduced, 1024 ranks
+    python bench_simulate.py --mode full            # exact full-world run
+    python bench_simulate.py --granularity leaf
+    python bench_simulate.py --stream-trace         # bounded-RSS trace write
+    python bench_simulate.py --perturb 0:1.3,7:1.5  # straggler injection
+    python bench_simulate.py --baseline BENCH_prev.json \
+        --max-regression 0.1      # regression gate (exit 1 on breach)
+
+Recorded alongside ``bench_sweep.py`` in the bench harness; numbers are
+committed in ``docs/simulation.md``.
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.perf import PerfLLM
+
+
+def build_perf(world: int, mbc: int):
+    """Fixed synthetic pod config: tp4 x pp4 x dp(world/16) of a
+    layer-trimmed llama3-8b on as many v5e slices as the world needs."""
+    st = get_strategy_config("tp1_pp2_dp4_mbs1")
+    st.tp_size = 4
+    st.pp_size = 4
+    st.world_size = world
+    st.micro_batch_num = mbc
+    st.__post_init__()
+    model = get_model_config("llama3-8b")
+    model.layer_num = 8
+    system = get_system_config("tpu_v5e_256")
+    system.num_slices = max(1, -(-world // system.chips_per_slice))
+    perf = PerfLLM()
+    perf.configure(st, model, system)
+    perf.run_estimate()
+    return perf
+
+
+def parse_perturb(spec):
+    out = {}
+    if spec:
+        for part in spec.split(","):
+            r, f = part.split(":")
+            out[int(r)] = float(f)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=1024,
+                    help="global ranks to simulate (default 1024)")
+    ap.add_argument("--mode", choices=("reduced", "full"),
+                    default="reduced",
+                    help="symmetry-reduced (default) or exact full-world")
+    ap.add_argument("--granularity", choices=("chunk", "leaf"),
+                    default="leaf")
+    ap.add_argument("--mbc", type=int, default=8,
+                    help="microbatches per iteration (default 8)")
+    ap.add_argument("--perturb", metavar="R:F,...",
+                    help="straggler injection, e.g. 0:1.3,7:1.5 "
+                         "(shatters the touched symmetry classes)")
+    ap.add_argument("--stream-trace", action="store_true",
+                    help="stream trace.json to a temp dir while "
+                         "simulating (the bounded-RSS path)")
+    ap.add_argument(
+        "--baseline", metavar="JSON",
+        help="previously saved bench JSON line to gate against "
+             "(compares events/sec at the same world/mode/granularity)",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=0.1, metavar="FRAC",
+        help="fail (exit 1) when events/sec drops more than this "
+             "fraction below the baseline (default 0.1)",
+    )
+    args = ap.parse_args(argv)
+
+    perf = build_perf(args.world, args.mbc)
+    perturbation = parse_perturb(args.perturb)
+    save_path = None
+    tmp = None
+    if args.stream_trace:
+        tmp = tempfile.TemporaryDirectory(prefix="bench_simulate_")
+        save_path = tmp.name
+    t0 = time.perf_counter()
+    r = perf.simulate(
+        save_path,
+        granularity=args.granularity,
+        world_ranks=True,
+        track_memory=False,
+        perturbation=perturbation,
+        reduce=args.mode == "reduced",
+        stream_trace=args.stream_trace,
+    )
+    elapsed = time.perf_counter() - t0
+    trace_bytes = None
+    if save_path:
+        trace_bytes = os.path.getsize(os.path.join(save_path, "trace.json"))
+        tmp.cleanup()
+    peak_rss_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    reduction = r.get("reduction") or {}
+    result = {
+        "metric": "simulate_events_per_sec",
+        "value": round(r["num_events"] / elapsed, 1) if elapsed else 0.0,
+        "unit": "events/s",
+        "world": args.world,
+        "mode": args.mode,
+        "granularity": args.granularity,
+        "mbc": args.mbc,
+        "perturbed_ranks": len(perturbation),
+        "events": r["num_events"],
+        "n_classes": reduction.get("n_classes"),
+        "engine_events": reduction.get("engine_events", r["num_events"]),
+        "elapsed_s": round(elapsed, 3),
+        "peak_rss_mib": round(peak_rss_mib, 1),
+        "stream_trace": args.stream_trace,
+        "end_time_ms": round(r["end_time_ms"], 3),
+    }
+    if trace_bytes is not None:
+        result["trace_bytes"] = trace_bytes
+    ok = True
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if "value" not in base or not isinstance(
+            base.get("value"), (int, float)
+        ):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field; re-record it with a plain "
+                         f"bench run",
+            }))
+            return 2
+        # compare like with like: reduced-vs-full or leaf-vs-chunk
+        # differ by orders of magnitude for non-regression reasons
+        for key, ours in (("world", args.world), ("mode", args.mode),
+                          ("granularity", args.granularity),
+                          ("mbc", args.mbc)):
+            theirs = base.get(key, ours)
+            if theirs != ours:
+                print(json.dumps({
+                    "error": f"baseline {key} {theirs!r} != this run's "
+                             f"{ours!r}; not comparable — re-record the "
+                             f"baseline with matching flags",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression"] = (
+            round(1.0 - result["value"] / base["value"], 4)
+            if base["value"] else 0.0
+        )
+        ok = result["value"] >= floor
+        result["regression_ok"] = ok
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,9 @@ non-interactively::
 
     python -m simumax_tpu list
     python -m simumax_tpu perf --model llama3-8b \
-        --strategy tp1_pp2_dp4_mbs1 --system tpu_v5e_256 [--simulate DIR]
+        --strategy tp1_pp2_dp4_mbs1 --system tpu_v5e_256 \
+        [--simulate DIR [--world-ranks] [--reduce auto|on|off] \
+         [--stream-trace]]
     python -m simumax_tpu search --model llama3-8b --system tpu_v5p_256 \
         --world 64 --gbs 128 --tp 1,2,4,8 --pp 1,2,4 [--csv sweep.csv]
     python -m simumax_tpu calibrate --model ... --strategy ... \
@@ -135,11 +137,24 @@ def cmd_perf(args):
         perf.analysis(save_path=args.save)
         if args.simulate:
             with perf.diagnostics.capture(category="simulate"):
-                result = perf.simulate(args.simulate)
+                result = perf.simulate(
+                    args.simulate,
+                    world_ranks=args.world_ranks,
+                    reduce={"auto": "auto", "on": True,
+                            "off": False}[args.reduce],
+                    stream_trace=args.stream_trace,
+                )
+            reduction = result.get("reduction")
+            extra = (
+                f" ({reduction['n_classes']} symmetry classes for "
+                f"{reduction['world_size']} ranks)" if reduction else ""
+            )
             _log().info(
                 f"simulated: {result['end_time_ms']:.2f} ms, "
+                f"{result['num_events']} events{extra}, "
                 f"trace at {result.get('trace_path')}",
                 event="simulate", end_time_ms=result["end_time_ms"],
+                num_events=result["num_events"],
                 trace_path=result.get("trace_path"),
             )
 
@@ -198,6 +213,7 @@ def _run_search(args, diag):
             diagnostics=diag,
             jobs=jobs,
             prune=not args.no_prune,
+            simulate=args.simulate_check,
         )
     counters = diag.counters
     if counters.get("sweep_cells_pruned"):
@@ -477,6 +493,21 @@ def main(argv=None):
     pp.add_argument("--system", required=True)
     pp.add_argument("--save", help="directory for result JSONs")
     pp.add_argument("--simulate", help="run the event simulator; dir for trace")
+    pp.add_argument(
+        "--world-ranks", action="store_true",
+        help="simulate every global rank (true rendezvous per tp/cp/ep/"
+             "dp group) instead of one representative per pp stage",
+    )
+    pp.add_argument(
+        "--reduce", choices=("auto", "on", "off"), default="auto",
+        help="world-rank symmetry reduction: simulate one rank per "
+             "equivalence class and expand (default auto)",
+    )
+    pp.add_argument(
+        "--stream-trace", action="store_true",
+        help="write trace.json incrementally while simulating (peak RSS "
+             "stays bounded at pod-size world-rank runs)",
+    )
     pp.add_argument("--graph", action="store_true", help="capture op graph")
     _add_diag_args(pp)
     _add_log_args(pp)
@@ -559,6 +590,13 @@ def main(argv=None):
              "of status=pruned CSV rows; structurally impossible "
              "layouts (divisibility) are still skipped, silently, as "
              "the sweep always has",
+    )
+    ps.add_argument(
+        "--simulate-check", action="store_true",
+        help="cross-check every fitting candidate with the discrete-"
+             "event simulator (sim_ms CSV column); cells whose replay "
+             "raises SimulationError are quarantined as status=error "
+             "rows like candidate timeouts",
     )
     _add_diag_args(ps)
     _add_log_args(ps)

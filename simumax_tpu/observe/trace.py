@@ -8,8 +8,11 @@ time was derived from) in the same Chrome-trace conventions, so a
 ``perf`` run is inspectable in the same UI as a ``simulate()`` run:
 
 * pid = pipeline stage, tid lanes ``comp`` / ``comm`` (reusing
-  ``simulator.trace.to_chrome_trace`` for metadata, lane order, colors
-  and ``displayTimeUnit``);
+  ``simulator.trace.to_chrome_trace`` — the batch writer built on the
+  same ``_meta_dicts`` / ``_x_dict`` / ``_counter_dicts`` helpers as
+  the engine's streaming ``StreamingTraceWriter`` sink, so both UIs
+  stay byte-compatible — for metadata, lane order, colors and
+  ``displayTimeUnit``);
 * per-microbatch F/B slices on the comp lane, the exposed DP grad
   reduce-scatter / optimizer / param all-gather tail after each stage's
   last backward;

@@ -1134,6 +1134,13 @@ class PerfLLM(PerfBase):
 
     # simulate() is provided by L5 (simulator package); bound lazily
     def simulate(self, save_path: Optional[str] = None, **kwargs):
+        """Discrete-event replay of the estimated iteration
+        (``simulator/runner.py``). Key kwargs: ``granularity``
+        ("leaf"/"chunk"), ``world_ranks`` (simulate every global rank),
+        ``perturbation`` ({rank: compute multiplier} straggler
+        injection), ``reduce`` (rank-symmetry reduction: "auto" / True /
+        False), ``track_memory``, ``stream_trace`` (bounded-RSS
+        incremental trace write). Reports into ``self.diagnostics``."""
         from simumax_tpu.simulator.runner import run_simulation
 
         return run_simulation(self, save_path, **kwargs)

@@ -84,6 +84,10 @@ class _Env:
     global_batch_size: int
     project_dualpp: bool
     candidate_timeout: Optional[float]
+    #: simulator-backed evaluation: fitting candidates get a
+    #: discrete-event ``sim_ms`` cross-check; a SimulationError
+    #: quarantines the cell like any other candidate failure
+    simulate: bool = False
 
 
 def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
@@ -103,6 +107,7 @@ def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
             row = _searcher._evaluate_sweep_cell(
                 st, cell.rc, env.model, env.system,
                 env.global_batch_size, cache, env.project_dualpp,
+                simulate=env.simulate,
             )
     except Exception as exc:  # quarantine upstream, keep sweeping
         err = {
@@ -129,6 +134,7 @@ def run_cells(
     diagnostics: Optional[Diagnostics] = None,
     jobs: int = 1,
     on_done: Optional[Callable[[CellOutcome], None]] = None,
+    simulate: bool = False,
 ) -> Dict[int, CellOutcome]:
     """Evaluate every cell; returns {cell.idx: CellOutcome}.
 
@@ -138,7 +144,7 @@ def run_cells(
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     env = _Env(base_strategy, model, system, global_batch_size,
-               project_dualpp, candidate_timeout)
+               project_dualpp, candidate_timeout, simulate)
     jobs = max(1, int(jobs or 1))
     if jobs > 1 and len(cells) > 1:
         return _run_cells_pool(cells, env, cache, diagnostics, jobs, on_done)

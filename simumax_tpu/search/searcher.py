@@ -279,6 +279,7 @@ def evaluate_strategy(
     gib_margin: float = 0.0,
     project_dualpp: bool = False,
     build_cache: Optional[Dict] = None,
+    simulate: bool = False,
 ) -> Optional[dict]:
     """Estimate one candidate; returns a flat result row or None when
     the candidate is invalid or does not fit in HBM (reference
@@ -291,9 +292,16 @@ def evaluate_strategy(
     ``build_cache`` (dict-like) enables the per-layout build reuse fast
     path: candidates differing only in the batch split rebatch a cached
     built ``PerfLLM`` (``PerfLLM.rebatch``) instead of rebuilding the
-    whole chunk graph."""
+    whole chunk graph.
+
+    ``simulate`` cross-checks every fitting candidate with the
+    discrete-event simulator (chunk granularity, merged ranks) and adds
+    ``sim_ms`` / ``sim_vs_analytical`` columns — opt-in because it adds
+    a schedule replay per candidate. A ``SimulationError`` (deadlocked
+    or inconsistent schedule) propagates so the sweep loop quarantines
+    the cell exactly like a candidate timeout."""
     key = _strategy_key(strategy, model, system, gib_margin) + (
-        project_dualpp,
+        project_dualpp, simulate,
     )
     if cache is not None and key in cache:
         return cache[key]
@@ -373,6 +381,18 @@ def evaluate_strategy(
         elif project_dualpp:
             row["dualpp_mfu"] = None
             row["dualpp_fits"] = None
+        if simulate and fits:
+            # simulator-backed cross-check (chunk granularity: one
+            # compute span per microbatch — the cheap replay). Failures
+            # are NOT caught here: a SimulationError quarantines the
+            # sweep cell upstream, it must never pass as a clean row.
+            sim = perf.simulate(None, granularity="chunk",
+                                track_memory=False)
+            row["sim_ms"] = sim["end_time_ms"]
+            row["sim_vs_analytical"] = (
+                sim["end_time_ms"] / cost["iter_time_ms"]
+                if cost["iter_time_ms"] else None
+            )
         if not fits:
             row = {**row, "mfu": 0.0}
     except ConfigError:
@@ -421,6 +441,7 @@ def search_micro_batch_config(
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
     build_cache: Optional[Dict] = None,
+    simulate: bool = False,
 ) -> Optional[dict]:
     """Fixed-GBS (mbs, mbc) search with a GiB safety margin
     (reference ``perf_llm.py:3111-3167``, ``gmi_error``)."""
@@ -443,7 +464,8 @@ def search_micro_batch_config(
             continue
         row = evaluate_strategy(st, model, system, cache, gib_margin,
                                 project_dualpp=project_dualpp,
-                                build_cache=build_cache)
+                                build_cache=build_cache,
+                                simulate=simulate)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -467,6 +489,7 @@ def search_best_selective_recompute(
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
     build_cache: Optional[Dict] = None,
+    simulate: bool = False,
 ) -> Optional[dict]:
     best = None
     for combo in _SELECTIVE_COMBOS:
@@ -478,7 +501,8 @@ def search_best_selective_recompute(
             setattr(st, k, v)
         row = evaluate_strategy(st, model, system, cache,
                                 project_dualpp=project_dualpp,
-                                build_cache=build_cache)
+                                build_cache=build_cache,
+                                simulate=simulate)
         if row is None or not row["fits"]:
             continue
         if best is None or row["mfu"] > best["mfu"]:
@@ -493,6 +517,7 @@ def search_best_recompute_layer_num(
     cache: Optional[Dict] = None,
     project_dualpp: bool = False,
     build_cache: Optional[Dict] = None,
+    simulate: bool = False,
 ) -> Optional[dict]:
     """Binary-search the fewest full-recompute layers that still fit
     (reference ``perf_llm.py:3270-3328``) — fewer recomputed layers is
@@ -508,7 +533,8 @@ def search_best_recompute_layer_num(
         st.recompute_layer_num = mid
         row = evaluate_strategy(st, model, system, cache,
                                 project_dualpp=project_dualpp,
-                                build_cache=build_cache)
+                                build_cache=build_cache,
+                                simulate=simulate)
         if row is not None and row["fits"]:
             best = row
             hi = mid - 1
@@ -518,7 +544,8 @@ def search_best_recompute_layer_num(
 
 
 def _evaluate_sweep_cell(
-    st, rc, model, system, global_batch_size, cache, project_dualpp
+    st, rc, model, system, global_batch_size, cache, project_dualpp,
+    simulate=False,
 ) -> Optional[dict]:
     """Evaluate one (layout, recompute-family) sweep cell: search the
     batch split, then the recompute family; at most one result row.
@@ -543,7 +570,7 @@ def _evaluate_sweep_cell(
         return search_micro_batch_config(
             st_rc, model, system, global_batch_size,
             cache=cache, project_dualpp=project_dualpp,
-            build_cache=build_cache,
+            build_cache=build_cache, simulate=simulate,
         )
     if rc == "selective":
         # pick the batch split under selective-recompute memory,
@@ -564,7 +591,7 @@ def _evaluate_sweep_cell(
         return search_best_selective_recompute(
             st_rc, model, system, cache=cache,
             project_dualpp=project_dualpp,
-            build_cache=build_cache,
+            build_cache=build_cache, simulate=simulate,
         )
     if rc == "full_block":
         st_rc.micro_batch_size = 1
@@ -572,7 +599,7 @@ def _evaluate_sweep_cell(
         return search_best_recompute_layer_num(
             st_rc, model, system, cache=cache,
             project_dualpp=project_dualpp,
-            build_cache=build_cache,
+            build_cache=build_cache, simulate=simulate,
         )
     raise ConfigError(f"unknown recompute family {rc!r}", phase="search")
 
@@ -599,6 +626,7 @@ def search_best_parallel_strategy(
     diagnostics: Optional[Diagnostics] = None,
     jobs: int = 1,
     prune: bool = True,
+    simulate: bool = False,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
@@ -622,7 +650,13 @@ def search_best_parallel_strategy(
     both to extend one journal across runs) — in any mix of serial and
     parallel runs. A journal stamped for a different run identity
     (model / system / gbs / world) is refused. ``prune=False`` restores
-    the evaluate-everything legacy behavior (``--no-prune``)."""
+    the evaluate-everything legacy behavior (``--no-prune``).
+
+    ``simulate=True`` asks every cell for simulator-backed evaluation
+    (``sim_ms`` cross-check column on fitting rows); a cell whose
+    schedule replay raises ``SimulationError`` is quarantined as a
+    ``status=error`` CSV row exactly like a candidate timeout — never a
+    sweep abort."""
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     # run identity for the journal: everything a cell row depends on
@@ -631,7 +665,9 @@ def search_best_parallel_strategy(
     # sweep does NOT override (seq_len, dtype, world_size, ...).
     # json round-trip so the comparison against a loaded header is
     # apples-to-apples (tuples become lists, etc.)
+    identity_extra = {"simulate": True} if simulate else {}
     identity = json.loads(json.dumps({
+        **identity_extra,
         "model": model.model_name,
         "system": system.sys_name,
         "system_hash": system.fingerprint(),
@@ -760,7 +796,7 @@ def search_best_parallel_strategy(
                 project_dualpp=project_dualpp,
                 candidate_timeout=candidate_timeout,
                 cache=cache, diagnostics=diagnostics, jobs=jobs,
-                on_done=_checkpoint,
+                on_done=_checkpoint, simulate=simulate,
             )
     finally:
         if journal:

@@ -10,6 +10,19 @@ requests to a deterministic scheduler — no real concurrency, perfectly
 reproducible, and the engine's invariants (queue ordering, deadlock
 detection with a full state dump) are kept as hard errors.
 
+Scheduling: a ready heap keyed ``(clock, rank)`` plus wake indexes.
+Each runnable rank sits in the heap; serving pops the lowest-clock rank
+(ties broken by rank id — the explicit determinism contract). A rank
+whose request cannot complete registers the *wake keys* it awaits
+(collective rendezvous, send/recv tag, async stream join) and leaves
+the heap; publishing a key re-queues exactly the ranks waiting on it.
+Serving is O(log R) per event instead of the previous
+sort-everything-and-rescan-all-blocked O(R log R) per pass, which is
+what makes pod-size world-rank runs (1024+ ranks) tractable.
+Event-driven ML-system simulators (ASTRA-sim) use the same indexed
+wakeup structure. Deadlock == the heap drains while ranks remain
+blocked; the dump names every blocked rank and the keys it awaits.
+
 Request vocabulary (yielded by rank coroutines):
 
 * ``("compute", duration, name, lane)`` — advance this rank's lane clock
@@ -43,25 +56,56 @@ Request vocabulary (yielded by rank coroutines):
 * ``("wait_comm",)`` — block until every async collective this rank
   posted has completed, then advance the main clock to the latest
   completion (stream join)
+
+Memory: trace records are slotted objects with interned name/lane/kind
+strings, and an ``event_sink`` callable (see
+:class:`simumax_tpu.simulator.trace.StreamingTraceWriter`) replaces the
+in-memory event list entirely so peak RSS no longer scales with total
+event count. Completed rendezvous and consumed p2p bookkeeping are
+deleted eagerly for the same reason.
 """
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass, field
+from heapq import heappop, heappush
 from typing import Callable, Dict, Generator, List, Optional, Tuple
 
 from simumax_tpu.core.errors import SimulationError
 
 
-@dataclass
 class TraceEvent:
-    rank: int
-    lane: str
-    name: str
-    start: float
-    end: float
-    kind: str = "compute"  # compute | comm | p2p | wait | marker
-    flow_id: Optional[int] = None  # links send->recv arrows
+    """One simulated span. Slotted + interned: world-rank runs emit
+    millions of these, and the previous dataclass (``__dict__`` per
+    instance, fresh f-string per name) dominated peak RSS."""
+
+    __slots__ = ("rank", "lane", "name", "start", "end", "kind", "flow_id")
+
+    def __init__(self, rank: int, lane: str, name: str, start: float,
+                 end: float, kind: str = "compute",
+                 flow_id: Optional[int] = None):
+        self.rank = rank
+        self.lane = sys.intern(lane)
+        self.name = sys.intern(name)
+        self.start = start
+        self.end = end
+        self.kind = sys.intern(kind)
+        self.flow_id = flow_id
+
+    def __repr__(self):  # keep the old dataclass debugging ergonomics
+        return (
+            f"TraceEvent(rank={self.rank}, lane={self.lane!r}, "
+            f"name={self.name!r}, start={self.start}, end={self.end}, "
+            f"kind={self.kind!r}, flow_id={self.flow_id})"
+        )
+
+    def __eq__(self, other):
+        if not isinstance(other, TraceEvent):
+            return NotImplemented
+        return all(
+            getattr(self, s) == getattr(other, s) for s in self.__slots__
+        )
 
 
 @dataclass
@@ -69,14 +113,15 @@ class _Rendezvous:
     peers: frozenset
     arrivals: Dict[int, float] = field(default_factory=dict)
     duration: float = 0.0
+    #: completion time, computed once when the last peer arrives
+    end: Optional[float] = None
+    #: peers that served their completion — the rendezvous record is
+    #: deleted when every peer consumed it (bounded-memory contract)
+    consumed: int = 0
 
     @property
     def complete(self) -> bool:
-        return set(self.arrivals) == set(self.peers)
-
-    @property
-    def end_time(self) -> float:
-        return max(self.arrivals.values()) + self.duration
+        return len(self.arrivals) == len(self.peers)
 
 
 class DeadlockError(SimulationError):
@@ -88,13 +133,30 @@ class DeadlockError(SimulationError):
 class SimuEngine:
     """Deterministic multi-rank virtual-time executor."""
 
-    def __init__(self, num_ranks: int):
+    def __init__(self, num_ranks: int,
+                 event_sink: Optional[Callable[[TraceEvent], None]] = None):
         self.num_ranks = num_ranks
         self.clock = [0.0] * num_ranks  # per-rank main lane clock
+        #: retained trace records (unused when ``event_sink`` streams
+        #: them out instead — the bounded-memory path)
         self.events: List[TraceEvent] = []
+        self._sink = event_sink
+        self.num_events = 0
+        #: per-rank event counts (total / comm-kind) — symmetry-reduced
+        #: runs expand these by class weight for full-world accounting
+        self.events_by_rank = [0] * num_ranks
+        self.comm_events_by_rank = [0] * num_ranks
         self._procs: List[Optional[Generator]] = [None] * num_ranks
         self._pending: List[Optional[tuple]] = [None] * num_ranks
         self._done = [False] * num_ranks
+        self._n_done = 0
+        #: ready heap of (clock, rank) + membership flags; at most one
+        #: live entry per rank
+        self._ready: List[Tuple[float, int]] = []
+        self._queued = [False] * num_ranks
+        #: wake index: key -> ranks blocked on it; inverse per rank
+        self._waiters: Dict[tuple, set] = {}
+        self._waiting_on: List[tuple] = [()] * num_ranks
         self._collectives: Dict[tuple, _Rendezvous] = {}
         self._coll_seq: Dict[tuple, int] = {}
         self._sends: Dict[tuple, Tuple[float, float]] = {}  # (src,dst,tag) -> (post, dur)
@@ -104,10 +166,6 @@ class SimuEngine:
         #: sendrecv: publish time of the outbound send of an in-flight
         #: batched pair (keyed like _sends; removed on completion)
         self._sr_done: Dict[tuple, float] = {}
-        #: bumped when a BLOCKED request mutates shared state (publishes
-        #: a send, records a recv post): another pass may now succeed,
-        #: so the run loop must not declare deadlock on this pass
-        self._state_version = 0
         self._flow_ids: Dict[tuple, int] = {}
         self._next_flow = 0
         #: async comm-stream state: per-(stream,peers) chained end time,
@@ -124,25 +182,108 @@ class SimuEngine:
 
     # -- engine loop -------------------------------------------------------
     def run(self) -> float:
-        # prime every coroutine to its first request
+        # prime every coroutine to its first request (rank order: every
+        # clock is 0.0, so the heap replays exactly this tie-break)
         for r in range(self.num_ranks):
             self._advance_rank(r, None)
-        while not all(self._done):
-            progressed = False
-            v0 = self._state_version
-            # serve ranks in clock order for determinism
-            order = sorted(range(self.num_ranks), key=lambda r: self.clock[r])
-            for r in order:
-                if self._done[r] or self._pending[r] is None:
-                    continue
-                if self._try_serve(r):
-                    progressed = True
-            if not progressed and self._state_version == v0:
-                # no rank ran AND no blocked request published new state
-                # (a send publish / recv post can unblock a rank already
-                # visited this pass)
-                self._deadlock_dump()
-        return max(self.clock)
+        ready = self._ready
+        while ready:
+            _, r = heappop(ready)
+            self._queued[r] = False
+            if self._done[r] or self._pending[r] is None:
+                continue
+            if not self._try_serve(r):
+                self._block(r)
+        if self._n_done < self.num_ranks:
+            # heap drained with live ranks left: nothing can wake them
+            self._deadlock_dump()
+        return max(self.clock) if self.clock else 0.0
+
+    # -- scheduler plumbing ------------------------------------------------
+    def _enqueue(self, rank: int):
+        if not self._queued[rank]:
+            self._queued[rank] = True
+            heappush(self._ready, (self.clock[rank], rank))
+
+    def _wake(self, rank: int):
+        """Re-queue a blocked rank and drop its remaining wake
+        registrations (it will re-register if it blocks again)."""
+        for k in self._waiting_on[rank]:
+            ws = self._waiters.get(k)
+            if ws is not None:
+                ws.discard(rank)
+                if not ws:
+                    del self._waiters[k]
+        self._waiting_on[rank] = ()
+        if not self._done[rank] and self._pending[rank] is not None:
+            self._enqueue(rank)
+
+    def _publish(self, key: tuple):
+        """New shared state under ``key``: wake exactly the ranks
+        blocked on it (the indexed replacement for the old
+        rescan-every-blocked-rank ``_state_version`` pass)."""
+        ws = self._waiters.get(key)
+        if ws:
+            for r in sorted(ws):
+                self._wake(r)
+
+    def _block(self, rank: int):
+        keys = self._wait_keys(rank)
+        if not keys:  # pragma: no cover - defensive: unwakeable block
+            raise SimulationError(
+                f"rank {rank} blocked on {self._pending[rank]!r} with no "
+                f"wake key — scheduler bug",
+                phase="simulate", rank=rank,
+            )
+        self._waiting_on[rank] = keys
+        for k in keys:
+            self._waiters.setdefault(k, set()).add(rank)
+
+    def _wait_keys(self, rank: int) -> tuple:
+        """The wake keys a blocked request awaits, derived from the same
+        state its failed service attempt just observed (and mutated —
+        first attempts post recv windows / publish sendrecv sends)."""
+        req = self._pending[rank]
+        kind = req[0]
+        if kind == "collective":
+            _, key, _duration, _name, peers = req
+            seq = self._coll_seq.get((key, rank), 0)
+            return (("coll", key, frozenset(peers), seq),)
+        if kind == "wait_comm":
+            return (("async", rank),)
+        if kind == "recv":
+            _, src, tag, _name, *_rest = req
+            seq = self._recv_seq.get((rank, src, tag), 0)
+            return (("send", (src, rank, tag, seq)),)
+        if kind == "send_sync":
+            _, dst, tag, _duration, _name, *_rest = req
+            seq = self._send_seq.get((rank, dst, tag), 0)
+            return (("recvpost", (rank, dst, tag, seq)),)
+        if kind == "sendrecv":
+            _, dst, stag, _sdur, src, rtag, _name, *_rest = req
+            if src is not None:
+                seq = self._recv_seq.get((rank, src, rtag), 0)
+                return (("send", (src, rank, rtag, seq)),)
+            # send-only batched call blocked on the peer's recv: wakes
+            # when the peer posts the recv window OR consumes the send
+            seq = self._send_seq.get((rank, dst, stag), 0)
+            out_key = (rank, dst, stag, seq - 1)
+            if out_key not in self._sr_done:
+                out_key = (rank, dst, stag, seq)
+            return (("recvpost", out_key), ("sendpop", out_key))
+        raise SimulationError(  # pragma: no cover - served kinds never block
+            f"unblockable request {req!r}", phase="simulate", rank=rank
+        )
+
+    def _emit(self, ev: TraceEvent):
+        self.num_events += 1
+        self.events_by_rank[ev.rank] += 1
+        if ev.kind != "compute":
+            self.comm_events_by_rank[ev.rank] += 1
+        if self._sink is not None:
+            self._sink(ev)
+        else:
+            self.events.append(ev)
 
     def _advance_rank(self, rank: int, value):
         proc = self._procs[rank]
@@ -150,9 +291,11 @@ class SimuEngine:
             req = proc.send(value)
         except StopIteration:
             self._done[rank] = True
+            self._n_done += 1
             self._pending[rank] = None
             return
         self._pending[rank] = req
+        self._enqueue(rank)
 
     def _try_serve(self, rank: int) -> bool:
         req = self._pending[rank]
@@ -162,7 +305,7 @@ class SimuEngine:
             start = self.clock[rank]
             self.clock[rank] = start + duration
             if duration > 0:
-                self.events.append(
+                self._emit(
                     TraceEvent(rank, lane, name, start, self.clock[rank])
                 )
             self._advance_rank(rank, self.clock[rank])
@@ -176,7 +319,7 @@ class SimuEngine:
             # zero-advance visibility span (e.g. overlapped async comm)
             _, duration, name, lane = req
             start = self.clock[rank]
-            self.events.append(
+            self._emit(
                 TraceEvent(rank, lane, name, start, start + duration,
                            kind="comm")
             )
@@ -189,9 +332,19 @@ class SimuEngine:
             rv = self._collectives.get(ckey)
             if rv is None:
                 rv = self._collectives[ckey] = _Rendezvous(
-                    peers=frozenset(peers), duration=duration
+                    peers=ckey[1], duration=duration
                 )
             if rank not in rv.arrivals:
+                if rank not in rv.peers:
+                    # membership invariant (kept as a hard error): the
+                    # len-based completion check below must never let a
+                    # malformed peer list complete silently
+                    raise SimulationError(
+                        f"collective {key}#{seq}: rank {rank} arrived at "
+                        f"a rendezvous whose peers {sorted(rv.peers)} do "
+                        f"not include it",
+                        phase="simulate", rank=rank, collective=str(key),
+                    )
                 rv.arrivals[rank] = self.clock[rank]
                 if rv.duration != duration:
                     raise SimulationError(
@@ -199,15 +352,21 @@ class SimuEngine:
                         f"{rv.duration} vs {duration} from rank {rank}",
                         phase="simulate", rank=rank, collective=str(key),
                     )
-            if not rv.complete:
-                return False  # stay blocked
+                if rv.complete:
+                    rv.end = max(rv.arrivals.values()) + rv.duration
+                    self._publish(("coll",) + ckey)
+            if rv.end is None:
+                return False  # stay blocked until the last peer arrives
             start = self.clock[rank]
-            end = rv.end_time
-            self.events.append(
+            end = rv.end
+            self._emit(
                 TraceEvent(rank, "comm", name, start, end, kind="comm")
             )
             self.clock[rank] = end
             self._coll_seq[(key, rank)] = seq + 1
+            rv.consumed += 1
+            if rv.consumed == len(rv.peers):
+                del self._collectives[ckey]
             self._advance_rank(rank, end)
             return True
         if kind == "async_collective":
@@ -220,6 +379,13 @@ class SimuEngine:
             if rv is None:
                 rv = self._async_rv[ckey] = _Rendezvous(
                     peers=pset, duration=duration
+                )
+            if rank not in rv.peers:
+                raise SimulationError(
+                    f"async collective {stream}#{seq}: rank {rank} posted "
+                    f"to a rendezvous whose peers {sorted(rv.peers)} do "
+                    f"not include it",
+                    phase="simulate", rank=rank, stream=str(stream),
                 )
             if rv.duration != duration:
                 raise SimulationError(
@@ -256,10 +422,11 @@ class SimuEngine:
             fid = self._next_flow
             self._next_flow += 1
             self._flow_ids[skey] = fid
-            self.events.append(
+            self._emit(
                 TraceEvent(rank, lane, name, post, post + duration,
                            kind="p2p", flow_id=fid)
             )
+            self._publish(("send", skey))
             self._advance_rank(rank, post)
             return True
         if kind == "send_sync":
@@ -279,11 +446,12 @@ class SimuEngine:
             fid = self._next_flow
             self._next_flow += 1
             self._flow_ids[skey] = fid
-            self.events.append(
+            self._emit(
                 TraceEvent(rank, lane, name, self.clock[rank], end,
                            kind="p2p", flow_id=fid)
             )
             self.clock[rank] = end
+            self._publish(("send", skey))
             self._advance_rank(rank, end)
             return True
         if kind == "recv":
@@ -295,7 +463,7 @@ class SimuEngine:
                 # record when this recv was first posted (sync sends
                 # rendezvous against it)
                 self._recv_posts[skey] = self.clock[rank]
-                self._state_version += 1
+                self._publish(("recvpost", skey))
             if skey not in self._sends:
                 return False  # sender hasn't posted yet
             post, duration = self._sends.pop(skey)
@@ -310,12 +478,14 @@ class SimuEngine:
             self._recv_seq[(rank, src, tag)] = seq + 1
             arrive = max(self.clock[rank], post + duration)
             if arrive > self.clock[rank]:
-                self.events.append(
+                self._emit(
                     TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
                                arrive, kind="wait",
                                flow_id=self._flow_ids.get(skey))
                 )
+            self._flow_ids.pop(skey, None)
             self.clock[rank] = arrive
+            self._publish(("sendpop", skey))
             self._advance_rank(rank, arrive)
             return True
         if kind == "sendrecv":
@@ -335,14 +505,14 @@ class SimuEngine:
                     self._send_seq[(rank, dst, stag)] = seq + 1
                     self._sends[out_key] = (post_t, sdur)
                     self._sr_done[out_key] = post_t
-                    self._state_version += 1
                     fid = self._next_flow
                     self._next_flow += 1
                     self._flow_ids[out_key] = fid
-                    self.events.append(
+                    self._emit(
                         TraceEvent(rank, lane, f"send_{name}", post_t,
                                    post_t + sdur, kind="p2p", flow_id=fid)
                     )
+                    self._publish(("send", out_key))
                 post_t = self._sr_done[out_key]
             in_key = None
             if src is not None:
@@ -350,7 +520,7 @@ class SimuEngine:
                 in_key = (src, rank, rtag, seq)
                 if in_key not in self._recv_posts:
                     self._recv_posts[in_key] = self.clock[rank]
-                    self._state_version += 1
+                    self._publish(("recvpost", in_key))
                 if in_key not in self._sends:
                     return False  # inbound not posted yet
             if out_key is not None and in_key is None:
@@ -374,7 +544,9 @@ class SimuEngine:
                         self._recv_posts.get(in_key, post),
                     )
                 self._recv_posts.pop(in_key, None)
+                self._flow_ids.pop(in_key, None)
                 self._recv_seq[(rank, src, rtag)] = seq + 1
+                self._publish(("sendpop", in_key))
                 end = max(end, post + duration)
             if out_key is not None:
                 peer_post = self._recv_posts.get(out_key)
@@ -385,7 +557,7 @@ class SimuEngine:
                 end = max(end, send_end)
                 del self._sr_done[out_key]
             if end > self.clock[rank]:
-                self.events.append(
+                self._emit(
                     TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
                                end, kind="wait")
                 )
@@ -410,17 +582,35 @@ class SimuEngine:
         for peer in pset:
             self.comm_done[peer] = max(self.comm_done[peer], end)
             self._async_pending[peer].discard(ckey)
-            self.events.append(
+            if not self._async_pending[peer]:
+                self._publish(("async", peer))
+            self._emit(
                 TraceEvent(peer, "comm", name, start, end, kind="comm")
             )
         del self._async_rv[ckey]
 
     # -- diagnostics (reference ``base_struct.py:1415-1474``) --------------
-    def _deadlock_dump(self):
+    def _deadlock_dump(self, max_ranks: int = 64):
         lines = ["simulator deadlock — per-rank state:"]
+        shown = 0
         for r in range(self.num_ranks):
+            if self._done[r] and self.num_ranks > max_ranks:
+                continue  # pod-size dumps: list only the stuck ranks
+            if shown >= max_ranks:
+                blocked_left = sum(
+                    1 for q in range(r, self.num_ranks) if not self._done[q]
+                )
+                lines.append(f"  ... and {blocked_left} more blocked ranks")
+                break
             state = "done" if self._done[r] else f"blocked on {self._pending[r]!r}"
             lines.append(f"  rank {r} t={self.clock[r]*1e3:.3f}ms: {state}")
+            shown += 1
+        if self._waiters:
+            keys = sorted(self._waiters, key=repr)[:max_ranks]
+            lines.append("  blocked wake keys:")
+            for k in keys:
+                ranks = sorted(self._waiters[k])
+                lines.append(f"    {k!r} <- ranks {ranks[:16]}")
         incomplete = {
             k: dict(v.arrivals)
             for k, v in self._collectives.items()
@@ -429,7 +619,7 @@ class SimuEngine:
         if incomplete:
             lines.append(f"  incomplete collectives: {incomplete}")
         if self._sends:
-            lines.append(f"  unmatched sends: {list(self._sends)}")
+            lines.append(f"  unmatched sends: {list(self._sends)[:max_ranks]}")
         pending_async = {
             k: dict(v.arrivals) for k, v in self._async_rv.items()
         }

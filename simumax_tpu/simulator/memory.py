@@ -18,8 +18,11 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 
-@dataclass
+@dataclass(slots=True)
 class MemSample:
+    """Slotted: world-scale timelines hold one of these per alloc/free
+    event, and the per-instance ``__dict__`` was pure overhead."""
+
     t: float
     bytes: float
     tag: str = ""

@@ -1,0 +1,199 @@
+"""Rank-symmetry reduction for world-rank simulation (L5).
+
+At pod scale (256 v5e chips, thousands of v5p chips) almost every
+global rank is interchangeable with hundreds of others: ranks whose
+(pp stage, tp/cp/ep/etp group roles, dp/edp group roles, perturbation
+multiplier) signatures are identical execute bit-identical event
+sequences, because every engine request they issue — compute durations,
+collective rendezvous, p2p tags, async buckets — is derived from
+exactly those signatures. Analytical pod-scale models (Calculon) and
+event-driven simulators (ASTRA-sim) exploit the same symmetry; here it
+is computed exactly, not assumed.
+
+Classes are found by color refinement (the 1-dimensional
+Weisfeiler-Leman fixpoint): start from ``(stage, perturb)`` colors and
+iteratively split ranks whose *relational* position differs — the color
+tuple of their tp/cp/ep/etp group peers (in group order), of their
+dp_cp/edp bucket peers, and of their pipeline neighbours. A
+``perturbation`` entry therefore shatters exactly the classes whose
+symmetry it breaks: untouched regions stay merged, and in the worst
+case the refinement degenerates to one-rank classes, which *is* the
+exact full-world simulation (the automatic fallback — reduced and full
+are the same algorithm, reduction just deduplicates proven-identical
+coroutines).
+
+The reduced engine runs one representative per class; rendezvous
+groups, pipeline neighbours and the optimizer barrier are mapped onto
+class representatives (class-weighted rendezvous: ``max`` over one
+arrival per class equals ``max`` over all members because members are
+bit-identical). Results are expanded back to full-world shape by
+:mod:`simumax_tpu.simulator.runner`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from simumax_tpu.parallel.mesh import rank_coords, rank_groups
+
+
+@dataclass
+class ReductionPlan:
+    """Everything the runner needs to simulate one rank per symmetry
+    class and expand the result to full-world shape."""
+
+    world_size: int
+    #: global members of each class, ascending; class index == engine rank
+    classes: List[List[int]]
+    #: class index of every global rank
+    class_of: List[int]
+    #: pp stage / perturbation multiplier per class
+    stages: List[int]
+    perturbs: List[float]
+    #: per-class rendezvous groups, mapped to engine ranks: keys are the
+    #: dims StageProcess consults (tp/cp/ep/etp plus dp_cp/edp buckets)
+    groups: List[Dict[str, List[int]]]
+    #: per-class {pp stage -> engine rank} for p2p neighbours
+    neighbor_maps: List[Dict[int, int]]
+
+    @property
+    def n_classes(self) -> int:
+        return len(self.classes)
+
+    @property
+    def reps(self) -> List[int]:
+        return [members[0] for members in self.classes]
+
+    @property
+    def weights(self) -> List[int]:
+        return [len(members) for members in self.classes]
+
+
+def _dense_dp_cp_groups(st) -> List[List[int]]:
+    """dp_cp bucket membership exactly as the world-rank runner builds
+    it: ranks sharing (tp, pp) coords (cp folds into the data-parallel
+    grad stream)."""
+    buckets: Dict[tuple, List[int]] = {}
+    for r in range(st.world_size):
+        c = rank_coords(r, st)
+        buckets.setdefault((c["tp"], c["pp"]), []).append(r)
+    return [sorted(g) for g in buckets.values()]
+
+
+def _membership(groups: List[List[int]]) -> Dict[int, List[int]]:
+    by_rank: Dict[int, List[int]] = {}
+    for g in groups:
+        for r in g:
+            by_rank[r] = g
+    return by_rank
+
+
+def build_reduction(st, perturbation: Optional[dict] = None) -> ReductionPlan:
+    """Partition the world into symmetry classes and map the simulated
+    structures onto class representatives. Deterministic: classes are
+    numbered by their smallest member."""
+    perturbation = perturbation or {}
+    n = st.world_size
+    pp = st.pp_size
+    stride = st.tp_size * st.cp_size * st.dp_size  # == StageProcess._pp_stride
+
+    # relational structure, computed once (same sources as the runner)
+    memberships: Dict[str, Dict[int, List[int]]] = {}
+    for dim in ("tp", "cp", "ep", "etp"):
+        if getattr(st, f"{dim}_size") > 1:
+            memberships[dim] = _membership(rank_groups(st, dim))
+    if st.dp_size * st.cp_size > 1:
+        memberships["dp_cp"] = _membership(_dense_dp_cp_groups(st))
+    if st.edp_size > 1:
+        memberships["edp"] = _membership(rank_groups(st, "edp"))
+    stages = [rank_coords(r, st)["pp"] for r in range(n)]
+
+    def pp_next(r: int) -> Optional[int]:
+        if pp <= 1:
+            return None
+        s = stages[r]
+        # interleaved schedules wrap stage pp-1 -> 0 (chunk handoff)
+        return r + stride if s < pp - 1 else r - (pp - 1) * stride
+
+    def pp_prev(r: int) -> Optional[int]:
+        if pp <= 1:
+            return None
+        s = stages[r]
+        return r - stride if s > 0 else r + (pp - 1) * stride
+
+    nxt = [pp_next(r) for r in range(n)]
+    prv = [pp_prev(r) for r in range(n)]
+    dims = sorted(memberships)
+
+    # color refinement to fixpoint
+    color = [(stages[r], float(perturbation.get(r, 1.0))) for r in range(n)]
+    canon: Dict[tuple, int] = {}
+    colors_out: List[int] = [0] * n
+    n_colors = 0
+    while True:
+        canon.clear()
+        for r in range(n):
+            sig = [color[r]]
+            for dim in dims:
+                grp = memberships[dim].get(r)
+                if grp is not None:
+                    sig.append(tuple(color[p] for p in grp))
+                else:
+                    sig.append(None)
+            if pp > 1:
+                sig.append(color[nxt[r]])
+                sig.append(color[prv[r]])
+            key = tuple(sig)
+            c = canon.get(key)
+            if c is None:
+                c = canon[key] = len(canon)
+            colors_out[r] = c
+        if len(canon) == n_colors:
+            break
+        n_colors = len(canon)
+        color = list(colors_out)
+
+    # classes ordered by smallest member (deterministic representative)
+    members_by_color: Dict[int, List[int]] = {}
+    for r in range(n):
+        members_by_color.setdefault(color[r], []).append(r)
+    classes = sorted(members_by_color.values(), key=lambda m: m[0])
+    class_of = [0] * n
+    for idx, members in enumerate(classes):
+        for r in members:
+            class_of[r] = idx
+
+    def map_group(grp: List[int]) -> List[int]:
+        return sorted({class_of[p] for p in grp})
+
+    plan_groups: List[Dict[str, List[int]]] = []
+    neighbor_maps: List[Dict[int, int]] = []
+    for members in classes:
+        rep = members[0]
+        g: Dict[str, List[int]] = {}
+        for dim in dims:
+            grp = memberships[dim].get(rep)
+            if grp is not None:
+                g[dim] = map_group(grp)
+        plan_groups.append(g)
+        nmap: Dict[int, int] = {}
+        if pp > 1:
+            s = stages[rep]
+            for s2 in range(pp):
+                # same arithmetic as StageProcess._neighbor; stages the
+                # schedule never addresses may fall outside the world
+                peer = rep + (s2 - s) * stride
+                if 0 <= peer < n:
+                    nmap[s2] = class_of[peer]
+        neighbor_maps.append(nmap)
+
+    return ReductionPlan(
+        world_size=n,
+        classes=classes,
+        class_of=class_of,
+        stages=[stages[m[0]] for m in classes],
+        perturbs=[float(perturbation.get(m[0], 1.0)) for m in classes],
+        groups=plan_groups,
+        neighbor_maps=neighbor_maps,
+    )

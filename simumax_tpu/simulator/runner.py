@@ -3,6 +3,18 @@
 Reference: ``simumax/core/simu_runner.py:22-94`` (``run_simulation``:
 one simulated rank per PP stage, memory tracker wiring, trace +
 memory-artifact export).
+
+Pod-scale additions on top of the reference shape:
+
+* ``world_ranks=True`` simulates every global rank; with
+  ``reduce="auto"`` (default) the world is first partitioned into
+  rank-symmetry classes (:mod:`simumax_tpu.simulator.reduce`) and one
+  representative per class is simulated — bit-identical results at a
+  fraction of the work, falling back to exact full-world simulation
+  wherever a ``perturbation`` entry breaks the symmetry.
+* ``stream_trace=True`` (with ``save_path``) streams Chrome-trace
+  events to disk while the engine runs instead of retaining them, so
+  peak RSS is bounded regardless of event count.
 """
 
 from __future__ import annotations
@@ -14,16 +26,61 @@ from typing import Optional
 from simumax_tpu.simulator.engine import SimuEngine
 from simumax_tpu.simulator.memory import SimuMemoryTracker
 from simumax_tpu.simulator.schedule import StageProcess
-from simumax_tpu.simulator.trace import write_chrome_trace
+from simumax_tpu.simulator.trace import StreamingTraceWriter, write_chrome_trace
+
+
+def _diag(perf):
+    diag = getattr(perf, "diagnostics", None)
+    if diag is None:
+        from simumax_tpu.core.records import Diagnostics
+
+        diag = Diagnostics.active()
+    return diag
+
+
+def _world_memberships(st) -> dict:
+    """Rendezvous-group membership per parallel dim, computed once for
+    the whole world (the per-rank ``group_of`` fallback inside
+    ``StageProcess`` is O(world) per rank — quadratic at pod scale)."""
+    from simumax_tpu.parallel.mesh import rank_coords, rank_groups
+
+    memberships = {}
+    for dim in ("tp", "cp", "ep", "etp"):
+        if getattr(st, f"{dim}_size") > 1:
+            by_rank = {}
+            for g in rank_groups(st, dim):
+                for r in g:
+                    by_rank[r] = g
+            memberships[dim] = by_rank
+    buckets: dict = {}
+    if st.dp_size * st.cp_size > 1:
+        for r in range(st.world_size):
+            c = rank_coords(r, st)
+            buckets.setdefault((c["tp"], c["pp"]), []).append(r)
+        by_rank = {}
+        for g in buckets.values():
+            g = sorted(g)
+            for r in g:
+                by_rank[r] = g
+        memberships["dp_cp"] = by_rank
+    if st.edp_size > 1:
+        by_rank = {}
+        for g in rank_groups(st, "edp"):
+            for r in g:
+                by_rank[r] = g
+        memberships["edp"] = by_rank
+    return memberships
 
 
 def run_simulation(
     perf,
     save_path: Optional[str] = None,
     granularity: str = "leaf",
-    track_memory: bool = True,
+    track_memory: Optional[bool] = None,
     world_ranks: bool = False,
     perturbation: Optional[dict] = None,
+    reduce="auto",
+    stream_trace: bool = False,
 ) -> dict:
     """Discrete-event replay of one training iteration. ``perf`` must
     have completed ``run_estimate()``.
@@ -35,56 +92,108 @@ def run_simulation(
     via ``perturbation`` ({rank: compute-time multiplier}). The
     reference only approximates stragglers with a closed-form inflation
     (perf_llm.py:255-291); here the slowdown propagates through the
-    actual collective dependency graph. Memory tracking is a
-    per-representative-stage feature and is disabled in world mode
-    (result carries no 'memory' key)."""
+    actual collective dependency graph.
+
+    ``reduce`` controls world-rank symmetry reduction: ``"auto"``
+    (default) simulates one rank per symmetry class when that is
+    cheaper, ``True`` forces the reduced path, ``False`` forces exact
+    full-world simulation. Reduced results are expanded back to
+    full-world shape (``per_rank_end_ms``, event counts) and carry a
+    ``reduction`` summary block.
+
+    Memory tracking is a per-representative-stage feature and is
+    disabled in world mode (result carries no 'memory' key); passing
+    ``track_memory=True`` together with ``world_ranks=True`` records a
+    Diagnostics warning instead of silently ignoring the request.
+
+    ``stream_trace=True`` with ``save_path`` writes ``trace.json``
+    incrementally while the engine runs (bounded peak RSS); without
+    ``save_path`` it is ignored with a Diagnostics warning."""
     assert perf.chunks, "call run_estimate() before simulate()"
     st = perf.strategy
     pp = st.pp_size
     perturbation = perturbation or {}
-    if world_ranks:
-        from simumax_tpu.parallel.mesh import rank_coords, rank_groups
+    diag = _diag(perf)
+    if world_ranks and track_memory:
+        # memory tracking is per-representative-stage; world mode is for
+        # timing/straggler analysis (satellite of ISSUE 4: surface the
+        # silent downgrade)
+        if diag is not None:
+            diag.warn(
+                "simulate",
+                "track_memory=True is ignored with world_ranks=True: "
+                "memory tracking is per-representative-stage; run "
+                "simulate() without world_ranks for memory analysis",
+                world_size=st.world_size,
+            )
+    do_memory = bool(track_memory is None or track_memory) and not world_ranks
+    sink = None
+    if stream_trace:
+        if save_path:
+            os.makedirs(save_path, exist_ok=True)
+            sink = StreamingTraceWriter(os.path.join(save_path, "trace.json"))
+        elif diag is not None:
+            diag.warn(
+                "simulate",
+                "stream_trace=True needs save_path to stream to; ignored",
+            )
 
+    plan = None
+    trackers = []
+    if world_ranks:
         n = st.world_size
         bad = [r for r in perturbation if not 0 <= r < n]
         assert not bad, f"perturbation for nonexistent ranks {bad} (world {n})"
-        # memory tracking is per-representative-stage; world mode is for
-        # timing/straggler analysis
-        track_memory = False
-        # group membership computed once per dim, shared by all ranks
-        memberships = {}
-        for dim in ("tp", "cp", "ep", "etp"):
-            if getattr(st, f"{dim}_size") > 1:
-                by_rank = {}
-                for g in rank_groups(st, dim):
-                    for r in g:
-                        by_rank[r] = g
-                memberships[dim] = by_rank
-        dp_groups = {}
-        if st.dp_size * st.cp_size > 1:
-            from collections import defaultdict
+        if reduce:
+            from simumax_tpu.simulator.reduce import build_reduction
 
-            buckets = defaultdict(list)
+            plan = build_reduction(st, perturbation)
+            if reduce == "auto" and plan.n_classes >= n:
+                plan = None  # no symmetry to exploit: exact path
+        if plan is not None:
+            k = plan.n_classes
+            engine = SimuEngine(k, event_sink=sink)
+            barrier = list(range(k))
+            for i in range(k):
+                groups = {
+                    d: g for d, g in plan.groups[i].items()
+                    if d in ("tp", "cp", "ep", "etp")
+                }
+                buckets = {
+                    d: g for d, g in plan.groups[i].items()
+                    if d in ("dp_cp", "edp")
+                }
+                proc = StageProcess(
+                    perf, plan.stages[i], tracker=None,
+                    granularity=granularity,
+                    rank=i, perturb=plan.perturbs[i],
+                    groups=groups, bucket_groups=buckets,
+                    neighbor_map=plan.neighbor_maps[i] or None,
+                    barrier_group=barrier,
+                )
+                engine.add_rank(i, proc.process())
+        else:
+            from simumax_tpu.parallel.mesh import rank_coords
+
+            memberships = _world_memberships(st)
+            engine = SimuEngine(n, event_sink=sink)
             for r in range(n):
-                c = rank_coords(r, st)
-                buckets[(c["tp"], c["pp"])].append(r)
-            for g in buckets.values():
-                for r in g:
-                    dp_groups[r] = sorted(g)
-        engine = SimuEngine(n)
-        trackers = []
-        for r in range(n):
-            stage = rank_coords(r, st)["pp"]
-            proc = StageProcess(
-                perf, stage, tracker=None, granularity=granularity,
-                rank=r, perturb=perturbation.get(r, 1.0),
-                groups={d: m[r] for d, m in memberships.items() if r in m},
-                dp_cp_group=dp_groups.get(r),
-            )
-            engine.add_rank(r, proc.process())
+                stage = rank_coords(r, st)["pp"]
+                proc = StageProcess(
+                    perf, stage, tracker=None, granularity=granularity,
+                    rank=r, perturb=perturbation.get(r, 1.0),
+                    groups={
+                        d: m[r] for d, m in memberships.items()
+                        if d in ("tp", "cp", "ep", "etp") and r in m
+                    },
+                    bucket_groups={
+                        d: m[r] for d, m in memberships.items()
+                        if d in ("dp_cp", "edp") and r in m
+                    },
+                )
+                engine.add_rank(r, proc.process())
     else:
-        engine = SimuEngine(pp)
-        trackers = []
+        engine = SimuEngine(pp, event_sink=sink)
         for s in range(pp):
             static = sum(
                 c.param_info.total_bytes for c in perf.stage_chunks(s)
@@ -92,7 +201,7 @@ def run_simulation(
             tracker = (
                 SimuMemoryTracker(s, static_bytes=static,
                                   record_events=save_path is not None)
-                if track_memory
+                if do_memory
                 else None
             )
             trackers.append(tracker)
@@ -100,20 +209,51 @@ def run_simulation(
                 perf, s, tracker=tracker, granularity=granularity
             )
             engine.add_rank(s, proc.process())
-    end_time = engine.run()
+    try:
+        end_time = engine.run()
+    except BaseException:
+        if sink is not None:
+            # finalize what streamed so far: a valid (partial) trace is
+            # exactly what's needed to debug the deadlocked schedule
+            sink.close(trackers if do_memory else None)
+        raise
     # machine-variance inflation, same as the analytical path
     # (perf-vs-simulator agreement must survive the straggler model)
     ratio = perf.straggler_ratio()
     end_time *= ratio
 
+    if plan is not None:
+        per_rank_ms = [
+            engine.clock[plan.class_of[r]] * 1e3
+            for r in range(plan.world_size)
+        ]
+        num_events = sum(
+            w * c for w, c in zip(plan.weights, engine.events_by_rank)
+        )
+        num_comm = sum(
+            w * c for w, c in zip(plan.weights, engine.comm_events_by_rank)
+        )
+    else:
+        per_rank_ms = [t * 1e3 for t in engine.clock]
+        num_events = engine.num_events
+        num_comm = sum(engine.comm_events_by_rank)
+
     result = {
         "end_time": end_time,
         "end_time_ms": end_time * 1e3,
         "straggle_ratio": ratio,
-        "per_rank_end_ms": [t * 1e3 for t in engine.clock],
-        "num_events": len(engine.events),
+        "per_rank_end_ms": per_rank_ms,
+        "num_events": num_events,
+        "num_comm_events": num_comm,
     }
-    if track_memory:
+    if plan is not None:
+        result["reduction"] = {
+            "world_size": plan.world_size,
+            "n_classes": plan.n_classes,
+            "engine_events": engine.num_events,
+            "max_class_size": max(plan.weights),
+        }
+    if do_memory:
         result["memory"] = [t.summary() for t in trackers]
         for t in trackers:
             leftover = t.outstanding_tokens()
@@ -123,11 +263,14 @@ def run_simulation(
     if save_path:
         os.makedirs(save_path, exist_ok=True)
         trace_path = os.path.join(save_path, "trace.json")
-        write_chrome_trace(
-            trace_path, engine.events, trackers if track_memory else None
-        )
+        if sink is not None:
+            sink.close(trackers if do_memory else None)
+        else:
+            write_chrome_trace(
+                trace_path, engine.events, trackers if do_memory else None
+            )
         result["trace_path"] = trace_path
-        if track_memory:
+        if do_memory:
             snaps = [t.snapshot() for t in trackers]
             with open(
                 os.path.join(save_path, "simu_memory_snapshot.json"), "w"
@@ -162,18 +305,21 @@ def analyze_stragglers(
     perf,
     slow_ranks: dict,
     granularity: str = "chunk",
+    reduce="auto",
 ) -> dict:
     """Quantify the iteration-time impact of per-rank slowdowns
     ({rank: multiplier}) by replaying the schedule with every global
     rank simulated. Returns baseline/perturbed times, the realized
     inflation, and the reference-style closed-form ratio for
-    comparison."""
+    comparison. Symmetry reduction (``reduce``) applies to both runs —
+    the perturbed run automatically shatters only the classes the
+    stragglers touch."""
     base = run_simulation(
-        perf, None, granularity=granularity, world_ranks=True
+        perf, None, granularity=granularity, world_ranks=True, reduce=reduce
     )
     slow = run_simulation(
         perf, None, granularity=granularity, world_ranks=True,
-        perturbation=slow_ranks,
+        perturbation=slow_ranks, reduce=reduce,
     )
     return {
         "baseline_ms": base["end_time_ms"],

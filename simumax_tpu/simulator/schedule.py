@@ -42,6 +42,9 @@ class StageProcess:
         perturb: float = 1.0,
         groups: Optional[dict] = None,
         dp_cp_group: Optional[list] = None,
+        bucket_groups: Optional[dict] = None,
+        neighbor_map: Optional[dict] = None,
+        barrier_group: Optional[list] = None,
     ):
         self.perf = perf
         self.stage = stage
@@ -53,11 +56,21 @@ class StageProcess:
         #: world-rank mode: this process IS global rank ``rank``; exposed
         #: intra-stage collectives become true rendezvous among the
         #: rank's groups, and ``perturb`` scales its compute (straggler
-        #: injection)
+        #: injection). Under symmetry reduction ``rank`` is an *engine*
+        #: rank (one per class) and ``groups`` / ``neighbor_map`` /
+        #: ``barrier_group`` arrive pre-mapped onto class reps — the
+        #: process itself never needs global coordinates then.
         self.rank = rank
         self.perturb = perturb
         self._groups = groups or {}
         self._dp_cp_group = dp_cp_group
+        #: pre-computed dp_cp/edp grad-stream rendezvous groups (the
+        #: runner builds them once for the whole world — the lazy
+        #: ``group_of`` fallback below is O(world) per rank, quadratic
+        #: at pod scale)
+        self._bucket_groups = bucket_groups or {}
+        self._neighbor_map = neighbor_map
+        self._barrier_group = barrier_group
         if rank is not None and not self._groups:
             from simumax_tpu.parallel.mesh import group_of
 
@@ -135,9 +148,12 @@ class StageProcess:
     def _dim_group(self, dim: str):
         """dp_cp / edp rendezvous group of this world rank (None in
         merged mode: the group's members are represented by one rank).
-        Computed once per StageProcess."""
+        Computed once per StageProcess; pre-mapped groups passed by the
+        runner (full-world precompute or symmetry reduction) win."""
         if self.rank is None:
             return None
+        if dim in self._bucket_groups:
+            return self._bucket_groups[dim]
         if dim in self._dp_groups:
             return self._dp_groups[dim]
         from simumax_tpu.parallel.mesh import group_of, rank_coords
@@ -213,6 +229,8 @@ class StageProcess:
         """Engine rank id of the same position at another pp stage."""
         if self.rank is None:
             return stage
+        if self._neighbor_map is not None:
+            return self._neighbor_map[stage]
         return self.rank + (stage - self.stage) * self._pp_stride()
 
     def _comm_events(self, leaf, phase: str, point: str):
@@ -433,13 +451,17 @@ class StageProcess:
                        "comm")
             clock[0] = t
         # world barrier before the step (rerun_state_machine analog)
-        n_ranks = self.pp if self.rank is None else st.world_size
+        if self._barrier_group is not None:
+            barrier = list(self._barrier_group)
+        else:
+            barrier = list(range(self.pp if self.rank is None
+                                  else st.world_size))
         t = yield (
             "collective",
             "optimizer_barrier",
             0.0,
             "optimizer_barrier",
-            list(range(n_ranks)),
+            barrier,
         )
         clock[0] = t
         t = yield ("compute",

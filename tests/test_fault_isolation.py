@@ -23,6 +23,7 @@ from simumax_tpu.core.errors import (
     CandidateTimeoutError,
     ConfigError,
     FeasibilityError,
+    SimulationError,
     UnknownConfigError,
 )
 from simumax_tpu.core.records import Diagnostics
@@ -52,16 +53,21 @@ def _inject(monkeypatch, failures):
     real = searcher_mod._evaluate_sweep_cell
     calls = []
 
-    def fake(st, rc, model, system, gbs, cache, project_dualpp):
+    def fake(st, rc, model, system, gbs, cache, project_dualpp,
+             simulate=False):
         calls.append((st.tp_size, rc))
         action = failures.get((st.tp_size, rc))
         if action == "feasibility":
             raise FeasibilityError("injected: does not fit", phase="search")
         if action == "runtime":
             raise RuntimeError("injected crash")
+        if action == "simulation":
+            raise SimulationError("injected: schedule replay wedged",
+                                  phase="simulate")
         if action == "hang":
             time.sleep(30)
-        return real(st, rc, model, system, gbs, cache, project_dualpp)
+        return real(st, rc, model, system, gbs, cache, project_dualpp,
+                    simulate=simulate)
 
     monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
     return calls
@@ -91,6 +97,38 @@ class TestQuarantine:
         kinds = {r["error_type"] for r in by_status["error"]}
         assert kinds == {"FeasibilityError", "RuntimeError"}
         assert any("injected" in r["error_msg"] for r in by_status["error"])
+
+    def test_simulation_error_quarantined_like_timeout(
+        self, monkeypatch, tmp_path
+    ):
+        """A sweep cell that requests simulator-backed evaluation and
+        hits a SimulationError (deadlocked / inconsistent replay) must
+        land as a status=error CSV row — never abort the sweep (ISSUE 4
+        satellite)."""
+        m, sysc, st = setup()
+        _inject(monkeypatch, {(2, "none"): "simulation"})
+        csv_path = tmp_path / "sweep.csv"
+        diag = Diagnostics()
+        rows = _sweep(m, sysc, st, csv_path=str(csv_path),
+                      diagnostics=diag, simulate=True)
+        assert rows and all(r["status"] == "ok" for r in rows)
+        assert len(diag.quarantined) == 1
+        assert diag.quarantined[0].context["exception"] == "SimulationError"
+        with open(csv_path) as f:
+            errors = [r for r in csv.DictReader(f) if r["status"] == "error"]
+        assert len(errors) == 1
+        assert errors[0]["error_type"] == "SimulationError"
+        assert "wedged" in errors[0]["error_msg"]
+
+    def test_simulate_check_adds_sim_column(self):
+        """The healthy path of simulator-backed sweeps: fitting rows
+        carry a sim_ms cross-check close to the analytical time."""
+        m, sysc, st = setup()
+        rows = _sweep(m, sysc, st, tp_list=(1,), simulate=True)
+        assert rows
+        for r in rows:
+            assert r["sim_ms"] > 0
+            assert r["sim_vs_analytical"] == pytest.approx(1.0, abs=0.05)
 
     def test_candidate_timeout_quarantines_hung_cell(
         self, monkeypatch, tmp_path
@@ -177,10 +215,12 @@ class TestPoolQuarantine:
 
         real = searcher_mod._evaluate_sweep_cell
 
-        def fake(st, rc, model, system, gbs, cache, project_dualpp):
+        def fake(st, rc, model, system, gbs, cache, project_dualpp,
+             simulate=False):
             if st.tp_size == 2:
                 os._exit(1)  # hard death: no exception, no result
-            return real(st, rc, model, system, gbs, cache, project_dualpp)
+            return real(st, rc, model, system, gbs, cache, project_dualpp,
+                        simulate=simulate)
 
         monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
         m, sysc, st = setup()

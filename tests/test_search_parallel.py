@@ -68,7 +68,8 @@ def _inject_logged(monkeypatch, failures, log_path):
     file so calls made inside fork workers are visible to the parent."""
     real = searcher_mod._evaluate_sweep_cell
 
-    def fake(st, rc, model, system, gbs, cache, project_dualpp):
+    def fake(st, rc, model, system, gbs, cache, project_dualpp,
+             simulate=False):
         with open(log_path, "a") as f:
             f.write(f"tp{st.tp_size}:{rc}\n")
         action = failures.get((st.tp_size, rc))
@@ -78,7 +79,8 @@ def _inject_logged(monkeypatch, failures, log_path):
             time.sleep(30)
         if action == "sleep":
             time.sleep(1.0)
-        return real(st, rc, model, system, gbs, cache, project_dualpp)
+        return real(st, rc, model, system, gbs, cache, project_dualpp,
+                        simulate=simulate)
 
     monkeypatch.setattr(searcher_mod, "_evaluate_sweep_cell", fake)
 

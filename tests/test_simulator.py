@@ -84,6 +84,24 @@ class TestEngine:
             eng.run()
         assert "rank 0" in str(ei.value) and "blocked" in str(ei.value)
 
+    def test_collective_peers_must_include_arriving_rank(self):
+        """The rendezvous completion check is count-based; membership
+        stays a hard error so a malformed peer list can never complete
+        silently with an absent peer."""
+        eng = SimuEngine(3)
+
+        def bad():
+            yield ("collective", "g", 0.5, "ar", [1, 2])  # omits self
+
+        def ok(r):
+            yield ("collective", "g", 0.5, "ar", [1, 2])
+
+        eng.add_rank(0, bad())
+        eng.add_rank(1, ok(1))
+        eng.add_rank(2, ok(2))
+        with pytest.raises(RuntimeError, match="do not include"):
+            eng.run()
+
     def test_mismatched_collective_duration_raises(self):
         eng = SimuEngine(2)
 
@@ -448,6 +466,309 @@ class TestWorldRanks:
         p = run("tp2_pp1_dp4_mbs1")
         r = analyze_stragglers(p, {})
         assert r["inflation"] == pytest.approx(1.0)
+
+
+class TestScheduler:
+    """Ready-heap scheduler with wake indexes (ISSUE 4 tentpole):
+    explicit (clock, rank) determinism, indexed wakeup of blocked
+    requests, deadlock dump naming the blocked keys."""
+
+    def test_equal_clock_ranks_serve_in_rank_order(self):
+        """Two ranks at identical clocks must serve in rank order —
+        previously guaranteed only by sort stability, now by the
+        explicit (clock, rank) heap key."""
+        eng = SimuEngine(2)
+
+        def proc(r):
+            yield ("compute", 1.0, f"r{r}.s1", "comp")
+            yield ("compute", 1.0, f"r{r}.s2", "comp")
+
+        eng.add_rank(0, proc(0))
+        eng.add_rank(1, proc(1))
+        eng.run()
+        assert [e.name for e in eng.events] == [
+            "r0.s1", "r1.s1", "r0.s2", "r1.s2",
+        ]
+
+    def test_blocked_publish_wakes_waiting_recv(self):
+        """A rank blocked on a recv whose matching send is published by
+        another *blocked* request (a sendrecv's eager publish — the old
+        engine's ``_state_version`` rescan path) must be re-served via
+        the wake index, not deadlock."""
+        eng = SimuEngine(2)
+
+        def r0():
+            # blocks first; the matching send appears only when rank 1's
+            # *blocked* sendrecv publishes its outbound half
+            yield ("recv", 1, "x", "rx")
+            yield ("send", 1, "y", 0.25, "sy")
+
+        def r1():
+            # batched pair: publish send x eagerly, block on recv y
+            yield ("sendrecv", 0, "x", 0.5, 0, "y", "pair", "pp_fwd")
+
+        eng.add_rank(0, r0())
+        eng.add_rank(1, r1())
+        eng.run()
+        assert eng.clock[0] == pytest.approx(0.5)   # recv got x at 0+0.5
+        assert eng.clock[1] == pytest.approx(0.75)  # y posted at 0.5 +0.25
+
+    def test_chained_wakes_across_blocked_ranks(self):
+        """A wake can enable a serve that itself publishes the key a
+        third rank awaits — the chain must drain in one run() without
+        any full-world rescan."""
+        eng = SimuEngine(3)
+
+        def r0():
+            yield ("compute", 1.0, "w", "comp")
+            yield ("send", 1, "a", 0.1, "sa")
+
+        def r1():
+            yield ("recv", 0, "a", "ra")
+            yield ("send", 2, "b", 0.1, "sb")
+
+        def r2():
+            yield ("recv", 1, "b", "rb")
+
+        eng.add_rank(0, r0())
+        eng.add_rank(1, r1())
+        eng.add_rank(2, r2())
+        eng.run()
+        assert eng.clock[2] == pytest.approx(1.0 + 0.1 + 0.1)
+
+    def test_deadlock_dump_names_blocked_keys(self):
+        """The deadlock dump must still fire under the heap scheduler
+        and name the wake keys each stuck rank awaits."""
+        eng = SimuEngine(2)
+
+        def a():
+            yield ("recv", 1, "x", "ra")
+
+        def b():
+            yield ("collective", "g", 0.5, "ar", [0, 1])
+            yield ("recv", 0, "y", "rb")
+
+        eng.add_rank(0, a())
+        eng.add_rank(1, b())
+        with pytest.raises(DeadlockError) as ei:
+            eng.run()
+        msg = str(ei.value)
+        assert "blocked wake keys" in msg
+        assert "'send'" in msg       # the recv's wake key
+        assert "'coll'" in msg       # the half-arrived collective
+        assert "rank 0" in msg and "blocked" in msg
+
+    def test_wait_comm_woken_by_last_async_completion(self):
+        eng = SimuEngine(2)
+
+        def r0():
+            yield ("async_collective", "s", 0.5, "ar", [0, 1])
+            yield ("wait_comm",)
+
+        def r1():
+            yield ("compute", 2.0, "w", "comp")
+            yield ("async_collective", "s", 0.5, "ar", [0, 1])
+
+        eng.add_rank(0, r0())
+        eng.add_rank(1, r1())
+        eng.run()
+        assert eng.clock[0] == pytest.approx(2.5)  # joined the stream
+
+
+class TestSymmetryReduction:
+    """Reduced world-rank simulation must be BIT-identical to exact
+    full-world simulation: final iteration time, per-rank lane clocks,
+    and expanded event/collective counts (ISSUE 4 acceptance)."""
+
+    def _assert_parity(self, p, perturbation=None, granularity="chunk"):
+        full = p.simulate(None, world_ranks=True, reduce=False,
+                          granularity=granularity, track_memory=False,
+                          perturbation=perturbation)
+        red = p.simulate(None, world_ranks=True, reduce=True,
+                         granularity=granularity, track_memory=False,
+                         perturbation=perturbation)
+        assert "reduction" in red
+        assert red["end_time"] == full["end_time"]  # bit identical
+        assert red["per_rank_end_ms"] == full["per_rank_end_ms"]
+        assert red["num_events"] == full["num_events"]
+        assert red["num_comm_events"] == full["num_comm_events"]
+        return red
+
+    @pytest.mark.parametrize("strat,model,pp", [
+        ("tp2_pp1_dp4_mbs1", "llama3-8b", 1),          # dense pp1
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 2),          # dense pp2
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 4),          # dense pp4
+        ("ep8_pp1_dp8_mbs1", "mixtral-8x7b", 1),       # MoE pp1
+        ("ep4_pp2_dp4_mbs1", "mixtral-8x7b", 2),       # MoE pp2
+        ("tp2_pp1_dp4_mbs1", "deepseekv2-lite", 1),    # MLA pp1
+        ("tp1_pp2_dp4_mbs1", "deepseekv2-lite", 2),    # MLA pp2
+    ])
+    def test_parity_with_and_without_straggler(self, strat, model, pp):
+        st = get_strategy_config(strat)
+        if pp != st.pp_size:
+            st.world_size = st.world_size * pp // st.pp_size
+            st.pp_size = pp
+        m = get_model_config(model)
+        m.layer_num = max(pp * 2, 4)
+        p = run(st, m)
+        sym = self._assert_parity(p)
+        # without perturbation, classes collapse to (at most) pp stages
+        assert sym["reduction"]["n_classes"] <= p.strategy.pp_size
+        self._assert_parity(p, perturbation={1: 1.25})
+
+    def test_parity_leaf_granularity_with_overlap(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.zero_state = 1
+        st.overlap_grad_reduce = True
+        st.overlap_param_gather = True
+        st.__post_init__()
+        p = run(st)
+        self._assert_parity(p, granularity="leaf")
+        self._assert_parity(p, perturbation={0: 2.0}, granularity="leaf")
+
+    def test_parity_blocking_pipeline(self):
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.pp_size = 4
+        st.world_size = 8
+        st.micro_batch_num = 4
+        st.pp_comm_async = False
+        st.__post_init__()
+        m = get_model_config("llama3-8b")
+        m.layer_num = 8
+        p = run(st, m)
+        self._assert_parity(p)
+        self._assert_parity(p, perturbation={2: 1.4})
+
+    def test_parity_interleaved_vpp(self):
+        st = get_strategy_config("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        p = run(st)
+        red = self._assert_parity(p)
+        assert red["reduction"]["n_classes"] == 4
+        self._assert_parity(p, perturbation={5: 1.3})
+
+    def test_straggler_shatters_only_touched_classes(self):
+        """One slow rank must not force a full-world fallback: ranks
+        symmetric with respect to the straggler stay merged."""
+        from simumax_tpu.simulator.reduce import build_reduction
+
+        st = get_strategy_config("tp2_pp1_dp4_mbs1")
+        plan = build_reduction(st, {1: 2.0})
+        assert 1 < plan.n_classes < st.world_size
+        # every class is internally consistent on (stage, perturb)
+        for members in plan.classes:
+            perts = {2.0 if r == 1 else 1.0 for r in members}
+            assert len(perts) == 1
+
+    def test_reduce_auto_equals_forced(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        auto = p.simulate(None, world_ranks=True, reduce="auto",
+                          track_memory=False)
+        forced = p.simulate(None, world_ranks=True, reduce=True,
+                            track_memory=False)
+        assert auto["end_time"] == forced["end_time"]
+        assert auto["per_rank_end_ms"] == forced["per_rank_end_ms"]
+
+
+class TestStreamingTrace:
+    """stream_trace=True writes trace.json incrementally (bounded RSS):
+    the streamed file must carry the same spans, counters and paired
+    flow arrows as the batch writer."""
+
+    def _load(self, path):
+        with open(path) as f:
+            return json.load(f)
+
+    def test_streamed_equals_batch_trace(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1")
+        batch_dir = tmp_path / "batch"
+        stream_dir = tmp_path / "stream"
+        rb = p.simulate(str(batch_dir))
+        rs = p.simulate(str(stream_dir), stream_trace=True)
+        assert rb["num_events"] == rs["num_events"]
+        tb = self._load(os.path.join(batch_dir, "trace.json"))
+        ts = self._load(os.path.join(stream_dir, "trace.json"))
+        assert ts["displayTimeUnit"] == "ms"
+
+        def shape(trace):
+            evs = trace["traceEvents"]
+            return {
+                "X": len([e for e in evs if e.get("ph") == "X"]),
+                "C": len([e for e in evs if e.get("ph") == "C"]),
+                "s": {e["id"] for e in evs if e.get("ph") == "s"},
+                "f": {e["id"] for e in evs if e.get("ph") == "f"},
+                "pids": {e["pid"] for e in evs if e.get("ph") == "X"},
+            }
+
+        sb, ss = shape(tb), shape(ts)
+        assert ss["X"] == sb["X"]
+        assert ss["C"] == sb["C"]
+        assert ss["pids"] == sb["pids"]
+        # arrows are pairwise complete and identical to the batch writer
+        assert ss["s"] == ss["f"] == sb["s"]
+
+    def test_streamed_world_rank_trace(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1")
+        r = p.simulate(str(tmp_path), world_ranks=True, reduce=True,
+                       stream_trace=True, track_memory=False)
+        trace = self._load(r["trace_path"])
+        evs = trace["traceEvents"]
+        assert any(e.get("ph") == "X" for e in evs)
+        # engine (class-representative) lanes, one per symmetry class
+        pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+        assert len(pids) == r["reduction"]["n_classes"]
+
+    def test_stream_without_save_path_warns_and_runs(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        r = p.simulate(None, stream_trace=True)
+        assert r["end_time"] > 0
+        assert any(
+            "stream_trace" in e.message for e in p.diagnostics.warnings
+        )
+
+
+class TestWorldMemoryDowngradeWarning:
+    """ISSUE 4 satellite: world_ranks=True silently disabled memory
+    tracking; now the downgrade is a Diagnostics warning that
+    --diagnostics/--strict surface."""
+
+    def test_explicit_track_memory_warns(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        r = p.simulate(None, world_ranks=True, track_memory=True)
+        assert "memory" not in r
+        warns = [e for e in p.diagnostics.warnings
+                 if e.category == "simulate"
+                 and "track_memory" in e.message]
+        assert warns
+
+    def test_default_world_run_does_not_warn(self):
+        p = run("tp1_pp2_dp4_mbs1")
+        p.simulate(None, world_ranks=True)
+        assert not [e for e in p.diagnostics.warnings
+                    if "track_memory" in e.message]
+
+
+@pytest.mark.slow
+class TestPodScale:
+    """Pod-size smoke: a >=1024-rank reduced world simulation completes
+    within a wall-clock budget, bit-identical to the exact engine."""
+
+    def test_1024_rank_reduced_simulation_under_budget(self):
+        import time as _time
+
+        import bench_simulate
+
+        p = bench_simulate.build_perf(1024, 8)
+        t0 = _time.monotonic()
+        red = p.simulate(None, world_ranks=True, reduce=True,
+                         granularity="chunk", track_memory=False)
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 60.0, f"reduced 1024-rank sim took {elapsed:.1f}s"
+        assert red["reduction"]["n_classes"] <= p.strategy.pp_size
+        assert len(red["per_rank_end_ms"]) == 1024
+        full = p.simulate(None, world_ranks=True, reduce=False,
+                          granularity="chunk", track_memory=False)
+        assert red["end_time"] == full["end_time"]
+        assert red["num_events"] == full["num_events"]
 
 
 class TestMemoryVizExport:
