@@ -25,6 +25,15 @@ failure); ``search`` additionally takes ``--journal`` / ``--resume``
 ``--no-prune`` (see ``docs/search.md``). Config-family errors exit 2
 with a one-line message instead of a traceback.
 
+Fault/goodput surface (see ``docs/faults.md``): ``perf --simulate``
+takes ``--faults SCENARIO.json`` (timed rank slowdowns, preemptions,
+link degradation, rank deaths injected into the simulated step);
+``faults`` predicts the goodput waterfall of a scenario over its job
+horizon (``--scenario``) or Monte-Carlos the failure space for the
+optimal checkpoint interval (``--monte-carlo N --seed S``).
+``SimulationError`` escaping any command exits 3 with a one-line
+message (the full engine dump goes to ``--diagnostics``).
+
 Observability surface (see ``docs/observability.md``): ``explain``
 renders the MFU-loss waterfall + top-N op table from the
 cost-attribution ledger (``--json`` saves the full ledger, ``--csv``
@@ -42,9 +51,12 @@ import json
 import os
 import sys
 
-#: exit codes: 2 = bad config / usage, 3 = --strict violation
+#: exit codes: 2 = bad config / usage, 3 = --strict violation or a
+#: simulation-invariant failure (SimulationError family — the schedule
+#: replay itself is wedged, not the user's configuration)
 EXIT_CONFIG = 2
 EXIT_STRICT = 3
+EXIT_SIMULATION = 3
 
 
 def _ints(s: str):
@@ -136,13 +148,40 @@ def cmd_perf(args):
         perf.run_estimate(capture_graph=args.graph)
         perf.analysis(save_path=args.save)
         if args.simulate:
+            scenario = None
+            world_ranks = args.world_ranks
+            if args.faults:
+                from simumax_tpu.simulator.faults import FaultScenario
+
+                scenario = FaultScenario.from_json(args.faults)
+                if not scenario.empty and not world_ranks:
+                    # rank-scoped faults need every rank simulated
+                    world_ranks = True
+                    _log().info(
+                        "[faults] scenario implies --world-ranks",
+                        event="faults_world_ranks",
+                    )
             with perf.diagnostics.capture(category="simulate"):
                 result = perf.simulate(
                     args.simulate,
-                    world_ranks=args.world_ranks,
+                    world_ranks=world_ranks,
                     reduce={"auto": "auto", "on": True,
                             "off": False}[args.reduce],
                     stream_trace=args.stream_trace,
+                    faults=scenario,
+                )
+            outcome = result.get("faults")
+            if outcome:
+                deaths = ", ".join(
+                    f"rank {d['rank']} @ {d['time_ms']:.1f} ms"
+                    for d in outcome["deaths"]
+                ) or "none"
+                _log().info(
+                    f"faults: {outcome['applied_events']} events, "
+                    f"completed={outcome['completed']}, deaths: {deaths}",
+                    event="fault_outcome",
+                    completed=outcome["completed"],
+                    deaths=len(outcome["deaths"]),
                 )
             reduction = result.get("reduction")
             extra = (
@@ -377,6 +416,100 @@ def cmd_diff(args):
                  path=args.json)
 
 
+def cmd_faults(args):
+    from simumax_tpu import PerfLLM
+
+    perf = PerfLLM()
+    perf.diagnostics.strict = args.strict
+    with _diagnosed(perf.diagnostics, args):
+        _run_faults(args, perf)
+
+
+def _run_faults(args, perf):
+    from simumax_tpu.observe.ledger import (
+        goodput_attribution_line,
+        goodput_waterfall_lines,
+    )
+    from simumax_tpu.simulator.faults import CheckpointSpec, FaultScenario
+
+    log = _log()
+    perf.configure(args.strategy, args.model, args.system)
+    perf.run_estimate()
+
+    def build_spec(scenario=None):
+        """Scenario checkpoint block as the base, explicit CLI flags
+        on top (flags always win); None when neither says anything."""
+        base = CheckpointSpec.from_overrides(
+            scenario.checkpoint if scenario is not None else None
+        )
+        flags = {}
+        if args.ckpt_interval:
+            flags["interval_steps"] = args.ckpt_interval
+        if args.restart_overhead is not None:
+            flags["restart_overhead_s"] = args.restart_overhead
+        if not flags and (scenario is None or not scenario.checkpoint):
+            return None
+        return CheckpointSpec.from_overrides(flags, base)
+    if args.monte_carlo:
+        with perf.diagnostics.capture(category="faults"):
+            res = perf.analyze_faults(
+                n_scenarios=args.monte_carlo, seed=args.seed,
+                horizon_steps=args.horizon or 50, spec=build_spec(),
+                granularity=args.granularity,
+            )
+        g = res["goodput"]
+        log.info(
+            f"goodput over {res['n_scenarios']} scenarios "
+            f"(seed {res['seed']}, horizon {res['horizon_steps']} "
+            f"steps): mean {g['mean']*100:.2f}%  "
+            f"p10 {g['p10']*100:.2f}%  p50 {g['p50']*100:.2f}%  "
+            f"p90 {g['p90']*100:.2f}%",
+            event="faults_mc", mean_goodput=g["mean"],
+        )
+        for k in sorted(res["goodput_by_interval"]):
+            v = res["goodput_by_interval"][k]
+            log.info(
+                f"  checkpoint every {k:4d} steps: mean goodput "
+                f"{v*100:.2f}%",
+                event="faults_interval", interval=k, goodput=v,
+            )
+        log.info(
+            f"optimal checkpoint interval: {res['best_interval_steps']} "
+            f"steps (Young-Daly closed form: "
+            f"{res['young_daly_interval_steps']})",
+            event="faults_optimal",
+            best_interval=res["best_interval_steps"],
+        )
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                json.dump(res, f, indent=1)
+            log.info(f"analysis -> {args.json}", event="faults_json",
+                     path=args.json)
+        return
+    if not args.scenario:
+        raise SystemExit(
+            "error: faults needs --scenario SCENARIO.json or "
+            "--monte-carlo N"
+        )
+    scenario = FaultScenario.from_json(args.scenario)
+    if args.horizon:
+        scenario.horizon_steps = args.horizon
+    with perf.diagnostics.capture(category="faults"):
+        report = perf.predict_goodput(
+            scenario, spec=build_spec(scenario),
+            granularity=args.granularity,
+        )
+    for line in goodput_waterfall_lines(report):
+        log.info(line, event="goodput_waterfall")
+    log.info(goodput_attribution_line(report), event="goodput_line",
+             goodput=report.goodput)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report.to_dict(), f, indent=1)
+        log.info(f"goodput report -> {args.json}", event="faults_json",
+                 path=args.json)
+
+
 def cmd_dualpp(args):
     from simumax_tpu import PerfLLM
 
@@ -508,6 +641,12 @@ def main(argv=None):
         help="write trace.json incrementally while simulating (peak RSS "
              "stays bounded at pod-size world-rank runs)",
     )
+    pp.add_argument(
+        "--faults", metavar="SCENARIO.json",
+        help="inject a fault scenario (docs/faults.md schema) into the "
+             "simulated step: rank slowdowns, preemptions, link "
+             "degradation, rank deaths; implies --world-ranks",
+    )
     pp.add_argument("--graph", action="store_true", help="capture op graph")
     _add_diag_args(pp)
     _add_log_args(pp)
@@ -618,6 +757,44 @@ def main(argv=None):
     _add_log_args(pc)
     pc.set_defaults(fn=cmd_calibrate)
 
+    pf = sub.add_parser(
+        "faults",
+        help="goodput prediction under a fault scenario, or seeded "
+             "Monte-Carlo over sampled scenarios (docs/faults.md)",
+    )
+    pf.add_argument("--model", required=True)
+    pf.add_argument("--strategy", required=True)
+    pf.add_argument("--system", required=True)
+    pf.add_argument("--scenario", metavar="SCENARIO.json",
+                    help="fault-scenario JSON to predict goodput for")
+    pf.add_argument("--monte-carlo", type=int, default=0, metavar="N",
+                    help="sample N random scenarios instead of loading "
+                         "one (seeded, deterministic)")
+    pf.add_argument("--seed", type=int, default=0,
+                    help="Monte-Carlo RNG seed (default 0)")
+    pf.add_argument("--horizon", type=int, default=0, metavar="STEPS",
+                    help="job horizon in steps (default: the scenario's "
+                         "horizon_steps; 50 for --monte-carlo)")
+    pf.add_argument("--ckpt-interval", type=int, default=0,
+                    metavar="STEPS",
+                    help="checkpoint every K steps (default: scenario "
+                         "override or 50)")
+    pf.add_argument("--restart-overhead", type=float, default=None,
+                    metavar="SECONDS",
+                    help="restart overhead per failure (default 120)")
+    pf.add_argument("--granularity", choices=("chunk", "leaf"),
+                    default="chunk",
+                    help="step-replay granularity: 'leaf' resolves "
+                         "intra-stage (tp/cp/ep) collectives so "
+                         "link_degradation on those dims takes effect; "
+                         "'chunk' (default) is faster and models "
+                         "pp/dp_cp/edp faults exactly")
+    pf.add_argument("--json", metavar="PATH",
+                    help="save the full goodput report / analysis JSON")
+    _add_diag_args(pf)
+    _add_log_args(pf)
+    pf.set_defaults(fn=cmd_faults)
+
     pd = sub.add_parser(
         "dualpp",
         help="DualPipe bidirectional-schedule projection (even pp)",
@@ -659,6 +836,7 @@ def main(argv=None):
     # still traceback — that is the right behavior for them.
     from simumax_tpu.core.errors import (
         ConfigError,
+        SimulationError,
         SimuMaxError,
         UnknownConfigError,
     )
@@ -673,6 +851,16 @@ def main(argv=None):
     except ConfigError as e:
         print(f"error: invalid configuration — {e}", file=sys.stderr)
         sys.exit(EXIT_CONFIG)
+    except SimulationError as e:
+        # same one-line treatment as the ConfigError family: a
+        # DeadlockError's multi-line state dump belongs in the
+        # diagnostics report, not on stderr
+        first = (str(e) or type(e).__name__).splitlines()[0]
+        print(f"error: simulation failed — {type(e).__name__}: {first}",
+              file=sys.stderr)
+        print("hint: rerun with --diagnostics PATH for the full "
+              "engine state dump", file=sys.stderr)
+        sys.exit(EXIT_SIMULATION)
     except SimuMaxError as e:
         print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
         sys.exit(1)

@@ -887,6 +887,25 @@ class DcnConfig:
 
 
 @dataclass
+class HostConfig:
+    """Per-chip host attach for checkpoint/restore traffic: the
+    HBM → host (PCIe/offload) → persistent storage / DCN chain the
+    checkpoint cost model streams through (``simulator/faults.py::
+    CheckpointCostModel``, ``docs/faults.md``). Bandwidths are
+    effective per-chip GB/s shares."""
+
+    #: device-to-host transfer share per chip (e.g. 100 GB/s PCIe
+    #: shared by a 4-chip host)
+    d2h_gbps: float = 25.0
+    #: sustained per-chip write share of the checkpoint store
+    ckpt_write_gbps: float = 1.0
+    #: sustained per-chip read share on restore (reads fan out wider)
+    ckpt_read_gbps: float = 2.0
+    #: fixed commit/barrier latency per checkpoint or restore
+    latency_s: float = 1.0
+
+
+@dataclass
 class AcceleratorSpec:
     backend: str = "tpu"
     mem_gbs: float = 16.0  # HBM capacity in GiB
@@ -922,6 +941,10 @@ class SystemConfig(ConfigBase):
     accelerator: Any = field(default_factory=AcceleratorSpec)
     ici: Any = field(default_factory=IciConfig)
     dcn: Any = field(default_factory=DcnConfig)
+    #: checkpoint/restore chain (HBM -> host -> storage), consumed by
+    #: the fault/goodput layer; excluded from :meth:`fingerprint`
+    #: (it is a policy surface, not calibrated compute identity)
+    host: Any = field(default_factory=HostConfig)
     #: calibration-table provenance stamp written by
     #: ``calibration.autocal.calibrate_system``: ``system_hash``
     #: (``fingerprint()`` of the hardware identity at calibration time),
@@ -940,6 +963,8 @@ class SystemConfig(ConfigBase):
             self.ici = IciConfig(**self.ici)
         if isinstance(self.dcn, dict):
             self.dcn = DcnConfig(**self.dcn)
+        if isinstance(self.host, dict):
+            self.host = HostConfig(**self.host)
         self.reset_status()
         self._check_provenance()
 

@@ -276,6 +276,42 @@ class CollectiveSpan:
         return asdict(self)
 
 
+@_addable
+@dataclass
+class GoodputBuckets:
+    """Wall-time decomposition of a multi-step goodput prediction
+    (``simulator/faults.py::predict_goodput``, rendered by
+    ``observe/ledger.py::goodput_waterfall_lines``). All seconds; the
+    accounting is constructive, so the fields sum to the job wall time
+    exactly and ``goodput = useful_train / wall_time``."""
+
+    #: committed training steps charged at the healthy step time
+    useful_train: float = 0.0
+    #: extra step time injected by slowdowns / preemptions / degraded
+    #: links on committed steps
+    fault_stall: float = 0.0
+    #: periodic checkpoint writes (HBM -> host -> storage chain)
+    checkpoint_write: float = 0.0
+    #: restore reads after a failure (storage -> host -> HBM chain)
+    restore_read: float = 0.0
+    #: failure detection + rescheduling + re-init per restart
+    restart_overhead: float = 0.0
+    #: wall time of work lost to a failure and re-run: steps committed
+    #: since the last checkpoint plus the aborted partial step
+    restart_replay: float = 0.0
+
+    @property
+    def wall_time(self) -> float:
+        return (
+            self.useful_train + self.fault_stall + self.checkpoint_write
+            + self.restore_read + self.restart_overhead
+            + self.restart_replay
+        )
+
+    def to_dict(self) -> Dict[str, float]:
+        return asdict(self)
+
+
 @dataclass
 class DiagnosticEvent:
     """One diagnostic fact: a funneled warning, a quarantined candidate,

@@ -65,6 +65,78 @@ _SHORT = {
     "straggler": "strag",
 }
 
+#: goodput waterfall buckets in presentation order; they sum to the job
+#: wall time (``simulator/faults.py::predict_goodput``, docs/faults.md)
+GOODPUT_WATERFALL_ORDER = (
+    "useful_train",
+    "fault_stall",
+    "checkpoint_write",
+    "restore_read",
+    "restart_overhead",
+    "restart_replay",
+)
+
+_GOODPUT_SHORT = {
+    "useful_train": "useful",
+    "fault_stall": "stall",
+    "checkpoint_write": "ckpt",
+    "restore_read": "restore",
+    "restart_overhead": "restart",
+    "restart_replay": "replay",
+}
+
+
+def build_goodput_waterfall(report) -> Dict[str, Any]:
+    """Normalize a ``GoodputReport`` (or its ``to_dict()``) into the
+    same ``{order, buckets, total}`` shape as the MFU-loss waterfall —
+    buckets sum to the job wall time within 1e-6 by construction (the
+    goodput accounting is itself the decomposition)."""
+    d = report if isinstance(report, dict) else report.to_dict()
+    buckets = {k: d["buckets"][k] for k in GOODPUT_WATERFALL_ORDER}
+    return {
+        "order": list(GOODPUT_WATERFALL_ORDER),
+        "buckets": buckets,
+        "total": d["wall_time_s"],
+        "goodput": d["goodput"],
+        "horizon_steps": d["horizon_steps"],
+        "n_restarts": d["n_restarts"],
+        "n_checkpoints": d["n_checkpoints"],
+    }
+
+
+def goodput_waterfall_lines(report) -> List[str]:
+    """Human rendering of the goodput wall-time decomposition (the
+    ``faults`` subcommand's default output)."""
+    wf = build_goodput_waterfall(report)
+    total = wf["total"] or 1.0
+    width = max(len(k) for k in wf["order"])
+    lines = [
+        f"== goodput waterfall: {wf['horizon_steps']} steps — wall "
+        f"{total:.1f} s, goodput {100.0 * wf['goodput']:.2f}% "
+        f"({wf['n_checkpoints']} checkpoints, {wf['n_restarts']} "
+        f"restarts) =="
+    ]
+    for key in wf["order"]:
+        v = wf["buckets"][key]
+        pct = round(100.0 * v / total, 2) + 0.0
+        lines.append(f"  {key:<{width}}  {v:12.3f} s  {pct:6.2f}%")
+    lines.append(
+        f"  {'= wall time':<{width}}  {total:12.3f} s  100.00%"
+    )
+    return lines
+
+
+def goodput_attribution_line(report) -> str:
+    """One-line goodput summary, e.g. ``useful 91.2% | stall 3.1% |
+    ckpt 2.0% | restore 0.4% | restart 1.8% | replay 1.5%``."""
+    wf = build_goodput_waterfall(report)
+    total = wf["total"] or 1.0
+    parts = []
+    for k in GOODPUT_WATERFALL_ORDER:
+        pct = round(100.0 * wf["buckets"][k] / total, 1) + 0.0
+        parts.append(f"{_GOODPUT_SHORT[k]} {pct:.1f}%")
+    return " | ".join(parts)
+
 
 def collect_op_spans(perf) -> Tuple[List[OpSpan], List[CollectiveSpan]]:
     """Walk every called leaf of the estimate's module tree and rebuild

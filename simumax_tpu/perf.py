@@ -1145,6 +1145,26 @@ class PerfLLM(PerfBase):
 
         return run_simulation(self, save_path, **kwargs)
 
+    def predict_goodput(self, scenario, **kwargs):
+        """Goodput prediction for a fault scenario over its job horizon
+        (``simulator/faults.py``, ``docs/faults.md``): per-step
+        discrete-event replays under the scenario's timed faults plus
+        the checkpoint-write / restore-read / restart-replay cost
+        model. Returns a ``GoodputReport`` whose wall-time buckets sum
+        to the wall time exactly."""
+        from simumax_tpu.simulator.faults import predict_goodput
+
+        return predict_goodput(self, scenario, **kwargs)
+
+    def analyze_faults(self, **kwargs):
+        """Seeded Monte-Carlo goodput analysis: sample N random fault
+        scenarios, predict each one's goodput, and sweep checkpoint
+        intervals for the optimum (``simulator/faults.py::
+        analyze_faults``)."""
+        from simumax_tpu.simulator.faults import analyze_faults
+
+        return analyze_faults(self, **kwargs)
+
     def analysis_dualpp(self, save_path: Optional[str] = None):
         """Per-rank DualPipe projection of this estimate (even pp only):
         bidirectional schedule, 2 stage chunks per rank, pp+1 in-flight

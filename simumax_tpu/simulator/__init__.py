@@ -1,1 +1,8 @@
+from simumax_tpu.simulator.faults import (  # noqa: F401
+    CheckpointSpec,
+    FaultEvent,
+    FaultScenario,
+    analyze_faults,
+    predict_goodput,
+)
 from simumax_tpu.simulator.runner import run_simulation  # noqa: F401

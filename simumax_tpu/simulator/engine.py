@@ -118,6 +118,9 @@ class _Rendezvous:
     #: peers that served their completion — the rendezvous record is
     #: deleted when every peer consumed it (bounded-memory contract)
     consumed: int = 0
+    #: op name, retained so a deferred completion (a dead peer resolved
+    #: by the fault model) can still emit a labelled trace span
+    name: str = ""
 
     @property
     def complete(self) -> bool:
@@ -134,7 +137,14 @@ class SimuEngine:
     """Deterministic multi-rank virtual-time executor."""
 
     def __init__(self, num_ranks: int,
-                 event_sink: Optional[Callable[[TraceEvent], None]] = None):
+                 event_sink: Optional[Callable[[TraceEvent], None]] = None,
+                 fault_model=None):
+        #: optional fault-injection hook (see ``simulator/faults.py::
+        #: StepFaultModel``) consulted at event-service time: piecewise
+        #: compute-rate multipliers, comm-time multipliers per
+        #: collective dim, and rank death times. ``None`` keeps every
+        #: code path bit-identical to the fault-free engine.
+        self._fault = fault_model
         self.num_ranks = num_ranks
         self.clock = [0.0] * num_ranks  # per-rank main lane clock
         #: retained trace records (unused when ``event_sink`` streams
@@ -176,6 +186,11 @@ class SimuEngine:
         self.comm_done = [0.0] * num_ranks
         self._async_pending: List[set] = [set() for _ in range(num_ranks)]
         self.mem_hooks: List[Callable[[int, str, float], None]] = []
+        #: graceful-degradation state: ranks killed by the fault model,
+        #: their death (virtual) times, and the kill log in kill order
+        self._dead = [False] * num_ranks
+        self._death_at: Dict[int, float] = {}
+        self.deaths: List[Tuple[int, float]] = []
 
     def add_rank(self, rank: int, proc: Generator):
         self._procs[rank] = proc
@@ -187,16 +202,37 @@ class SimuEngine:
         for r in range(self.num_ranks):
             self._advance_rank(r, None)
         ready = self._ready
-        while ready:
-            _, r = heappop(ready)
-            self._queued[r] = False
-            if self._done[r] or self._pending[r] is None:
-                continue
-            if not self._try_serve(r):
-                self._block(r)
-        if self._n_done < self.num_ranks:
-            # heap drained with live ranks left: nothing can wake them
-            self._deadlock_dump()
+        while True:
+            while ready:
+                _, r = heappop(ready)
+                self._queued[r] = False
+                if self._done[r] or self._pending[r] is None:
+                    continue
+                if not self._try_serve(r):
+                    self._block(r)
+            if self._n_done >= self.num_ranks:
+                break
+            # heap drained with live ranks left: nothing can wake them —
+            # unless a blocked rank is scheduled to die, in which case
+            # the death resolves its partners' waits (graceful
+            # degradation via the fault model, not a deadlock). Kill
+            # only the EARLIEST death per drain pass: resolving it may
+            # unblock later-doomed ranks, which then live to finish
+            # the step instead of being spuriously killed at their own
+            # (possibly far-future) death time.
+            doomed = []
+            if self._fault is not None:
+                doomed = [
+                    (self._fault.death_time(r), r)
+                    for r in range(self.num_ranks)
+                    if not self._done[r]
+                    and self._fault.death_time(r) is not None
+                ]
+            if not doomed:
+                self._deadlock_dump()
+            dt, r = min(doomed)
+            self.clock[r] = max(self.clock[r], dt)
+            self._kill(r)
         return max(self.clock) if self.clock else 0.0
 
     # -- scheduler plumbing ------------------------------------------------
@@ -275,6 +311,76 @@ class SimuEngine:
             f"unblockable request {req!r}", phase="simulate", rank=rank
         )
 
+    def _complete_rv(self, pub_key: tuple, rv: _Rendezvous, key):
+        """Fix a sync rendezvous' completion time and wake its waiters.
+        Dead peers that never arrived contribute their death time as
+        the arrival (the survivors resolve via the fault model); the
+        duration picks up any active link-degradation multiplier at
+        the rendezvous start."""
+        dead_times = []
+        if self._fault is not None:
+            dead_times = [
+                self._death_at[p] for p in rv.peers
+                if p not in rv.arrivals and self._dead[p]
+            ]
+        start = max(list(rv.arrivals.values()) + dead_times)
+        dur = rv.duration
+        if self._fault is not None:
+            dur *= self._fault.comm_scale(key, rv.peers, start)
+        rv.end = start + dur
+        self._publish(pub_key)
+
+    def _kill(self, rank: int):
+        """The fault model killed ``rank`` at its current clock: close
+        its coroutine, resolve every rendezvous now waiting only on the
+        dead, and wake all blocked ranks so their service attempts
+        re-evaluate against the updated death state."""
+        t = self.clock[rank]
+        self._dead[rank] = True
+        self._death_at[rank] = t
+        self.deaths.append((rank, t))
+        self._emit(TraceEvent(rank, "comp", "rank_death", t, t,
+                              kind="fault"))
+        proc = self._procs[rank]
+        if proc is not None:
+            proc.close()
+        if not self._done[rank]:
+            self._done[rank] = True
+            self._n_done += 1
+        self._pending[rank] = None
+        for k in self._waiting_on[rank]:
+            ws = self._waiters.get(k)
+            if ws is not None:
+                ws.discard(rank)
+                if not ws:
+                    del self._waiters[k]
+        self._waiting_on[rank] = ()
+        # p2p state only the dead rank could ever consume (inbound
+        # sends and its posted recv windows): drop it — bounded-memory
+        # contract, and senders rendezvousing against the dead rank
+        # must abort via the fault model, not complete into a corpse
+        for skey in [k for k in self._sends if k[1] == rank]:
+            del self._sends[skey]
+            self._flow_ids.pop(skey, None)
+        for skey in [k for k in self._recv_posts if k[1] == rank]:
+            del self._recv_posts[skey]
+        # async rendezvous the dead rank never posted to: finish the
+        # ones every live peer has posted, drop the ones nobody can
+        for ckey, rv in list(self._async_rv.items()):
+            if rank not in rv.peers or rank in rv.arrivals:
+                continue
+            if all(self._dead[p] for p in rv.peers):
+                del self._async_rv[ckey]
+                continue
+            if all(p in rv.arrivals or self._dead[p] for p in rv.peers):
+                self._finish_async(ckey, rv, rv.name or "async")
+        self._async_pending[rank].clear()
+        # wake everyone blocked: collective / p2p dead-peer resolution
+        # happens inside their re-served requests
+        for r in range(self.num_ranks):
+            if self._waiting_on[r]:
+                self._wake(r)
+
     def _emit(self, ev: TraceEvent):
         self.num_events += 1
         self.events_by_rank[ev.rank] += 1
@@ -298,16 +404,33 @@ class SimuEngine:
         self._enqueue(rank)
 
     def _try_serve(self, rank: int) -> bool:
+        fault = self._fault
+        if fault is not None and not self._dead[rank]:
+            dt = fault.death_time(rank)
+            if dt is not None and self.clock[rank] >= dt:
+                self._kill(rank)
+                return True
         req = self._pending[rank]
         kind = req[0]
         if kind == "compute":
             _, duration, name, lane = req
             start = self.clock[rank]
-            self.clock[rank] = start + duration
-            if duration > 0:
-                self._emit(
-                    TraceEvent(rank, lane, name, start, self.clock[rank])
-                )
+            if fault is not None:
+                end = fault.compute_end(rank, start, duration)
+                dt = fault.death_time(rank)
+                if dt is not None and end > dt:
+                    # the rank dies mid-op: emit the truncated span,
+                    # then let the kill resolve its partners
+                    if dt > start:
+                        self._emit(TraceEvent(rank, lane, name, start, dt))
+                    self.clock[rank] = dt
+                    self._kill(rank)
+                    return True
+            else:
+                end = start + duration
+            self.clock[rank] = end
+            if end > start:
+                self._emit(TraceEvent(rank, lane, name, start, end))
             self._advance_rank(rank, self.clock[rank])
             return True
         if kind == "advance":
@@ -332,7 +455,7 @@ class SimuEngine:
             rv = self._collectives.get(ckey)
             if rv is None:
                 rv = self._collectives[ckey] = _Rendezvous(
-                    peers=ckey[1], duration=duration
+                    peers=ckey[1], duration=duration, name=name
                 )
             if rank not in rv.arrivals:
                 if rank not in rv.peers:
@@ -353,8 +476,16 @@ class SimuEngine:
                         phase="simulate", rank=rank, collective=str(key),
                     )
                 if rv.complete:
-                    rv.end = max(rv.arrivals.values()) + rv.duration
-                    self._publish(("coll",) + ckey)
+                    self._complete_rv(("coll",) + ckey, rv, key)
+            if rv.end is None and fault is not None:
+                # graceful degradation: with every live peer arrived
+                # and the rest dead, the survivors resolve against the
+                # fault model (arrival time = the peer's death time)
+                # instead of deadlocking on a rendezvous that can
+                # never complete
+                if all(p in rv.arrivals or self._dead[p]
+                       for p in rv.peers):
+                    self._complete_rv(("coll",) + ckey, rv, key)
             if rv.end is None:
                 return False  # stay blocked until the last peer arrives
             start = self.clock[rank]
@@ -365,7 +496,10 @@ class SimuEngine:
             self.clock[rank] = end
             self._coll_seq[(key, rank)] = seq + 1
             rv.consumed += 1
-            if rv.consumed == len(rv.peers):
+            live = len(rv.peers) if fault is None else sum(
+                1 for p in rv.peers if not self._dead[p]
+            )
+            if rv.consumed >= live:
                 del self._collectives[ckey]
             self._advance_rank(rank, end)
             return True
@@ -378,7 +512,7 @@ class SimuEngine:
             rv = self._async_rv.get(ckey)
             if rv is None:
                 rv = self._async_rv[ckey] = _Rendezvous(
-                    peers=pset, duration=duration
+                    peers=pset, duration=duration, name=name
                 )
             if rank not in rv.peers:
                 raise SimulationError(
@@ -396,6 +530,12 @@ class SimuEngine:
             rv.arrivals[rank] = self.clock[rank]
             self._async_pending[rank].add(ckey)
             if rv.complete:
+                self._finish_async(ckey, rv, name)
+            elif fault is not None and all(
+                p in rv.arrivals or self._dead[p] for p in rv.peers
+            ):
+                # the missing posters are dead: the live peers resolve
+                # via the fault model instead of waiting forever
                 self._finish_async(ckey, rv, name)
             # poster never blocks: continue at the unchanged clock
             self._advance_rank(rank, self.clock[rank])
@@ -418,6 +558,10 @@ class SimuEngine:
                     phase="simulate", rank=rank, send=str(skey),
                 )
             post = self.clock[rank]
+            if fault is not None:
+                duration = duration * fault.comm_scale(
+                    "pp", (rank, dst), post
+                )
             self._sends[skey] = (post, duration)
             fid = self._next_flow
             self._next_flow += 1
@@ -437,9 +581,26 @@ class SimuEngine:
             # rendezvous: wait until the peer posts the matching recv
             recv_post = self._recv_posts.get(skey)
             if recv_post is None:
+                if fault is not None and self._dead[dst]:
+                    # peer died before posting its recv: the sender
+                    # resolves via the fault model and aborts the send
+                    self._send_seq[(rank, dst, tag)] = seq + 1
+                    end = max(self.clock[rank], self._death_at[dst])
+                    if end > self.clock[rank]:
+                        self._emit(
+                            TraceEvent(rank, lane, f"abort_{name}",
+                                       self.clock[rank], end, kind="fault")
+                        )
+                    self.clock[rank] = end
+                    self._advance_rank(rank, end)
+                    return True
                 return False  # peer not at its recv yet: stay blocked
             self._send_seq[(rank, dst, tag)] = seq + 1
             start = max(self.clock[rank], recv_post)
+            if fault is not None:
+                duration = duration * fault.comm_scale(
+                    "pp", (rank, dst), start
+                )
             end = start + duration
             # publish as a completed transfer for the recv side
             self._sends[skey] = (start, duration)
@@ -465,6 +626,20 @@ class SimuEngine:
                 self._recv_posts[skey] = self.clock[rank]
                 self._publish(("recvpost", skey))
             if skey not in self._sends:
+                if fault is not None and self._dead[src]:
+                    # sender died without posting: the receiver learns
+                    # of the death via the fault model and aborts
+                    self._recv_posts.pop(skey, None)
+                    self._recv_seq[(rank, src, tag)] = seq + 1
+                    end = max(self.clock[rank], self._death_at[src])
+                    if end > self.clock[rank]:
+                        self._emit(
+                            TraceEvent(rank, lane, f"abort_{name}",
+                                       self.clock[rank], end, kind="fault")
+                        )
+                    self.clock[rank] = end
+                    self._advance_rank(rank, end)
+                    return True
                 return False  # sender hasn't posted yet
             post, duration = self._sends.pop(skey)
             if skey in self._sr_done:
@@ -492,6 +667,10 @@ class SimuEngine:
             _, dst, stag, sdur, src, rtag, name, *rest = req
             lane = rest[0] if rest else "pp_fwd"
             post_t = self.clock[rank]
+            if fault is not None and dst is not None:
+                # a blocked request re-serves at an unchanged clock, so
+                # this samples the same multiplier on every attempt
+                sdur = sdur * fault.comm_scale("pp", (rank, dst), post_t)
             out_key = None
             if dst is not None:
                 # publish the outbound send exactly once per pending
@@ -522,6 +701,25 @@ class SimuEngine:
                     self._recv_posts[in_key] = self.clock[rank]
                     self._publish(("recvpost", in_key))
                 if in_key not in self._sends:
+                    if fault is not None and self._dead[src]:
+                        # inbound sender died without posting: resolve
+                        # both halves of the batched pair via the fault
+                        # model (the outbound stays published — a live
+                        # peer may still consume it)
+                        self._recv_posts.pop(in_key, None)
+                        self._recv_seq[(rank, src, rtag)] = seq + 1
+                        if out_key is not None:
+                            self._sr_done.pop(out_key, None)
+                        end = max(self.clock[rank], self._death_at[src])
+                        if end > self.clock[rank]:
+                            self._emit(
+                                TraceEvent(rank, lane, f"abort_{name}",
+                                           self.clock[rank], end,
+                                           kind="fault")
+                            )
+                        self.clock[rank] = end
+                        self._advance_rank(rank, end)
+                        return True
                     return False  # inbound not posted yet
             if out_key is not None and in_key is None:
                 # send-only batched call: true rendezvous — completes
@@ -534,6 +732,20 @@ class SimuEngine:
                 # at 1F1B phase boundaries) do not have.
                 peer_post = self._recv_posts.get(out_key)
                 if peer_post is None and out_key in self._sends:
+                    if fault is not None and self._dead[dst]:
+                        # peer died before posting the matching recv:
+                        # the sender aborts the rendezvous
+                        self._sr_done.pop(out_key, None)
+                        end = max(self.clock[rank], self._death_at[dst])
+                        if end > self.clock[rank]:
+                            self._emit(
+                                TraceEvent(rank, lane, f"abort_{name}",
+                                           self.clock[rank], end,
+                                           kind="fault")
+                            )
+                        self.clock[rank] = end
+                        self._advance_rank(rank, end)
+                        return True
                     return False  # peer's recv not posted yet
             end = self.clock[rank]
             if in_key is not None:
@@ -569,17 +781,31 @@ class SimuEngine:
         )
 
     def _finish_async(self, ckey: tuple, rv: _Rendezvous, name: str):
-        """All peers posted: schedule the op on its comm stream (starts
-        after the stream's previous op and the last arrival) and record
-        completion for every peer."""
+        """All peers posted (or the missing posters are dead): schedule
+        the op on its comm stream (starts after the stream's previous
+        op and the last arrival — a dead peer's death time counts as
+        its arrival) and record completion for every live peer."""
         stream, pset, _seq = ckey
         chain_key = (stream, pset)
+        dead_times = []
+        if self._fault is not None:
+            dead_times = [
+                self._death_at[p] for p in pset
+                if p not in rv.arrivals and self._dead[p]
+            ]
         start = max(
-            max(rv.arrivals.values()), self._async_chain.get(chain_key, 0.0)
+            max(rv.arrivals.values()), self._async_chain.get(chain_key, 0.0),
+            *dead_times,
         )
-        end = start + rv.duration
+        dur = rv.duration
+        if self._fault is not None:
+            dur *= self._fault.comm_scale(stream, pset, start)
+        end = start + dur
         self._async_chain[chain_key] = end
         for peer in pset:
+            if self._fault is not None and self._dead[peer]:
+                self._async_pending[peer].discard(ckey)
+                continue
             self.comm_done[peer] = max(self.comm_done[peer], end)
             self._async_pending[peer].discard(ckey)
             if not self._async_pending[peer]:

@@ -1,0 +1,914 @@
+"""Fault injection, checkpoint/restore cost model, goodput prediction.
+
+SimuMax predicts MFU for a *healthy* job; at pod scale a real TPU
+training run also spends wall-clock on preemptions, slow hosts,
+degraded links, and checkpoint/restore — the gap between MFU and
+*goodput* that resilient-training systems (Bamboo, Oobleck) exist to
+close. This module makes failure a first-class, simulatable input:
+
+* :class:`FaultEvent` / :class:`FaultScenario` — a declarative,
+  JSON-loadable timeline of faults: per-rank compute-slowdown windows,
+  ICI/DCN link-bandwidth degradation scoped to specific collective
+  groups, host preemptions (a rank frozen for a window), and rank
+  deaths followed by restart-from-checkpoint.
+* :class:`StepFaultModel` — the discrete-event engine's view of one
+  training step: piecewise compute-rate multipliers integrated at
+  event-service time, comm-time multipliers per collective dim, and
+  death times. A dead rank no longer deadlocks the world: its
+  collective partners resolve against the fault model
+  (``SimuEngine`` consults it, see ``simulator/engine.py``) and the
+  run returns a structured :class:`FaultOutcome` instead of crashing.
+* :class:`CheckpointCostModel` — checkpoint write / restore read times
+  derived from :class:`~simumax_tpu.core.config.SystemConfig`'s
+  HBM→host→storage chain (``SystemConfig.host``) and the per-rank
+  weight + optimizer-state bytes of the estimate.
+* :func:`predict_goodput` — composes perturbed step simulations,
+  periodic checkpoint writes, and death→restart→replay sequences into
+  a wall-time decomposition (:class:`GoodputBuckets`) whose buckets
+  sum to the wall time exactly; ``goodput = useful_train / wall``.
+* :func:`analyze_faults` — seeded Monte-Carlo over sampled scenarios:
+  goodput distribution plus the empirically optimal checkpoint
+  interval (cross-checked against the Young–Daly closed form).
+
+All scenario times are **milliseconds relative to the simulated
+window** (one step for ``simulate(faults=...)``; job wall-clock for
+:func:`predict_goodput`, which re-bases events per step itself).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from simumax_tpu.core.errors import ConfigError
+from simumax_tpu.core.records import GoodputBuckets
+
+EVENT_KINDS = ("slowdown", "link_degradation", "preemption", "rank_death")
+
+#: dims a link_degradation may target: the collective-group dims the
+#: schedule issues rendezvous on, plus "pp" (p2p) and "*" (every comm op)
+LINK_DIMS = ("tp", "cp", "ep", "etp", "dp_cp", "edp", "pp", "*")
+
+
+# --------------------------------------------------------------------------
+# Scenario schema
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FaultEvent:
+    """One timed fault. Field use per ``kind``:
+
+    * ``slowdown`` — ``rank``'s compute takes ``multiplier``× longer
+      during ``[start_ms, start_ms + duration_ms)`` (``duration_ms``
+      None = until the end of the window).
+    * ``preemption`` — ``rank`` is frozen (makes no progress) for
+      ``duration_ms`` starting at ``start_ms``; collective partners
+      stall on its late arrivals.
+    * ``link_degradation`` — comm ops on ``dim`` take ``multiplier``×
+      longer while active; ``ranks`` (optional) scopes it to ops whose
+      rendezvous involves at least one listed rank.
+    * ``rank_death`` — ``rank`` dies at ``start_ms`` and never
+      returns; the job must restart from the last checkpoint
+      (:func:`predict_goodput` accounts the restart).
+    """
+
+    kind: str
+    start_ms: float = 0.0
+    duration_ms: Optional[float] = None
+    rank: Optional[int] = None
+    multiplier: float = 1.0
+    dim: Optional[str] = None
+    ranks: Optional[List[int]] = None
+
+    @property
+    def end_ms(self) -> float:
+        if self.kind == "rank_death":
+            return math.inf
+        if self.duration_ms is None:
+            return math.inf
+        return self.start_ms + self.duration_ms
+
+    def validate(self, world_size: Optional[int] = None) -> "FaultEvent":
+        def bad(msg):
+            raise ConfigError(
+                f"fault event {self.to_dict()}: {msg}",
+                phase="simulate", fault_kind=self.kind,
+            )
+
+        if self.kind not in EVENT_KINDS:
+            bad(f"unknown kind (expected one of {EVENT_KINDS})")
+        if not (isinstance(self.start_ms, (int, float))
+                and math.isfinite(self.start_ms) and self.start_ms >= 0):
+            bad("start_ms must be a finite non-negative number")
+        if self.duration_ms is not None and not (
+            isinstance(self.duration_ms, (int, float))
+            and math.isfinite(self.duration_ms) and self.duration_ms > 0
+        ):
+            bad("duration_ms must be a finite positive number")
+        if self.kind in ("slowdown", "preemption", "rank_death"):
+            if self.rank is None:
+                bad("needs a target rank")
+            if world_size is not None and not 0 <= self.rank < world_size:
+                bad(f"rank {self.rank} outside world [0, {world_size})")
+        if self.kind == "preemption" and self.duration_ms is None:
+            bad("preemption needs a finite duration_ms")
+        if self.kind in ("slowdown", "link_degradation"):
+            if not (math.isfinite(self.multiplier) and self.multiplier >= 1.0):
+                bad("multiplier must be finite and >= 1.0")
+        if self.kind == "link_degradation":
+            if self.dim not in LINK_DIMS:
+                bad(f"dim {self.dim!r} not one of {LINK_DIMS}")
+            if self.ranks is not None and world_size is not None:
+                oob = [r for r in self.ranks
+                       if not 0 <= r < world_size]
+                if oob:
+                    bad(f"scope ranks {oob} outside world "
+                        f"[0, {world_size})")
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"kind": self.kind, "start_ms": self.start_ms}
+        if self.duration_ms is not None:
+            d["duration_ms"] = self.duration_ms
+        if self.rank is not None:
+            d["rank"] = self.rank
+        if self.kind in ("slowdown", "link_degradation"):
+            d["multiplier"] = self.multiplier
+        if self.dim is not None:
+            d["dim"] = self.dim
+        if self.ranks is not None:
+            d["ranks"] = list(self.ranks)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultEvent":
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        extra = set(d) - known
+        if extra:
+            raise ConfigError(
+                f"fault event has unknown fields {sorted(extra)} "
+                f"(known: {sorted(known)})", phase="simulate",
+            )
+        return cls(**d)
+
+    def signature(self) -> tuple:
+        """Hashable identity used for symmetry-reduction coloring."""
+        return (self.kind, self.start_ms, self.duration_ms,
+                self.multiplier, self.dim)
+
+
+@dataclass
+class FaultScenario:
+    """A declarative fault timeline plus the job-level knobs goodput
+    prediction needs (horizon length, checkpoint overrides)."""
+
+    events: List[FaultEvent] = field(default_factory=list)
+    #: job horizon for goodput prediction (training steps)
+    horizon_steps: int = 100
+    #: optional :class:`CheckpointSpec` field overrides
+    checkpoint: Optional[Dict[str, Any]] = None
+    #: provenance when sampled by :func:`sample_scenario`
+    seed: Optional[int] = None
+
+    @property
+    def empty(self) -> bool:
+        return not self.events
+
+    def validate(self, world_size: Optional[int] = None) -> "FaultScenario":
+        if not isinstance(self.horizon_steps, int) or self.horizon_steps < 1:
+            raise ConfigError(
+                f"horizon_steps must be a positive int, got "
+                f"{self.horizon_steps!r}", phase="simulate",
+            )
+        for ev in self.events:
+            ev.validate(world_size)
+        return self
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "schema": "simumax-fault-scenario-v1",
+            "horizon_steps": self.horizon_steps,
+            "events": [e.to_dict() for e in self.events],
+        }
+        if self.checkpoint:
+            d["checkpoint"] = dict(self.checkpoint)
+        if self.seed is not None:
+            d["seed"] = self.seed
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultScenario":
+        schema = d.get("schema", "simumax-fault-scenario-v1")
+        if schema != "simumax-fault-scenario-v1":
+            raise ConfigError(
+                f"unknown fault-scenario schema {schema!r}",
+                phase="simulate",
+            )
+        events = [
+            e if isinstance(e, FaultEvent) else FaultEvent.from_dict(e)
+            for e in d.get("events", [])
+        ]
+        return cls(
+            events=events,
+            horizon_steps=int(d.get("horizon_steps", 100)),
+            checkpoint=d.get("checkpoint"),
+            seed=d.get("seed"),
+        )
+
+    @classmethod
+    def from_json(cls, path: str) -> "FaultScenario":
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            raise ConfigError(
+                f"cannot load fault scenario {path}: {exc}",
+                phase="simulate", path=path,
+            )
+        return cls.from_dict(data)
+
+    def save(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+    # -- step windowing / reduction support --------------------------------
+    def shifted(self, offset_ms: float, span_ms: float) -> "FaultScenario":
+        """The sub-scenario active inside ``[offset, offset + span)``,
+        with event times re-based to the window start (clamped at 0 —
+        an event already in progress is active from the window start,
+        with its remaining duration)."""
+        out: List[FaultEvent] = []
+        for ev in self.events:
+            if ev.kind == "rank_death":
+                if offset_ms <= ev.start_ms < offset_ms + span_ms:
+                    out.append(FaultEvent(
+                        "rank_death", start_ms=ev.start_ms - offset_ms,
+                        rank=ev.rank,
+                    ))
+                continue
+            if ev.end_ms <= offset_ms or ev.start_ms >= offset_ms + span_ms:
+                continue
+            start = max(ev.start_ms - offset_ms, 0.0)
+            dur = None
+            if ev.duration_ms is not None:
+                dur = ev.end_ms - offset_ms - start
+            out.append(FaultEvent(
+                ev.kind, start_ms=start, duration_ms=dur, rank=ev.rank,
+                multiplier=ev.multiplier, dim=ev.dim,
+                ranks=list(ev.ranks) if ev.ranks is not None else None,
+            ))
+        return FaultScenario(events=out, horizon_steps=self.horizon_steps,
+                             checkpoint=self.checkpoint, seed=self.seed)
+
+    def signature(self) -> tuple:
+        """Hashable identity of the event set (step-result caching)."""
+        return tuple(
+            ev.signature() + (ev.rank, tuple(ev.ranks) if ev.ranks else None)
+            for ev in self.events
+        )
+
+    def rank_signatures(self) -> Dict[int, tuple]:
+        """Per-rank fault signature for rank-symmetry reduction: two
+        ranks with different signatures must land in different classes
+        (``simulator/reduce.py`` colors on this), so a fault shatters
+        exactly the symmetry it breaks — globally-scoped link events
+        perturb every group of a dim identically and shatter nothing."""
+        sigs: Dict[int, List[tuple]] = {}
+        for ev in self.events:
+            targets: Sequence[int] = ()
+            if ev.rank is not None:
+                targets = (ev.rank,)
+            elif ev.kind == "link_degradation" and ev.ranks is not None:
+                targets = ev.ranks
+            for r in targets:
+                sigs.setdefault(r, []).append(ev.signature())
+        return {r: tuple(sorted(s)) for r, s in sigs.items()}
+
+
+# --------------------------------------------------------------------------
+# Engine-facing fault model (one step window, times in SECONDS)
+# --------------------------------------------------------------------------
+
+
+def _key_dim(key) -> Optional[str]:
+    """Collective dim of an engine rendezvous key. Keys are either
+    ``(dim, group)`` tuples (leaf collectives), strings like
+    ``"grad_rs:dp_cp"`` / ``"param_ag:edp"`` (bucketed DP streams and
+    their async-stream names), or ``"optimizer_barrier"``."""
+    if isinstance(key, tuple):
+        key = key[0]
+    if not isinstance(key, str):
+        return None
+    return key.rsplit(":", 1)[-1] if ":" in key else key
+
+
+class StepFaultModel:
+    """The engine's consult-at-service-time view of a scenario, scoped
+    to one simulated step. All times are seconds relative to the step
+    start. ``rank_map`` translates engine ranks to global ranks when
+    the engine runs one representative per symmetry class."""
+
+    def __init__(self, scenario: FaultScenario,
+                 rank_map: Optional[Sequence[int]] = None):
+        self.scenario = scenario
+        self._map = list(rank_map) if rank_map is not None else None
+        #: global rank -> [(start_s, end_s, multiplier)]; multiplier
+        #: math.inf encodes a preemption freeze (progress rate 0)
+        self._slow: Dict[int, List[Tuple[float, float, float]]] = {}
+        #: (dim, start_s, end_s, multiplier, scope frozenset | None)
+        self._links: List[Tuple[str, float, float, float,
+                                Optional[frozenset]]] = []
+        #: global rank -> earliest death time (s)
+        self._deaths: Dict[int, float] = {}
+        for ev in scenario.events:
+            s = ev.start_ms * 1e-3
+            e = ev.end_ms * 1e-3 if math.isfinite(ev.end_ms) else math.inf
+            if ev.kind == "slowdown":
+                self._slow.setdefault(ev.rank, []).append(
+                    (s, e, ev.multiplier)
+                )
+            elif ev.kind == "preemption":
+                self._slow.setdefault(ev.rank, []).append((s, e, math.inf))
+            elif ev.kind == "link_degradation":
+                scope = (frozenset(ev.ranks)
+                         if ev.ranks is not None else None)
+                self._links.append((ev.dim, s, e, ev.multiplier, scope))
+            elif ev.kind == "rank_death":
+                prev = self._deaths.get(ev.rank)
+                self._deaths[ev.rank] = s if prev is None else min(prev, s)
+        for wins in self._slow.values():
+            wins.sort()
+
+    def _g(self, engine_rank: int) -> int:
+        return self._map[engine_rank] if self._map is not None \
+            else engine_rank
+
+    def death_time(self, engine_rank: int) -> Optional[float]:
+        return self._deaths.get(self._g(engine_rank))
+
+    @property
+    def has_deaths(self) -> bool:
+        return bool(self._deaths)
+
+    def compute_end(self, engine_rank: int, start: float,
+                    duration: float) -> float:
+        """Wall end time of ``duration`` seconds of work starting at
+        ``start`` under this rank's piecewise slowdown windows
+        (progress rate ``1/Π multipliers`` of the active windows, 0
+        while preempted)."""
+        wins = self._slow.get(self._g(engine_rank))
+        if not wins or duration <= 0:
+            return start + duration
+        edges = sorted({x for w in wins for x in w[:2]
+                        if math.isfinite(x) and x > start})
+        t, work = start, duration
+        ei = 0
+        while True:
+            mult = 1.0
+            for (s, e, m) in wins:
+                if s <= t < e:
+                    mult = math.inf if m == math.inf else mult * m
+            while ei < len(edges) and edges[ei] <= t:
+                ei += 1
+            nxt = edges[ei] if ei < len(edges) else math.inf
+            if mult == math.inf:
+                # frozen: no progress until the window closes (finite
+                # by validation)
+                t = nxt
+                continue
+            need = work * mult
+            if t + need <= nxt:
+                return t + need
+            work -= (nxt - t) / mult
+            t = nxt
+
+    def comm_scale(self, key, engine_peers: Sequence[int],
+                   t: float) -> float:
+        """Comm-time multiplier of one rendezvous/p2p op at service
+        time ``t``: the product of active link windows matching its dim
+        whose scope (if any) intersects the participating ranks."""
+        if not self._links:
+            return 1.0
+        dim = _key_dim(key)
+        m = 1.0
+        for (d, s, e, mult, scope) in self._links:
+            if not s <= t < e:
+                continue
+            if d != "*" and d != dim:
+                continue
+            if scope is not None and not any(
+                self._g(p) in scope for p in engine_peers
+            ):
+                continue
+            m *= mult
+        return m
+
+
+@dataclass
+class FaultOutcome:
+    """Structured result of a faulted simulation: whether the step
+    completed, who died when, how much was injected."""
+
+    applied_events: int
+    completed: bool
+    deaths: List[Dict[str, float]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema": "simumax-fault-outcome-v1",
+            "applied_events": self.applied_events,
+            "completed": self.completed,
+            "deaths": list(self.deaths),
+        }
+
+
+# --------------------------------------------------------------------------
+# Checkpoint / restore cost model
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CheckpointSpec:
+    """Checkpointing policy knobs (overridable per scenario via
+    ``FaultScenario.checkpoint``)."""
+
+    #: write a checkpoint every N committed steps
+    interval_steps: int = 50
+    #: failure detection + rescheduling + process restart + re-init,
+    #: before the restore read begins
+    restart_overhead_s: float = 120.0
+    #: bandwidth overrides (GB/s per chip); None = derive from
+    #: ``SystemConfig.host``
+    write_gbps: Optional[float] = None
+    read_gbps: Optional[float] = None
+
+    @classmethod
+    def from_overrides(cls, overrides: Optional[Dict[str, Any]],
+                       base: Optional["CheckpointSpec"] = None
+                       ) -> "CheckpointSpec":
+        spec = base or cls()
+        if not overrides:
+            return spec
+        known = {f for f in cls.__dataclass_fields__}  # type: ignore[attr-defined]
+        extra = set(overrides) - known
+        if extra:
+            raise ConfigError(
+                f"unknown checkpoint fields {sorted(extra)} "
+                f"(known: {sorted(known)})", phase="simulate",
+            )
+        kw = {f: getattr(spec, f) for f in known}
+        kw.update(overrides)
+        out = cls(**kw)
+        if out.interval_steps < 1:
+            raise ConfigError(
+                f"checkpoint interval_steps must be >= 1, got "
+                f"{out.interval_steps}", phase="simulate",
+            )
+        return out
+
+
+@dataclass
+class CheckpointCostModel:
+    """Per-rank checkpoint write / restore read times.
+
+    The checkpointed state per rank is its weights + optimizer state
+    (gradients are not checkpointed). The write streams HBM → host
+    (``host.d2h_gbps``) → persistent storage / DCN
+    (``host.ckpt_write_gbps``); pipelined streaming is bound by the
+    slowest stage of the chain (HBM read bandwidth included for
+    completeness — it never binds on real parts), plus a fixed
+    commit/barrier latency. Restore is the reverse chain with the read
+    bandwidths."""
+
+    bytes_per_rank: float
+    write_s: float
+    read_s: float
+    spec: CheckpointSpec
+
+    @classmethod
+    def from_perf(cls, perf,
+                  spec: Optional[CheckpointSpec] = None
+                  ) -> "CheckpointCostModel":
+        spec = spec or CheckpointSpec()
+        mem = perf.analysis_mem()
+        nbytes = max(
+            s["weight_bytes"] + s["optimizer_state_bytes"]
+            for s in mem["stages"]
+        )
+        host = perf.system.host
+        hbm = perf.system.accelerator.bandwidth["default"].gbps
+        write_bw = spec.write_gbps or min(
+            hbm, host.d2h_gbps, host.ckpt_write_gbps
+        )
+        read_bw = spec.read_gbps or min(
+            hbm, host.d2h_gbps, host.ckpt_read_gbps
+        )
+        return cls(
+            bytes_per_rank=nbytes,
+            write_s=nbytes / (write_bw * 1e9) + host.latency_s,
+            read_s=nbytes / (read_bw * 1e9) + host.latency_s,
+            spec=spec,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "bytes_per_rank": self.bytes_per_rank,
+            "write_s": self.write_s,
+            "read_s": self.read_s,
+            "interval_steps": self.spec.interval_steps,
+            "restart_overhead_s": self.spec.restart_overhead_s,
+        }
+
+
+# --------------------------------------------------------------------------
+# Goodput prediction
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class GoodputReport:
+    """Wall-time decomposition of a scenario over ``horizon_steps``
+    training steps. ``buckets`` sum to ``wall_time_s`` exactly (the
+    accounting is constructive); ``goodput = useful_train / wall``."""
+
+    goodput: float
+    wall_time_s: float
+    useful_time_s: float
+    healthy_step_s: float
+    horizon_steps: int
+    n_checkpoints: int
+    n_restarts: int
+    steps_replayed: int
+    buckets: GoodputBuckets
+    deaths: List[Dict[str, float]]
+    checkpoint: Dict[str, Any]
+    truncated: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schema": "simumax-goodput-v1",
+            "goodput": self.goodput,
+            "wall_time_s": self.wall_time_s,
+            "useful_time_s": self.useful_time_s,
+            "healthy_step_s": self.healthy_step_s,
+            "horizon_steps": self.horizon_steps,
+            "n_checkpoints": self.n_checkpoints,
+            "n_restarts": self.n_restarts,
+            "steps_replayed": self.steps_replayed,
+            "buckets": self.buckets.to_dict(),
+            "deaths": list(self.deaths),
+            "checkpoint": dict(self.checkpoint),
+            "truncated": self.truncated,
+        }
+
+
+def _simulate_step(perf, sub: FaultScenario,
+                   cache: Dict[tuple, Tuple[float, Optional[float]]],
+                   granularity: str, reduce) -> Tuple[float, Optional[float]]:
+    """(wall duration, death time | None) of one step under the
+    re-based sub-scenario ``sub``; death times arrive in the same
+    straggler-inflated wall base as ``end_time``."""
+    from simumax_tpu.simulator.runner import run_simulation
+
+    key = sub.signature()
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    res = run_simulation(
+        perf, None, granularity=granularity, world_ranks=True,
+        reduce=reduce, faults=sub,
+    )
+    deaths = res["faults"]["deaths"]
+    if deaths:
+        t_death = min(d["time_ms"] for d in deaths) * 1e-3
+        out = (t_death, t_death)
+    else:
+        out = (res["end_time"], None)
+    cache[key] = out
+    return out
+
+
+def predict_goodput(
+    perf,
+    scenario: FaultScenario,
+    spec: Optional[CheckpointSpec] = None,
+    granularity: str = "chunk",
+    reduce="auto",
+    max_restarts: int = 1000,
+    _cache: Optional[Dict[tuple, Tuple[float, Optional[float]]]] = None,
+) -> GoodputReport:
+    """Predict goodput of ``scenario`` over its ``horizon_steps``.
+
+    Walks job wall-clock step by step: each step's duration comes from
+    a discrete-event simulation with the scenario's events re-based
+    onto the step window (steps no event touches reuse the fault-free
+    step, so only perturbed steps pay for a simulation); every
+    ``interval_steps`` committed steps a checkpoint write is charged; a
+    rank death aborts the step, rolls uncommitted progress back to the
+    last checkpoint (its wall time becomes ``restart_replay``), and
+    charges restart overhead + restore read before training resumes.
+    """
+    scenario.validate(perf.strategy.world_size)
+    from simumax_tpu.simulator.runner import run_simulation
+
+    # an explicitly passed spec wins outright (a CLI flag must beat
+    # the scenario's bundled default, not the other way round); the
+    # scenario's "checkpoint" block only fills in when none is given
+    if spec is None:
+        spec = CheckpointSpec.from_overrides(scenario.checkpoint)
+    ckpt = CheckpointCostModel.from_perf(perf, spec)
+    healthy = run_simulation(
+        perf, None, granularity=granularity, world_ranks=True,
+        reduce=reduce,
+    )
+    h = healthy["end_time"]
+    horizon = scenario.horizon_steps
+    interval = spec.interval_steps
+    cache = _cache if _cache is not None else {}
+    b = GoodputBuckets()
+    wall = 0.0
+    committed = 0
+    ckpt_committed = 0
+    n_ckpt = n_restart = replayed = 0
+    #: (healthy_part, stall_part) of steps committed since the last
+    #: checkpoint — rolled into restart_replay on a death
+    uncommitted: List[Tuple[float, float]] = []
+    deaths: List[Dict[str, float]] = []
+    truncated = False
+
+    def first_death_in(t0_s: float, t1_s: float) -> Optional[float]:
+        """Earliest rank-death absolute time inside [t0, t1)."""
+        times = [
+            ev.start_ms * 1e-3 for ev in scenario.events
+            if ev.kind == "rank_death"
+            and t0_s <= ev.start_ms * 1e-3 < t1_s
+        ]
+        return min(times) if times else None
+
+    def restart(abort_wall_s: float, extra_lost_s: float):
+        """Roll uncommitted progress back to the last checkpoint and
+        charge the recovery sequence. ``extra_lost_s`` is wall time of
+        the aborted partial step / checkpoint write."""
+        nonlocal wall, committed, n_restart, replayed, uncommitted
+        deaths.append({
+            "wall_time_s": abort_wall_s,
+            "lost_steps": committed - ckpt_committed,
+        })
+        for (hp, sp) in uncommitted:
+            b.useful_train -= hp
+            b.fault_stall -= sp
+            b.restart_replay += hp + sp
+        replayed += len(uncommitted)
+        b.restart_replay += extra_lost_s
+        committed = ckpt_committed
+        uncommitted = []
+        wall = abort_wall_s + spec.restart_overhead_s + ckpt.read_s
+        b.restart_overhead += spec.restart_overhead_s
+        b.restore_read += ckpt.read_s
+        n_restart += 1
+
+    while committed < horizon:
+        # fixpoint window growth: a step stretched by faults may pull
+        # later events into its window
+        span = h
+        dur, death = h, None
+        for _ in range(8):
+            sub = scenario.shifted(wall * 1e3, span * 1e3)
+            if sub.empty:
+                dur, death = h, None
+                break
+            dur, death = _simulate_step(
+                perf, sub, cache, granularity, reduce
+            )
+            if death is not None or dur <= span * (1 + 1e-12):
+                break
+            span = dur
+        if death is None:
+            wall += dur
+            b.useful_train += h
+            b.fault_stall += dur - h
+            uncommitted.append((h, dur - h))
+            committed += 1
+            if committed % interval == 0 and committed < horizon:
+                # a rank death during the checkpoint write still kills
+                # the job — and the interrupted write never commits
+                t_d = first_death_in(wall, wall + ckpt.write_s)
+                if t_d is not None:
+                    restart(t_d, t_d - wall)
+                    if n_restart >= max_restarts:
+                        truncated = True
+                        break
+                    continue
+                wall += ckpt.write_s
+                b.checkpoint_write += ckpt.write_s
+                n_ckpt += 1
+                ckpt_committed = committed
+                uncommitted = []
+        else:
+            # committed-but-uncheckpointed steps are lost: their wall
+            # time (healthy + stall) turns into replay, plus the
+            # aborted partial step
+            restart(wall + death, death)
+            if n_restart >= max_restarts:
+                truncated = True
+                break
+    useful = b.useful_train
+    return GoodputReport(
+        goodput=(useful / wall) if wall > 0 else 1.0,
+        wall_time_s=wall,
+        useful_time_s=useful,
+        healthy_step_s=h,
+        horizon_steps=horizon,
+        n_checkpoints=n_ckpt,
+        n_restarts=n_restart,
+        steps_replayed=replayed,
+        buckets=b,
+        deaths=deaths,
+        checkpoint=ckpt.to_dict(),
+        truncated=truncated,
+    )
+
+
+# --------------------------------------------------------------------------
+# Monte-Carlo sampling
+# --------------------------------------------------------------------------
+
+
+def sample_scenario(
+    rng: random.Random,
+    world_size: int,
+    horizon_ms: float,
+    *,
+    horizon_steps: int = 100,
+    max_events: int = 6,
+    death_prob: float = 0.3,
+    seed: Optional[int] = None,
+) -> FaultScenario:
+    """One random-but-seeded fault scenario: a mix of slowdown windows,
+    preemptions, scoped/unscoped link degradations, and (with
+    ``death_prob``) rank deaths, all inside ``[0, horizon_ms)``."""
+    events: List[FaultEvent] = []
+    n = rng.randint(0, max_events)
+    for _ in range(n):
+        kind = rng.choice(("slowdown", "preemption", "link_degradation"))
+        start = rng.uniform(0.0, horizon_ms * 0.9)
+        dur = rng.uniform(horizon_ms * 0.005, horizon_ms * 0.25)
+        if kind == "slowdown":
+            events.append(FaultEvent(
+                "slowdown", start_ms=start, duration_ms=dur,
+                rank=rng.randrange(world_size),
+                multiplier=rng.uniform(1.05, 5.0),
+            ))
+        elif kind == "preemption":
+            events.append(FaultEvent(
+                "preemption", start_ms=start,
+                duration_ms=rng.uniform(horizon_ms * 0.002,
+                                        horizon_ms * 0.05),
+                rank=rng.randrange(world_size),
+            ))
+        else:
+            scope = None
+            if rng.random() < 0.5:
+                k = rng.randint(1, max(1, min(4, world_size)))
+                scope = sorted(rng.sample(range(world_size), k))
+            events.append(FaultEvent(
+                "link_degradation", start_ms=start, duration_ms=dur,
+                dim=rng.choice(("tp", "pp", "dp_cp", "*")),
+                multiplier=rng.uniform(1.1, 8.0), ranks=scope,
+            ))
+    if rng.random() < death_prob:
+        events.append(FaultEvent(
+            "rank_death", start_ms=rng.uniform(0.0, horizon_ms * 0.9),
+            rank=rng.randrange(world_size),
+        ))
+    return FaultScenario(events=events, horizon_steps=horizon_steps,
+                         seed=seed)
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def analyze_faults(
+    perf,
+    n_scenarios: int = 32,
+    seed: int = 0,
+    horizon_steps: int = 50,
+    spec: Optional[CheckpointSpec] = None,
+    intervals: Optional[Sequence[int]] = None,
+    granularity: str = "chunk",
+    reduce="auto",
+    max_events: int = 6,
+    death_prob: float = 0.3,
+) -> Dict[str, Any]:
+    """Seeded Monte-Carlo goodput analysis: sample ``n_scenarios``
+    random scenarios, predict each one's goodput, and sweep checkpoint
+    intervals to find the empirically optimal one (reported next to
+    the Young–Daly closed form ``sqrt(2 * write_time * MTBF)``).
+    Deterministic for a given seed."""
+    from simumax_tpu.simulator.runner import run_simulation
+
+    spec = spec or CheckpointSpec()
+    st = perf.strategy
+    healthy = run_simulation(
+        perf, None, granularity=granularity, world_ranks=True,
+        reduce=reduce,
+    )
+    h = healthy["end_time"]
+    # sample against the rough job wall (healthy horizon + slack so
+    # late-run faults land inside the actual, stretched wall-clock)
+    horizon_ms = horizon_steps * h * 1e3 * 1.25
+    rng = random.Random(seed)
+    scenarios = [
+        sample_scenario(
+            rng, st.world_size, horizon_ms, horizon_steps=horizon_steps,
+            max_events=max_events, death_prob=death_prob, seed=seed,
+        )
+        for _ in range(n_scenarios)
+    ]
+    cache: Dict[tuple, Tuple[float, Optional[float]]] = {}
+    reports = [
+        predict_goodput(perf, s, spec=spec, granularity=granularity,
+                        reduce=reduce, _cache=cache)
+        for s in scenarios
+    ]
+    goodputs = sorted(r.goodput for r in reports)
+    n_interrupts = sum(r.n_restarts for r in reports)
+    total_wall = sum(r.wall_time_s for r in reports)
+    mtbf = (total_wall / n_interrupts) if n_interrupts else math.inf
+    ckpt = CheckpointCostModel.from_perf(perf, spec)
+    if math.isfinite(mtbf):
+        yd_interval = max(
+            1, int(round(math.sqrt(2.0 * ckpt.write_s * mtbf) / h))
+        )
+    else:
+        yd_interval = horizon_steps
+    if intervals is None:
+        grid = sorted({
+            max(1, horizon_steps // 16), max(1, horizon_steps // 8),
+            max(1, horizon_steps // 4), max(1, horizon_steps // 2),
+            horizon_steps, min(yd_interval, horizon_steps),
+        })
+        intervals = grid
+    by_interval: Dict[int, float] = {}
+    for k in intervals:
+        k_spec = CheckpointSpec(
+            interval_steps=int(k),
+            restart_overhead_s=spec.restart_overhead_s,
+            write_gbps=spec.write_gbps, read_gbps=spec.read_gbps,
+        )
+        vals = [
+            predict_goodput(perf, s, spec=k_spec, granularity=granularity,
+                            reduce=reduce, _cache=cache).goodput
+            for s in scenarios
+        ]
+        by_interval[int(k)] = sum(vals) / len(vals) if vals else 1.0
+    best_interval = max(by_interval, key=lambda k: (by_interval[k], -k))
+    return {
+        "schema": "simumax-fault-analysis-v1",
+        "seed": seed,
+        "n_scenarios": n_scenarios,
+        "horizon_steps": horizon_steps,
+        "healthy_step_s": h,
+        "goodput": {
+            "mean": sum(goodputs) / len(goodputs) if goodputs else 1.0,
+            "min": goodputs[0] if goodputs else 1.0,
+            "max": goodputs[-1] if goodputs else 1.0,
+            "p10": _quantile(goodputs, 0.10),
+            "p50": _quantile(goodputs, 0.50),
+            "p90": _quantile(goodputs, 0.90),
+        },
+        "restarts_total": n_interrupts,
+        "mtbf_s": mtbf,
+        "checkpoint": ckpt.to_dict(),
+        "goodput_by_interval": by_interval,
+        "best_interval_steps": best_interval,
+        "young_daly_interval_steps": yd_interval,
+        "reports": [r.to_dict() for r in reports],
+    }
+
+
+__all__ = [
+    "EVENT_KINDS",
+    "LINK_DIMS",
+    "FaultEvent",
+    "FaultScenario",
+    "StepFaultModel",
+    "FaultOutcome",
+    "CheckpointSpec",
+    "CheckpointCostModel",
+    "GoodputReport",
+    "predict_goodput",
+    "sample_scenario",
+    "analyze_faults",
+]

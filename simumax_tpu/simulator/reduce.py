@@ -89,11 +89,19 @@ def _membership(groups: List[List[int]]) -> Dict[int, List[int]]:
     return by_rank
 
 
-def build_reduction(st, perturbation: Optional[dict] = None) -> ReductionPlan:
+def build_reduction(st, perturbation: Optional[dict] = None,
+                    signatures: Optional[dict] = None) -> ReductionPlan:
     """Partition the world into symmetry classes and map the simulated
     structures onto class representatives. Deterministic: classes are
-    numbered by their smallest member."""
+    numbered by their smallest member.
+
+    ``signatures`` maps rank -> extra hashable identity folded into the
+    initial colors: a fault scenario's per-rank event signature
+    (``faults.py::FaultScenario.rank_signatures``) shatters exactly the
+    classes its rank-scoped events touch, the same way a straggler
+    ``perturbation`` does."""
     perturbation = perturbation or {}
+    signatures = signatures or {}
     n = st.world_size
     pp = st.pp_size
     stride = st.tp_size * st.cp_size * st.dp_size  # == StageProcess._pp_stride
@@ -127,7 +135,10 @@ def build_reduction(st, perturbation: Optional[dict] = None) -> ReductionPlan:
     dims = sorted(memberships)
 
     # color refinement to fixpoint
-    color = [(stages[r], float(perturbation.get(r, 1.0))) for r in range(n)]
+    color = [
+        (stages[r], float(perturbation.get(r, 1.0)), signatures.get(r))
+        for r in range(n)
+    ]
     canon: Dict[tuple, int] = {}
     colors_out: List[int] = [0] * n
     n_colors = 0

@@ -81,6 +81,7 @@ def run_simulation(
     perturbation: Optional[dict] = None,
     reduce="auto",
     stream_trace: bool = False,
+    faults=None,
 ) -> dict:
     """Discrete-event replay of one training iteration. ``perf`` must
     have completed ``run_estimate()``.
@@ -108,12 +109,45 @@ def run_simulation(
 
     ``stream_trace=True`` with ``save_path`` writes ``trace.json``
     incrementally while the engine runs (bounded peak RSS); without
-    ``save_path`` it is ignored with a Diagnostics warning."""
-    assert perf.chunks, "call run_estimate() before simulate()"
+    ``save_path`` it is ignored with a Diagnostics warning.
+
+    ``faults`` injects a :class:`~simumax_tpu.simulator.faults.
+    FaultScenario` (or a path to its JSON): timed rank slowdowns,
+    preemptions, link degradation, and rank deaths, consulted by the
+    engine at event-service time (``docs/faults.md``). Requires
+    ``world_ranks=True`` when non-empty; an empty scenario is
+    bit-identical to no scenario at all. The result then carries a
+    structured ``"faults"`` outcome block — a rank death degrades
+    gracefully (partners resolve via the fault model) instead of
+    deadlocking."""
+    from simumax_tpu.core.errors import ConfigError
+
+    if not perf.chunks:
+        raise ConfigError(
+            "simulate() needs a completed estimate: call run_estimate() "
+            "first", phase="simulate",
+        )
     st = perf.strategy
     pp = st.pp_size
     perturbation = perturbation or {}
     diag = _diag(perf)
+    if isinstance(faults, str):
+        from simumax_tpu.simulator.faults import FaultScenario
+
+        faults = FaultScenario.from_json(faults)
+    if faults is not None:
+        faults.validate(st.world_size)
+        if faults.empty:
+            # the empty scenario must be bit-identical to a run with no
+            # scenario at all: drop it before it can touch anything
+            faults = None
+        elif not world_ranks:
+            raise ConfigError(
+                "fault scenarios need world_ranks=True: rank-scoped "
+                "faults are meaningless when one simulated rank stands "
+                "for a whole pipeline stage",
+                phase="simulate", world_size=st.world_size,
+            )
     if world_ranks and track_memory:
         # memory tracking is per-representative-stage; world mode is for
         # timing/straggler analysis (satellite of ISSUE 4: surface the
@@ -140,19 +174,38 @@ def run_simulation(
 
     plan = None
     trackers = []
+    fault_model = None
     if world_ranks:
         n = st.world_size
         bad = [r for r in perturbation if not 0 <= r < n]
-        assert not bad, f"perturbation for nonexistent ranks {bad} (world {n})"
+        if bad:
+            # a typed error, not an assert: rank validation must
+            # survive `python -O`, and the CLI turns ConfigError into
+            # an actionable one-liner
+            raise ConfigError(
+                f"perturbation for nonexistent ranks {bad} "
+                f"(world {n})",
+                phase="simulate", world_size=n, bad_ranks=bad,
+            )
         if reduce:
             from simumax_tpu.simulator.reduce import build_reduction
 
-            plan = build_reduction(st, perturbation)
+            plan = build_reduction(
+                st, perturbation,
+                signatures=faults.rank_signatures() if faults else None,
+            )
             if reduce == "auto" and plan.n_classes >= n:
                 plan = None  # no symmetry to exploit: exact path
+        if faults is not None:
+            from simumax_tpu.simulator.faults import StepFaultModel
+
+            fault_model = StepFaultModel(
+                faults, rank_map=plan.reps if plan is not None else None
+            )
         if plan is not None:
             k = plan.n_classes
-            engine = SimuEngine(k, event_sink=sink)
+            engine = SimuEngine(k, event_sink=sink,
+                                fault_model=fault_model)
             barrier = list(range(k))
             for i in range(k):
                 groups = {
@@ -176,7 +229,8 @@ def run_simulation(
             from simumax_tpu.parallel.mesh import rank_coords
 
             memberships = _world_memberships(st)
-            engine = SimuEngine(n, event_sink=sink)
+            engine = SimuEngine(n, event_sink=sink,
+                                fault_model=fault_model)
             for r in range(n):
                 stage = rank_coords(r, st)["pp"]
                 proc = StageProcess(
@@ -246,6 +300,27 @@ def run_simulation(
         "num_events": num_events,
         "num_comm_events": num_comm,
     }
+    if fault_model is not None:
+        from simumax_tpu.simulator.faults import FaultOutcome
+
+        deaths = []
+        for (r, t) in engine.deaths:
+            # a dead class rep stands for every member (a death that
+            # leaves ranks symmetric — e.g. whole-world kill — keeps
+            # them in one class); sort so reduced == exact regardless
+            # of engine kill order. Times carry the same straggler
+            # inflation as end_time so the result dict has one wall
+            # time base.
+            members = plan.classes[r] if plan is not None else [r]
+            deaths.extend(
+                {"rank": g, "time_ms": t * ratio * 1e3} for g in members
+            )
+        deaths.sort(key=lambda d: (d["time_ms"], d["rank"]))
+        result["faults"] = FaultOutcome(
+            applied_events=len(faults.events),
+            completed=not deaths,
+            deaths=deaths,
+        ).to_dict()
     if plan is not None:
         result["reduction"] = {
             "world_size": plan.world_size,

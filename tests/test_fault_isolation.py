@@ -579,3 +579,42 @@ class TestCLIErrorSurface:
                   "--system", "tpu_v5e_256", "--strict"])
         assert ei.value.code == EXIT_STRICT
         assert "strict mode" in capsys.readouterr().err
+
+    def test_simulation_error_exits_3_with_one_liner(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """A SimulationError escaping `perf --simulate` gets the same
+        one-line treatment as the ConfigError family (exit 3), not a
+        traceback — a DeadlockError's multi-line state dump belongs in
+        the diagnostics report, not on stderr."""
+        import simumax_tpu.simulator.runner as runner_mod
+        from simumax_tpu.cli import EXIT_SIMULATION, main
+
+        def wedged(*a, **k):
+            raise SimulationError(
+                "engine invariant violated\n  rank 0 blocked on recv",
+                phase="simulate",
+            )
+
+        monkeypatch.setattr(runner_mod, "run_simulation", wedged)
+        report = tmp_path / "diag.json"
+        with pytest.raises(SystemExit) as ei:
+            main(["perf", "--model", "llama2-tiny",
+                  "--strategy", "tp1_pp2_dp4_mbs1",
+                  "--system", "tpu_v5e_256",
+                  "--simulate", str(tmp_path / "sim"),
+                  "--diagnostics", str(report)])
+        assert ei.value.code == EXIT_SIMULATION == 3
+        err = capsys.readouterr().err
+        assert "simulation failed" in err
+        assert "engine invariant violated" in err
+        # one-liner: the dump's continuation lines stay off stderr,
+        # and no traceback leaks
+        assert "rank 0 blocked on recv" not in err
+        assert "Traceback" not in err
+        # ... but the diagnostics report captured the full failure
+        d = json.loads(report.read_text())
+        assert any(
+            e["context"].get("exception") == "SimulationError"
+            for e in d["errors"]
+        )

@@ -1,0 +1,516 @@
+"""Fault-injection, checkpoint/restore, and goodput-prediction tests
+(the PR-5 robustness tentpole), including the chaos harness: hundreds
+of seeded random scenarios across dense/MoE/MLA x pp{1,2,4} asserting
+the subsystem's invariants — no deadlock or uncaught exception, goodput
+<= 1, the empty scenario bit-identical to a fault-free run, and
+reduce="auto" exactly equal to the exact full-world path."""
+
+import copy
+import random
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import (
+    ConfigError,
+    get_model_config,
+    get_strategy_config,
+)
+from simumax_tpu.simulator.faults import (
+    CheckpointCostModel,
+    CheckpointSpec,
+    FaultEvent,
+    FaultScenario,
+    predict_goodput,
+    sample_scenario,
+)
+
+SIM = dict(world_ranks=True, granularity="chunk", track_memory=False)
+
+
+def build_perf(model="llama2-tiny", tp=1, pp=2, ep=1, world=8, mbc=4,
+               layers=None, dense_layers=None, system="tpu_v5e_256"):
+    m = get_model_config(model)
+    if layers is not None or dense_layers is not None:
+        m = copy.deepcopy(m)
+        if layers is not None:
+            m.layer_num = layers
+        if dense_layers is not None:
+            m.dense_layers = dense_layers
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = world
+    st.tp_size = tp
+    st.pp_size = pp
+    st.ep_size = ep
+    st.micro_batch_num = mbc
+    st.__post_init__()
+    p = PerfLLM().configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+@pytest.fixture(scope="module")
+def perf():
+    return build_perf()
+
+
+@pytest.fixture(scope="module")
+def healthy(perf):
+    return perf.simulate(None, **SIM)
+
+
+class TestScenarioSchema:
+    def test_json_round_trip(self, tmp_path):
+        sc = FaultScenario(
+            events=[
+                FaultEvent("slowdown", 10.0, duration_ms=5.0, rank=1,
+                           multiplier=2.5),
+                FaultEvent("preemption", 3.0, duration_ms=7.0, rank=0),
+                FaultEvent("link_degradation", 0.0, duration_ms=50.0,
+                           dim="pp", multiplier=4.0, ranks=[0, 3]),
+                FaultEvent("rank_death", 20.0, rank=2),
+            ],
+            horizon_steps=12,
+            checkpoint={"interval_steps": 4},
+        )
+        sc.validate(8)
+        path = tmp_path / "scenario.json"
+        sc.save(str(path))
+        back = FaultScenario.from_json(str(path))
+        assert back.to_dict() == sc.to_dict()
+        assert back.signature() == sc.signature()
+
+    @pytest.mark.parametrize(
+        "event,match",
+        [
+            (FaultEvent("meteor_strike", rank=0), "unknown kind"),
+            (FaultEvent("slowdown", rank=99, duration_ms=1.0,
+                        multiplier=2.0), "outside world"),
+            (FaultEvent("slowdown", rank=0, duration_ms=1.0,
+                        multiplier=0.5), "multiplier"),
+            (FaultEvent("preemption", rank=0), "duration_ms"),
+            (FaultEvent("slowdown", duration_ms=1.0), "target rank"),
+            (FaultEvent("link_degradation", duration_ms=1.0,
+                        dim="warp-drive"), "dim"),
+            (FaultEvent("link_degradation", duration_ms=1.0, dim="pp",
+                        ranks=[5, 42]), "scope ranks"),
+            (FaultEvent("slowdown", start_ms=-1.0, rank=0,
+                        duration_ms=1.0), "start_ms"),
+        ],
+    )
+    def test_validation_rejects(self, event, match):
+        with pytest.raises(ConfigError, match=match):
+            FaultScenario([event]).validate(8)
+
+    def test_unknown_event_field_rejected(self):
+        with pytest.raises(ConfigError, match="unknown fields"):
+            FaultScenario.from_dict(
+                {"events": [{"kind": "rank_death", "rank": 0,
+                             "severity": "high"}]}
+            )
+
+    def test_bad_json_raises_config_error(self, tmp_path):
+        path = tmp_path / "broken.json"
+        path.write_text("{not json")
+        with pytest.raises(ConfigError, match="cannot load"):
+            FaultScenario.from_json(str(path))
+
+    def test_shifted_windows_and_rebases(self):
+        sc = FaultScenario([
+            FaultEvent("slowdown", 100.0, duration_ms=50.0, rank=0,
+                       multiplier=2.0),
+            FaultEvent("rank_death", 210.0, rank=1),
+        ])
+        # window before both events
+        assert sc.shifted(0.0, 50.0).empty
+        # window overlapping the slowdown tail: re-based, clamped
+        sub = sc.shifted(120.0, 50.0)
+        assert len(sub.events) == 1
+        ev = sub.events[0]
+        assert ev.start_ms == 0.0 and ev.duration_ms == pytest.approx(30.0)
+        # deaths are point events: included only in their window
+        assert [e.kind for e in sc.shifted(200.0, 50.0).events] == [
+            "rank_death"
+        ]
+        assert sc.shifted(200.0, 50.0).events[0].start_ms == (
+            pytest.approx(10.0)
+        )
+
+    def test_rank_signatures_shatter_only_touched_ranks(self):
+        sc = FaultScenario([
+            FaultEvent("slowdown", 0.0, duration_ms=1.0, rank=3,
+                       multiplier=2.0),
+            FaultEvent("link_degradation", 0.0, duration_ms=1.0,
+                       dim="pp", multiplier=2.0),  # unscoped: global
+        ])
+        sigs = sc.rank_signatures()
+        assert set(sigs) == {3}
+
+
+class TestEmptyScenarioIdentity:
+    def test_world_rank_results_bit_identical(self, perf, healthy):
+        empty = perf.simulate(None, faults=FaultScenario([]), **SIM)
+        assert empty == healthy
+
+    def test_merged_mode_trace_and_memory_bit_identical(self, tmp_path):
+        p = build_perf(mbc=2)
+        a = p.simulate(str(tmp_path / "a"))
+        b = p.simulate(str(tmp_path / "b"), faults=FaultScenario([]))
+        assert (tmp_path / "a" / "trace.json").read_bytes() == (
+            (tmp_path / "b" / "trace.json").read_bytes()
+        )
+        assert a["memory"] == b["memory"]
+        for k in ("end_time", "per_rank_end_ms", "num_events",
+                  "num_comm_events"):
+            assert a[k] == b[k], k
+
+
+class TestFaultSemantics:
+    def test_slowdown_inflates_and_past_window_does_not(self, perf,
+                                                        healthy):
+        sc = FaultScenario([FaultEvent(
+            "slowdown", 0.0, duration_ms=1e6, rank=0, multiplier=3.0,
+        )])
+        slow = perf.simulate(None, faults=sc, **SIM)
+        assert slow["end_time"] > healthy["end_time"]
+        # a window entirely after the step end perturbs nothing
+        late = FaultScenario([FaultEvent(
+            "slowdown", healthy["end_time_ms"] * 10, duration_ms=1.0,
+            rank=0, multiplier=3.0,
+        )])
+        same = perf.simulate(None, faults=late, **SIM)
+        assert same["end_time"] == healthy["end_time"]
+        assert same["per_rank_end_ms"] == healthy["per_rank_end_ms"]
+
+    def test_preemption_freezes_rank(self, perf, healthy):
+        freeze_ms = healthy["end_time_ms"] * 2
+        sc = FaultScenario([FaultEvent(
+            "preemption", 0.0, duration_ms=freeze_ms, rank=0,
+        )])
+        res = perf.simulate(None, faults=sc, **SIM)
+        # rank 0 makes no progress during the freeze, so the step ends
+        # after the window at the earliest
+        assert res["end_time_ms"] >= freeze_ms
+        assert res["faults"]["completed"]
+
+    def test_link_degradation_inflates_scoped_dim(self, perf, healthy):
+        sc = FaultScenario([FaultEvent(
+            "link_degradation", 0.0, duration_ms=1e6, dim="pp",
+            multiplier=20.0,
+        )])
+        res = perf.simulate(None, faults=sc, **SIM)
+        assert res["end_time"] > healthy["end_time"]
+        # scoping to a rank subset perturbs no more than the unscoped
+        scoped = FaultScenario([FaultEvent(
+            "link_degradation", 0.0, duration_ms=1e6, dim="pp",
+            multiplier=20.0, ranks=[0],
+        )])
+        res_scoped = perf.simulate(None, faults=scoped, **SIM)
+        assert healthy["end_time"] < res_scoped["end_time"] <= (
+            res["end_time"]
+        )
+
+    def test_rank_death_degrades_gracefully(self, perf, healthy):
+        sc = FaultScenario([FaultEvent("rank_death", 1.0, rank=2)])
+        res = perf.simulate(None, faults=sc, **SIM)
+        out = res["faults"]
+        assert not out["completed"]
+        assert [d["rank"] for d in out["deaths"]] == [2]
+        assert out["deaths"][0]["time_ms"] >= 1.0
+        # the world drained: the run returned instead of deadlocking
+        assert res["end_time"] > 0
+
+    def test_death_at_t0_kills_everything_it_touches(self, perf):
+        # every rank dies: the run must still return, not hang
+        sc = FaultScenario([
+            FaultEvent("rank_death", 0.0, rank=r) for r in range(8)
+        ])
+        res = perf.simulate(None, faults=sc, **SIM)
+        assert not res["faults"]["completed"]
+        assert len(res["faults"]["deaths"]) == 8
+
+    def test_faults_require_world_ranks(self, perf):
+        sc = FaultScenario([FaultEvent("rank_death", 0.0, rank=0)])
+        with pytest.raises(ConfigError, match="world_ranks"):
+            perf.simulate(None, faults=sc, granularity="chunk",
+                          track_memory=False)
+
+    def test_scenario_rank_validated_against_world(self, perf):
+        sc = FaultScenario([FaultEvent("rank_death", 0.0, rank=64)])
+        with pytest.raises(ConfigError, match="outside world"):
+            perf.simulate(None, faults=sc, **SIM)
+
+
+class TestEngineDeathResolution:
+    def test_earliest_death_resolves_later_doomed_rank(self):
+        """Killing the earliest death at heap drain can unblock a
+        later-doomed rank, which must then live to finish — not be
+        spuriously killed at its own far-future death time."""
+        from simumax_tpu.simulator.engine import SimuEngine
+        from simumax_tpu.simulator.faults import StepFaultModel
+
+        sc = FaultScenario([
+            FaultEvent("rank_death", 5000.0, rank=0),
+            FaultEvent("rank_death", 1_000_000_000.0, rank=1),
+        ])
+        eng = SimuEngine(2, fault_model=StepFaultModel(sc))
+
+        def proc(me, peer):
+            yield ("recv", peer, "x", f"r{me}")
+
+        eng.add_rank(0, proc(0, 1))
+        eng.add_rank(1, proc(1, 0))
+        end = eng.run()
+        # rank 0 died at 5 s; rank 1's recv aborted against the death
+        # and it finished ALIVE, long before its own death time
+        assert [r for (r, _) in eng.deaths] == [0]
+        assert end == pytest.approx(5.0)
+
+    def test_mutual_recv_without_deaths_still_deadlocks(self):
+        """The drain-kill path must not soften genuine deadlocks when
+        a fault model is attached but no death can resolve them."""
+        from simumax_tpu.simulator.engine import DeadlockError, SimuEngine
+        from simumax_tpu.simulator.faults import StepFaultModel
+
+        sc = FaultScenario([FaultEvent(
+            "slowdown", 0.0, duration_ms=1.0, rank=0, multiplier=2.0,
+        )])
+        eng = SimuEngine(2, fault_model=StepFaultModel(sc))
+
+        def proc(me, peer):
+            yield ("recv", peer, "x", f"r{me}")
+
+        eng.add_rank(0, proc(0, 1))
+        eng.add_rank(1, proc(1, 0))
+        with pytest.raises(DeadlockError):
+            eng.run()
+
+
+class TestCheckpointCostModel:
+    def test_costs_positive_and_scale_with_bytes(self, perf):
+        ckpt = CheckpointCostModel.from_perf(perf)
+        assert ckpt.bytes_per_rank > 0
+        assert ckpt.write_s > perf.system.host.latency_s
+        assert ckpt.read_s > perf.system.host.latency_s
+        # faster storage -> cheaper checkpoint
+        fast = CheckpointCostModel.from_perf(
+            perf, CheckpointSpec(write_gbps=1000.0, read_gbps=1000.0)
+        )
+        assert fast.write_s < ckpt.write_s
+        assert fast.read_s < ckpt.read_s
+
+    def test_spec_overrides_and_validation(self):
+        spec = CheckpointSpec.from_overrides(
+            {"interval_steps": 7, "restart_overhead_s": 9.0}
+        )
+        assert spec.interval_steps == 7
+        assert spec.restart_overhead_s == 9.0
+        with pytest.raises(ConfigError, match="unknown checkpoint"):
+            CheckpointSpec.from_overrides({"cadence": 3})
+        with pytest.raises(ConfigError, match="interval_steps"):
+            CheckpointSpec.from_overrides({"interval_steps": 0})
+
+
+class TestGoodput:
+    def test_fault_free_goodput_is_checkpoint_overhead_only(self, perf):
+        spec = CheckpointSpec(interval_steps=2, restart_overhead_s=5.0)
+        rep = predict_goodput(
+            perf, FaultScenario([], horizon_steps=6), spec=spec,
+        )
+        h = rep.healthy_step_s
+        ckpt = CheckpointCostModel.from_perf(perf, spec)
+        # 6 steps, a checkpoint after steps 2 and 4 (none at the end)
+        expect_wall = 6 * h + 2 * ckpt.write_s
+        assert rep.wall_time_s == pytest.approx(expect_wall, rel=1e-12)
+        assert rep.goodput == pytest.approx(6 * h / expect_wall,
+                                            rel=1e-12)
+        assert rep.n_checkpoints == 2 and rep.n_restarts == 0
+
+    def test_buckets_sum_to_wall_time(self, perf, healthy):
+        h_ms = healthy["end_time_ms"]
+        sc = FaultScenario(
+            [
+                FaultEvent("slowdown", h_ms * 0.5, duration_ms=h_ms,
+                           rank=1, multiplier=4.0),
+                FaultEvent("rank_death", h_ms * 3.2, rank=2),
+            ],
+            horizon_steps=8,
+        )
+        spec = CheckpointSpec(interval_steps=2, restart_overhead_s=3.0)
+        rep = predict_goodput(perf, sc, spec=spec)
+        assert rep.n_restarts == 1
+        assert rep.buckets.restart_replay > 0
+        assert rep.buckets.wall_time == pytest.approx(
+            rep.wall_time_s, rel=1e-9
+        )
+        total = sum(rep.buckets.to_dict().values())
+        assert total == pytest.approx(rep.wall_time_s, abs=1e-6)
+        assert 0 < rep.goodput <= 1 + 1e-9
+        # faults strictly lose goodput vs the fault-free run
+        clean = predict_goodput(
+            perf, FaultScenario([], horizon_steps=8), spec=spec,
+        )
+        assert rep.goodput < clean.goodput
+
+    def test_goodput_waterfall_rendering(self, perf, healthy):
+        from simumax_tpu.observe.ledger import (
+            GOODPUT_WATERFALL_ORDER,
+            build_goodput_waterfall,
+            goodput_attribution_line,
+            goodput_waterfall_lines,
+        )
+
+        sc = FaultScenario(
+            [FaultEvent("rank_death", healthy["end_time_ms"] * 1.5,
+                        rank=0)],
+            horizon_steps=4,
+        )
+        rep = predict_goodput(
+            perf, sc, spec=CheckpointSpec(interval_steps=2,
+                                          restart_overhead_s=2.0),
+        )
+        wf = build_goodput_waterfall(rep)
+        assert sum(wf["buckets"].values()) == pytest.approx(
+            wf["total"], abs=1e-6
+        )
+        assert tuple(wf["order"]) == GOODPUT_WATERFALL_ORDER
+        lines = goodput_waterfall_lines(rep)
+        assert "goodput" in lines[0] and "= wall time" in lines[-1]
+        line = goodput_attribution_line(rep)
+        assert "useful" in line and "replay" in line
+
+
+class TestCLISpecPrecedence:
+    def test_cli_flags_beat_scenario_checkpoint_block(self, tmp_path):
+        """An explicit --ckpt-interval must win over the scenario's
+        bundled checkpoint override (the flag is the user's direct
+        request; the scenario block is its default)."""
+        import json as _json
+
+        from simumax_tpu.cli import main
+
+        sc = FaultScenario([], horizon_steps=6,
+                           checkpoint={"interval_steps": 2,
+                                       "restart_overhead_s": 7.0})
+        spath = tmp_path / "sc.json"
+        sc.save(str(spath))
+        out = tmp_path / "report.json"
+        main(["faults", "--model", "llama2-tiny",
+              "--strategy", "tp1_pp2_dp4_mbs1",
+              "--system", "tpu_v5e_256",
+              "--scenario", str(spath), "--ckpt-interval", "3",
+              "--json", str(out)])
+        rep = _json.loads(out.read_text())
+        assert rep["checkpoint"]["interval_steps"] == 3
+        # the un-flagged field still comes from the scenario block
+        assert rep["checkpoint"]["restart_overhead_s"] == 7.0
+
+
+class TestMonteCarlo:
+    def test_deterministic_and_structured(self, perf):
+        kw = dict(n_scenarios=4, seed=11, horizon_steps=6,
+                  spec=CheckpointSpec(interval_steps=2,
+                                      restart_overhead_s=2.0))
+        a = perf.analyze_faults(**kw)
+        b = perf.analyze_faults(**kw)
+        assert a == b
+        assert a["n_scenarios"] == 4
+        assert 0 < a["goodput"]["mean"] <= 1 + 1e-9
+        assert a["goodput"]["min"] <= a["goodput"]["p50"] <= (
+            a["goodput"]["max"]
+        )
+        assert a["best_interval_steps"] in a["goodput_by_interval"]
+        assert len(a["reports"]) == 4
+        c = perf.analyze_faults(n_scenarios=4, seed=12, horizon_steps=6)
+        assert c["seed"] != a["seed"]
+
+
+# ---------------------------------------------------------------------------
+# Chaos harness: >= 200 seeded random scenarios across dense / MoE / MLA
+# x pp {1, 2, 4}
+# ---------------------------------------------------------------------------
+
+CHAOS_CONFIGS = {
+    "dense-pp1": dict(model="llama2-tiny", tp=2, pp=1, world=8),
+    "dense-pp2": dict(model="llama2-tiny", tp=2, pp=2, world=8, mbc=4),
+    "dense-pp4": dict(model="llama2-tiny", tp=2, pp=4, world=16,
+                      layers=4, mbc=4),
+    "moe-pp1": dict(model="mixtral-8x1b", ep=2, pp=1, world=8, layers=4),
+    "moe-pp2": dict(model="mixtral-8x1b", ep=2, pp=2, world=8, layers=4,
+                    mbc=4),
+    "moe-pp4": dict(model="mixtral-8x1b", ep=2, pp=4, world=8, layers=4,
+                    mbc=4),
+    "mla-pp1": dict(model="deepseekv2-lite", ep=2, pp=1, world=8,
+                    layers=4, dense_layers=0, system="tpu_v5p_256"),
+    "mla-pp2": dict(model="deepseekv2-lite", ep=2, pp=2, world=8,
+                    layers=4, dense_layers=0, mbc=4,
+                    system="tpu_v5p_256"),
+    "mla-pp4": dict(model="deepseekv2-lite", ep=2, pp=4, world=8,
+                    layers=4, dense_layers=0, mbc=4,
+                    system="tpu_v5p_256"),
+}
+
+N_CHAOS_SEEDS = 24  # 9 configs x 24 = 216 scenarios
+
+_chaos_cache = {}
+
+
+def _chaos_perf(key):
+    if key not in _chaos_cache:
+        p = build_perf(**CHAOS_CONFIGS[key])
+        _chaos_cache[key] = (p, p.simulate(None, **SIM))
+    return _chaos_cache[key]
+
+
+class TestChaos:
+    @pytest.mark.parametrize("key", sorted(CHAOS_CONFIGS))
+    def test_chaos_invariants(self, key):
+        p, healthy = _chaos_perf(key)
+        world = p.strategy.world_size
+        h = healthy["end_time"]
+        for seed in range(N_CHAOS_SEEDS):
+            # string hash() is salted per process: derive a stable
+            # per-config stream so failures reproduce across runs
+            rng = random.Random(
+                sum(ord(c) for c in key) * 1000 + seed
+            )
+            sc = sample_scenario(
+                rng, world, healthy["end_time_ms"] * 3, seed=seed,
+            )
+            ctx = (key, seed, [e.to_dict() for e in sc.events])
+            # invariant: no deadlock, no uncaught exception
+            res = p.simulate(None, faults=sc, **SIM)
+            # invariant: faults never speed the step up
+            assert res["end_time"] >= h - 1e-12, ctx
+            if sc.empty:
+                # invariant: the empty scenario IS the fault-free run
+                assert res == healthy, ctx
+                continue
+            out = res["faults"]
+            has_death = any(e.kind == "rank_death" for e in sc.events)
+            assert out["completed"] == (not out["deaths"]), ctx
+            if not has_death:
+                assert out["completed"], ctx
+            # invariant: reduce="auto" == exact full-world simulation
+            exact = p.simulate(None, faults=sc, reduce=False, **SIM)
+            assert res["end_time"] == exact["end_time"], ctx
+            assert res["per_rank_end_ms"] == exact["per_rank_end_ms"], ctx
+            assert res["faults"] == exact["faults"], ctx
+            if seed < 2:
+                # invariant: goodput <= 1, buckets sum to wall time
+                sc.horizon_steps = 5
+                rep = predict_goodput(
+                    p, sc,
+                    spec=CheckpointSpec(interval_steps=2,
+                                        restart_overhead_s=2.0),
+                )
+                assert rep.goodput <= 1 + 1e-9, ctx
+                assert sum(rep.buckets.to_dict().values()) == (
+                    pytest.approx(rep.wall_time_s, abs=1e-6)
+                ), ctx
+
+    @pytest.mark.parametrize("key", sorted(CHAOS_CONFIGS))
+    def test_chaos_empty_scenario_identity(self, key):
+        p, healthy = _chaos_perf(key)
+        empty = p.simulate(None, faults=FaultScenario([]), **SIM)
+        assert empty == healthy

@@ -467,6 +467,67 @@ class TestWorldRanks:
         r = analyze_stragglers(p, {})
         assert r["inflation"] == pytest.approx(1.0)
 
+    def test_perturbation_rank_out_of_range_is_config_error(self):
+        """Rank validation must be a typed ConfigError, not a bare
+        assert (asserts vanish under `python -O`, and the CLI turns
+        ConfigError into an actionable one-liner)."""
+        from simumax_tpu.core.errors import ConfigError
+
+        p = run("tp1_pp2_dp4_mbs1")
+        with pytest.raises(ConfigError, match="nonexistent ranks"):
+            p.simulate(None, world_ranks=True, perturbation={99: 1.5})
+        with pytest.raises(ConfigError, match="nonexistent ranks"):
+            p.simulate(None, world_ranks=True, perturbation={-1: 1.5})
+
+
+class TestAnalyzeStragglersDeterminism:
+    """Same seed/perturbation must produce bit-identical results under
+    reduce='auto' vs reduce='off' — including the deadlock-dump path,
+    whose diagnostic text must also be reproducible."""
+
+    def test_auto_equals_off_bit_identical(self):
+        from simumax_tpu.simulator.runner import analyze_stragglers
+
+        p = run("tp1_pp2_dp4_mbs1")
+        slow = {1: 1.3, 5: 1.1}
+        auto1 = analyze_stragglers(p, slow, reduce="auto")
+        auto2 = analyze_stragglers(p, slow, reduce="auto")
+        off = analyze_stragglers(p, slow, reduce=False)
+        assert auto1 == auto2  # repeated runs: bit-identical
+        assert auto1 == off  # exact float equality, not approx
+
+    def _break_schedule(self, monkeypatch):
+        """Drop stage 0's last forward: its downstream peer blocks on
+        a recv that never comes — a genuine schedule deadlock."""
+        import simumax_tpu.simulator.schedule as sched_mod
+
+        orig = sched_mod.one_f_one_b_order
+
+        def broken(pp, stage, mbc):
+            order = list(orig(pp, stage, mbc))
+            if stage == 0:
+                idx = max(
+                    i for i, op in enumerate(order) if op[0] == "F"
+                )
+                del order[idx]
+            return order
+
+        monkeypatch.setattr(sched_mod, "one_f_one_b_order", broken)
+
+    @pytest.mark.parametrize("reduce", ["auto", False])
+    def test_deadlock_dump_deterministic(self, monkeypatch, reduce):
+        from simumax_tpu.simulator.runner import analyze_stragglers
+
+        p = run("tp1_pp2_dp4_mbs1")
+        self._break_schedule(monkeypatch)
+        dumps = []
+        for _ in range(2):
+            with pytest.raises(DeadlockError) as ei:
+                analyze_stragglers(p, {1: 1.3}, reduce=reduce)
+            dumps.append(str(ei.value))
+        assert dumps[0] == dumps[1]  # reproducible diagnostics
+        assert "blocked" in dumps[0] and "recv" in dumps[0]
+
 
 class TestScheduler:
     """Ready-heap scheduler with wake indexes (ISSUE 4 tentpole):
