@@ -38,9 +38,15 @@ Observability surface (see ``docs/observability.md``): ``explain``
 renders the MFU-loss waterfall + top-N op table from the
 cost-attribution ledger (``--json`` saves the full ledger, ``--csv``
 the op table, ``--trace`` a Chrome trace of the analytical schedule);
-``diff`` compares two saved ledgers. Every subcommand accepts
-``--log-level`` and ``--log-json`` (structured JSONL lines with a
-run_id instead of the human format).
+``explain --memory`` renders the peak-HBM waterfall + per-tensor
+holder table from the memory ledger, with OOM forensics (top holders +
+what-if probes naming the cheapest fitting change) for non-fitting
+configs, ``--crosscheck`` for the analytical-vs-DES per-stage peak
+comparison, and ``--mem-artifacts DIR`` for the analytical memory
+timeline in the simulator's artifact formats; ``diff`` compares two
+saved ledgers (``--memory`` for memory ledgers). Every subcommand
+accepts ``--log-level`` and ``--log-json`` (structured JSONL lines
+with a run_id instead of the human format).
 """
 
 from __future__ import annotations
@@ -352,8 +358,17 @@ def _run_explain(args, perf):
     from simumax_tpu.observe.trace import write_analytical_trace
 
     log = _log()
+    if (args.crosscheck or args.mem_artifacts) and not args.memory:
+        # silently ignoring these would let the user believe the
+        # cross-check ran clean when it never ran at all
+        raise SystemExit(
+            "error: --crosscheck/--mem-artifacts require --memory "
+            "(they explain the peak-HBM prediction, not the step time)"
+        )
     perf.configure(args.strategy, args.model, args.system)
     perf.run_estimate()
+    if args.memory:
+        return _run_explain_memory(args, perf)
     led = perf.ledger()
     for line in led.waterfall_lines():
         log.info(line, event="waterfall")
@@ -393,21 +408,110 @@ def _run_explain(args, perf):
         )
 
 
+def _run_explain_memory(args, perf):
+    """`explain --memory`: peak-HBM waterfall + top holders from the
+    per-tensor memory ledger, OOM forensics (incl. the what-if probe
+    table) for non-fitting configs, and the analytical memory-timeline
+    artifacts."""
+    import csv as _csv
+
+    from simumax_tpu.observe.memledger import (
+        export_analytical_memory,
+        oom_forensic_lines,
+        oom_forensics,
+    )
+
+    log = _log()
+    # the timeline snapshots only ship inside the --json artifact; skip
+    # building them otherwise
+    led = perf.memory_ledger(timeline=bool(args.json))
+    for line in led.waterfall_lines():
+        log.info(line, event="mem_waterfall")
+    if led.headline["fits"]:
+        for line in led.top_holder_lines(args.top):
+            log.info(line, event="mem_top_holders")
+    else:
+        # the forensics block prints the same binding-stage top holders
+        # — one list, not two copies of it
+        report = oom_forensics(perf, top=args.top, probes=True,
+                               spans=led.spans)
+        for line in oom_forensic_lines(report):
+            log.info(line, event="mem_forensics")
+    if args.crosscheck:
+        res = perf.memory_crosscheck()
+        for r in res["stages"]:
+            log.info(
+                f"  stage {r['stage']}: analytical "
+                f"{r['analytical_peak_gib']:.2f} GiB vs DES "
+                f"{r['des_peak_gib']:.2f} GiB "
+                f"(ratio {r['des_vs_analytical']:.4f})",
+                event="mem_crosscheck", stage=r["stage"],
+                ratio=r["des_vs_analytical"],
+            )
+    if args.json:
+        led.save(args.json)
+        log.info(f"memory ledger -> {args.json}",
+                 event="explain_mem_ledger", path=args.json,
+                 run_id=led.meta["run_id"])
+    if args.csv:
+        rows = led.span_rows()
+        fields = [
+            "path", "bucket", "kinds", "category", "module_type",
+            "stage", "chunk", "bytes", "share", "count", "shape",
+            "dtype", "sharding",
+        ]
+        with open(args.csv, "w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            w.writeheader()
+            w.writerows(rows)
+        log.info(f"holder table -> {args.csv}", event="explain_mem_csv",
+                 path=args.csv, rows=len(rows))
+    if args.mem_artifacts:
+        paths = export_analytical_memory(perf, args.mem_artifacts)
+        log.info(
+            f"analytical memory timeline -> {paths['snapshot']}, "
+            f"memory-viz pickle -> {paths['memory_viz']} "
+            f"(load at pytorch.org/memory_viz), counter trace -> "
+            f"{paths['counters']}",
+            event="explain_mem_artifacts", **paths,
+        )
+    if args.trace:
+        from simumax_tpu.observe.trace import write_analytical_trace
+
+        write_analytical_trace(perf, args.trace)
+        log.info(
+            f"analytical Chrome trace -> {args.trace} "
+            f"(hbm_bytes counter tracks included)",
+            event="explain_trace", path=args.trace,
+        )
+
+
 def cmd_diff(args):
     from simumax_tpu.observe.ledger import (
         Ledger,
         diff_ledgers,
         format_diff_lines,
     )
+    from simumax_tpu.observe.memledger import (
+        MemoryLedger,
+        diff_memory_ledgers,
+        format_memory_diff_lines,
+    )
 
+    loader = MemoryLedger.load if args.memory else Ledger.load
     try:
-        a = Ledger.load(args.ledger_a)
-        b = Ledger.load(args.ledger_b)
+        a = loader(args.ledger_a)
+        b = loader(args.ledger_b)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         raise SystemExit(f"error: {exc}")
-    d = diff_ledgers(a, b, top=args.top)
+    if args.memory:
+        d = diff_memory_ledgers(a, b, top=args.top)
+        lines = format_memory_diff_lines(d, top=args.top)
+    else:
+        d = diff_ledgers(a, b, top=args.top)
+        lines = format_diff_lines(d, top=args.top)
     log = _log()
-    for line in format_diff_lines(d, top=args.top):
+    for line in lines:
         log.info(line, event="diff")
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
@@ -654,18 +758,41 @@ def main(argv=None):
 
     pe = sub.add_parser(
         "explain",
-        help="MFU-loss waterfall + top-N op attribution for one config",
+        help="MFU-loss waterfall + top-N op attribution for one config "
+             "(--memory: peak-HBM waterfall + per-tensor holders + OOM "
+             "forensics)",
     )
     pe.add_argument("--model", required=True)
     pe.add_argument("--strategy", required=True)
     pe.add_argument("--system", required=True)
     pe.add_argument("--top", type=int, default=10,
-                    help="rows in the top-op table (default 10)")
+                    help="rows in the top-op / top-holder table "
+                         "(default 10)")
+    pe.add_argument(
+        "--memory", action="store_true",
+        help="explain the peak-HBM prediction instead of the step time: "
+             "per-tensor memory ledger, peak-memory waterfall, and (for "
+             "non-fitting configs) OOM forensics with what-if probes",
+    )
+    pe.add_argument(
+        "--crosscheck", action="store_true",
+        help="with --memory: also run the discrete-event simulator with "
+             "memory tracking and report per-stage analytical-vs-DES "
+             "peak ratios",
+    )
+    pe.add_argument(
+        "--mem-artifacts", metavar="DIR",
+        help="with --memory: write the analytical memory timeline in "
+             "the simulator's artifact formats (JSON snapshot, torch "
+             "memory-viz pickle, Chrome counter trace)",
+    )
     pe.add_argument("--json", metavar="PATH",
                     help="save the full attribution ledger JSON "
-                         "(the input format of `simumax_tpu diff`)")
+                         "(the input format of `simumax_tpu diff`; with "
+                         "--memory, the memory-ledger JSON)")
     pe.add_argument("--csv", metavar="PATH",
-                    help="save the per-op attribution table as CSV")
+                    help="save the per-op attribution table as CSV "
+                         "(with --memory, the per-tensor holder table)")
     pe.add_argument("--trace", metavar="PATH",
                     help="save a Chrome/Perfetto trace of the analytical "
                          "schedule (same UI as simulate() traces)")
@@ -675,12 +802,18 @@ def main(argv=None):
 
     pdf = sub.add_parser(
         "diff",
-        help="compare two saved attribution ledgers (explain --json)",
+        help="compare two saved attribution ledgers (explain --json), "
+             "or two memory ledgers with --memory",
     )
     pdf.add_argument("ledger_a", help="baseline ledger JSON")
     pdf.add_argument("ledger_b", help="comparison ledger JSON")
     pdf.add_argument("--top", type=int, default=20,
                      help="max per-op deltas to report (default 20)")
+    pdf.add_argument(
+        "--memory", action="store_true",
+        help="the inputs are memory ledgers (explain --memory --json): "
+             "diff peak-HBM buckets and per-tensor holders",
+    )
     pdf.add_argument("--json", metavar="PATH",
                      help="also save the structured diff report")
     _add_log_args(pdf)

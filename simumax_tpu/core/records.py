@@ -276,6 +276,37 @@ class CollectiveSpan:
         return asdict(self)
 
 
+@dataclass
+class MemSpan:
+    """One live allocation at a stage's predicted HBM peak — the memory
+    ledger's per-tensor record (``observe/memledger.py``,
+    ``docs/observability.md``). The spans of one stage sum to that
+    stage's ``analysis_mem`` ``peak_bytes`` within 1e-6 relative.
+
+    ``bytes`` is the total contribution at the peak (``count`` instances
+    folded in — e.g. one activation cache held for each of ``count``
+    outstanding microbatches). ``bytes`` may be slightly negative for
+    the ``saved_input_reuse`` adjustment of a recompute-segment replay
+    (the saved segment input is reused, not re-allocated)."""
+
+    path: str  # module path, e.g. stage0_chunk0.layer0.attention.qkv_proj
+    module_type: str  # leaf class name (LinearCol, CoreAttention, ...)
+    category: str  # op family tag (gemm | attention | moe_dispatch | ...)
+    stage: int
+    chunk: int
+    bucket: str  # peak-waterfall bucket (params | grads | ... see memledger)
+    kind: str  # weight | grad | opt_state | act_cache | recompute_cache |
+    #          fwd_temp | bwd_temp | grad_flight | saved_input_reuse
+    bytes: float  # total bytes live at the peak (count instances)
+    count: int  # instances folded into ``bytes`` (outstanding microbatches)
+    shape: Optional[str]  # best-effort tensor shape, None when unknown
+    dtype: str
+    sharding: str  # provenance: which dims shard/replicate this tensor
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
 @_addable
 @dataclass
 class GoodputBuckets:

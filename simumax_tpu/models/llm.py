@@ -274,26 +274,33 @@ class LLMModel(MetaModule):
         )
 
     # -- activation replay (reference ``language_model.py:355-467``) -------
-    def compute_activations(self) -> PeakPoint:
-        """Walk the called leaves fwd then bwd (with recompute segment
-        replay), tracking the live activation set; returns the peak.
+    def activation_events(self):
+        """The activation-replay walk as an event stream — the single
+        source for both :meth:`compute_activations` (scalar fold to the
+        peak) and the memory ledger's peak live-set materialization
+        (``observe/memledger.py``), so the two can never diverge.
 
-        Conservation invariant: the live set must return to ~0 after the
-        backward walk (reference ``language_model.py:462-465``).
+        Yields tuples:
+
+        * ``("alloc", leaf, kind, bytes)`` / ``("free", leaf, kind,
+          bytes)`` — the live set grows/shrinks by ``bytes``; ``kind``
+          is ``act_cache`` (fwd-to-bwd activation cache) or
+          ``recompute_cache`` (raw cache re-materialized during a
+          checkpointed segment's replay);
+        * ``("probe", leaf, stage, extras)`` — a candidate peak at the
+          current live set plus the transient ``extras``: an ordered
+          tuple of ``(kind, bytes)`` terms (``fwd_temp`` /
+          ``bwd_temp`` / ``grad_flight`` / the negative
+          ``saved_input_reuse`` adjustment of a segment replay), summed
+          onto ``live`` left-to-right so the fold reproduces the
+          historical float-op order bit-for-bit.
         """
         leaves = self.called_leaves()
-        live = 0.0
-        peak = PeakPoint()
-
-        def bump(path: str, stage: str, candidate: float):
-            nonlocal peak
-            if candidate > peak.bytes:
-                peak = PeakPoint(path, stage, candidate)
-
         # ---- forward walk
         for leaf in leaves:
-            live += leaf.act_info.cache_bytes
-            bump(leaf.path_name(), "fwd", live + leaf.raw_act_info.fwd_temp_bytes)
+            yield ("alloc", leaf, "act_cache", leaf.act_info.cache_bytes)
+            yield ("probe", leaf, "fwd",
+                   (("fwd_temp", leaf.raw_act_info.fwd_temp_bytes),))
 
         # ---- backward walk with recompute replay. Segments need not be
         # contiguous in the call order (e.g. sdp-only inside a
@@ -324,30 +331,56 @@ class LLMModel(MetaModule):
                 for sl in seg_leaves:
                     if sl.variance_tail:
                         continue
-                    live += sl.raw_act_info.cache_bytes
-                    bump(sl.path_name(), "recompute",
-                         live - saved + sl.raw_act_info.fwd_temp_bytes)
+                    yield ("alloc", sl, "recompute_cache",
+                           sl.raw_act_info.cache_bytes)
+                    yield ("probe", sl, "recompute",
+                           (("saved_input_reuse", -saved),
+                            ("fwd_temp", sl.raw_act_info.fwd_temp_bytes)))
                 if not tail_is_first:
-                    live -= saved
+                    yield ("free", seg_leaves[0], "act_cache", saved)
                 # consume raw caches in reverse as bwd proceeds
                 for sl in reversed(seg_leaves):
-                    bump(sl.path_name(), "bwd",
-                         live + sl.raw_act_info.bwd_temp_bytes
-                         + sl.raw_act_info.grad_flight_bytes)
+                    yield ("probe", sl, "bwd",
+                           (("bwd_temp", sl.raw_act_info.bwd_temp_bytes),
+                            ("grad_flight",
+                             sl.raw_act_info.grad_flight_bytes)))
                     if sl.variance_tail:
                         if sl is seg_leaves[0]:
-                            live -= saved
+                            yield ("free", sl, "act_cache", saved)
                     else:
-                        live -= sl.raw_act_info.cache_bytes
+                        yield ("free", sl, "recompute_cache",
+                               sl.raw_act_info.cache_bytes)
                     done.add(id(sl))
                 i -= 1
                 continue
-            bump(leaf.path_name(), "bwd",
-                 live + leaf.raw_act_info.bwd_temp_bytes
-                 + leaf.raw_act_info.grad_flight_bytes)
-            live -= leaf.act_info.cache_bytes
+            yield ("probe", leaf, "bwd",
+                   (("bwd_temp", leaf.raw_act_info.bwd_temp_bytes),
+                    ("grad_flight", leaf.raw_act_info.grad_flight_bytes)))
+            yield ("free", leaf, "act_cache", leaf.act_info.cache_bytes)
             done.add(id(leaf))
             i -= 1
+
+    def compute_activations(self) -> PeakPoint:
+        """Fold :meth:`activation_events`, tracking the live activation
+        set; returns the peak.
+
+        Conservation invariant: the live set must return to ~0 after the
+        backward walk (reference ``language_model.py:462-465``).
+        """
+        live = 0.0
+        peak = PeakPoint()
+        for ev in self.activation_events():
+            op = ev[0]
+            if op == "alloc":
+                live += ev[3]
+            elif op == "free":
+                live -= ev[3]
+            else:  # probe
+                cand = live
+                for _, extra in ev[3]:
+                    cand += extra
+                if cand > peak.bytes:
+                    peak = PeakPoint(ev[1].path_name(), ev[2], cand)
 
         assert abs(live) < 1024, (
             f"activation conservation violated: {live} bytes left live"

@@ -1,20 +1,36 @@
 """Observability layer (L8-adjacent): the cost-attribution ledger, the
-MFU-loss waterfall, ledger diffing, the analytical Chrome-trace export,
-and the shared structured reporter.
+MFU-loss waterfall, the per-tensor HBM memory ledger with its
+peak-memory waterfall and OOM forensics, ledger diffing, the analytical
+Chrome-trace / memory-timeline exports, and the shared structured
+reporter.
 
-See ``docs/observability.md`` for the ledger schema, the waterfall
-bucket definitions, and a worked misprediction-triage example.
+See ``docs/observability.md`` for the ledger schemas, the waterfall
+bucket definitions, and worked triage examples.
 """
 
 from simumax_tpu.observe.ledger import Ledger, attribution_line, build_waterfall, diff_ledgers
+from simumax_tpu.observe.memledger import (
+    MemoryLedger,
+    build_memory_waterfall,
+    diff_memory_ledgers,
+    mem_crosscheck,
+    memory_attribution_line,
+    oom_forensics,
+)
 from simumax_tpu.observe.report import Reporter, configure_reporter, get_reporter
 
 __all__ = [
     "Ledger",
+    "MemoryLedger",
     "Reporter",
     "attribution_line",
+    "build_memory_waterfall",
     "build_waterfall",
     "configure_reporter",
     "diff_ledgers",
+    "diff_memory_ledgers",
     "get_reporter",
+    "mem_crosscheck",
+    "memory_attribution_line",
+    "oom_forensics",
 ]

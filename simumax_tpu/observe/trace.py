@@ -29,40 +29,28 @@ from __future__ import annotations
 from typing import List, Tuple
 
 from simumax_tpu.simulator.engine import TraceEvent
-from simumax_tpu.simulator.memory import MemSample
+from simumax_tpu.simulator.memory import MemSample, SimuMemoryTracker
 from simumax_tpu.simulator.trace import to_chrome_trace
 
 
-class _CounterTrack:
-    """Minimal tracker shim (``rank`` + ``timeline``) accepted by
-    ``to_chrome_trace``'s counter-track exporter."""
-
-    def __init__(self, rank: int, timeline: List[MemSample]):
-        self.rank = rank
-        self.timeline = timeline
-
-
-def analytical_trace_events(perf) -> Tuple[List[TraceEvent], List[_CounterTrack]]:
+def analytical_trace_events(perf) -> Tuple[List[TraceEvent], List[SimuMemoryTracker]]:
     """Build TraceEvents + per-stage memory counter tracks from the last
-    ``analysis_cost()`` schedule replay."""
+    ``analysis_cost()`` schedule replay. The counter tracks ARE the
+    memory ledger's analytical timeline trackers
+    (``observe/memledger.py::analytical_memory_trackers`` — one replay,
+    two consumers), extended with a flat ``step_end`` sample covering
+    the exposed optimizer tail this trace additionally lays out."""
+    from simumax_tpu.observe.memledger import analytical_memory_trackers
+
     perf.analysis_cost()  # ensures the replay ran (cached)
     st = perf.strategy
     pp, vp = st.pp_size, st.vp_size
-    cache = {
-        (s, c): ch.act_info.cache_bytes for (s, c), ch in perf.chunks.items()
-    }
-    model_bytes = {
-        s: sum(ch.param_info.total_bytes for ch in perf.stage_chunks(s))
-        for s in range(pp)
-    }
     events: List[TraceEvent] = []
-    trackers: List[_CounterTrack] = []
+    trackers = analytical_memory_trackers(perf, record_events=False)
     by_stage: List[List[tuple]] = [[] for _ in range(pp)]
     for ev in perf._schedule_events:
         by_stage[ev[0]].append(ev)
     for s in range(pp):
-        live = model_bytes[s]
-        timeline = [MemSample(0.0, live, "static")]
         for (_, kind, c, mb, start, end) in sorted(
             by_stage[s], key=lambda e: e[4]
         ):
@@ -73,8 +61,6 @@ def analytical_trace_events(perf) -> Tuple[List[TraceEvent], List[_CounterTrack]
                 rank=s, lane="comp", name=name, start=start, end=end,
                 kind="compute",
             ))
-            live += cache.get((s, c), 0.0) * (1 if kind == "F" else -1)
-            timeline.append(MemSample(end, live, name))
         # exposed step tail: grad reduce-scatter -> optimizer -> param
         # gather (the analytical max-path components, laid out serially
         # the way analysis_cost charges them)
@@ -93,8 +79,9 @@ def analytical_trace_events(perf) -> Tuple[List[TraceEvent], List[_CounterTrack]
                 kind=kind,
             ))
             t += dur
-        timeline.append(MemSample(t, model_bytes[s], "step_end"))
-        trackers.append(_CounterTrack(s, timeline))
+        trackers[s].timeline.append(
+            MemSample(t, trackers[s].static_bytes, "step_end")
+        )
     return events, trackers
 
 

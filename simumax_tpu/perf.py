@@ -35,6 +35,54 @@ from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.core.utils import dp_comm_buckets, human_time
 from simumax_tpu.models.llm import LLMModel
 
+#: stable schema tag of the :meth:`PerfLLM.analysis_mem` result dict
+#: (documented in docs/observability.md; bump on breaking changes)
+MEM_SCHEMA = "simumax-mem-v1"
+
+
+def interleaved_stage_peak(order, cache, peakpt):
+    """Schedule-position memory replay of one stage's interleaved op
+    list — the single source for both ``_analysis_mem_interleaved``'s
+    scalar peak and the memory ledger's peak live-set materialization
+    (``observe/memledger.py``), so the two folds can never diverge.
+
+    ``order`` is the stage's (kind, chunk, mb) op list; ``cache`` /
+    ``peakpt`` map chunk_idx -> per-microbatch cache bytes / internal
+    walk peak. At each op, the active chunk's own microbatch walk
+    contributes its internal PeakPoint (which includes that
+    microbatch's cache) on top of every OTHER outstanding microbatch's
+    cache — no last-chunk heuristic (round-1 VERDICT weak #3).
+
+    Returns ``(peak_sched, peak_outstanding, peak_counts,
+    peak_active)``: the peak bytes over model memory, the number of
+    outstanding microbatches at the peak, the per-chunk count of FULL
+    caches held there (the active chunk's own microbatch already
+    excluded), and the chunk whose internal walk the peak rode on
+    (None when the plain outstanding-cache sum won the max)."""
+    live = peak_sched = 0.0
+    counts: Dict[int, int] = {}
+    peak_outstanding = 0
+    peak_counts: Dict[int, int] = {}
+    peak_active: Optional[int] = None
+    for kind, c, _ in order:
+        if kind == "F":
+            live += cache.get(c, 0.0)
+            counts[c] = counts.get(c, 0) + 1
+        cand = live - cache.get(c, 0.0) + peakpt.get(c, 0.0)
+        if max(cand, live) > peak_sched:
+            peak_sched = max(cand, live)
+            peak_outstanding = sum(counts.values())
+            peak_counts = dict(counts)
+            if cand >= live:
+                peak_active = c
+                peak_counts[c] = peak_counts.get(c, 0) - 1
+            else:
+                peak_active = None
+        if kind == "B":
+            live -= cache.get(c, 0.0)
+            counts[c] = counts.get(c, 0) - 1
+    return peak_sched, peak_outstanding, peak_counts, peak_active
+
 
 def _resolve(cfg, cls, getter):
     if isinstance(cfg, cls):
@@ -411,6 +459,23 @@ class PerfLLM(PerfBase):
         return [c for (s, _), c in sorted(self.chunks.items()) if s == stage]
 
     def analysis_mem(self) -> dict:
+        """Per-stage peak-HBM prediction. Stable documented schema
+        (``simumax-mem-v1``, see docs/observability.md):
+
+        * ``stages[i]`` — per pipeline stage: ``model_bytes`` split into
+          ``weight_bytes`` / ``grad_bytes`` / ``optimizer_state_bytes``,
+          ``act_cache_per_microbatch_bytes``, ``live_microbatches``,
+          ``replay_peak_bytes`` (the per-chunk activation-walk peak),
+          ``peak_bytes`` / ``peak_gib``, and ``fits_margin_bytes``
+          (usable HBM minus this stage's peak; negative = over);
+        * top level — ``binding_stage`` (the max-peak stage every
+          memory surface keys on), ``max_peak_bytes`` /
+          ``max_peak_gib``, ``hbm_capacity_gib``, ``usable_bytes`` /
+          ``usable_gib`` (capacity x ``mem_factor``), ``fits``, and
+          ``fits_margin_bytes`` for the binding stage.
+
+        The memory ledger (:meth:`memory_ledger`) decomposes each
+        stage's ``peak_bytes`` into its live tensors."""
         if self._mem_result is not None:
             return self._mem_result
         st = self.strategy
@@ -455,13 +520,25 @@ class PerfLLM(PerfBase):
                     }
                 )
         cap = self.system.mem_bytes * st.mem_factor
+        for s in stages:
+            s["fits_margin_bytes"] = cap - s["peak_bytes"]
+        max_peak = max(s["peak_bytes"] for s in stages)
+        # the single source every memory surface (waterfall, forensics,
+        # timeline artifacts) keys its "binding stage" on — first stage
+        # at the max on ties (max returns the first maximal element)
+        binding = max(range(len(stages)),
+                      key=lambda i: stages[i]["peak_bytes"])
         result = {
+            "schema": MEM_SCHEMA,
             "stages": stages,
-            "max_peak_bytes": max(s["peak_bytes"] for s in stages),
-            "max_peak_gib": max(s["peak_bytes"] for s in stages) / GiB,
+            "binding_stage": binding,
+            "max_peak_bytes": max_peak,
+            "max_peak_gib": max_peak / GiB,
             "hbm_capacity_gib": self.system.mem_bytes / GiB,
+            "usable_bytes": cap,
             "usable_gib": cap / GiB,
             "fits": all(s["peak_bytes"] <= cap for s in stages),
+            "fits_margin_bytes": cap - max_peak,
         }
         self._mem_result = result
         return result
@@ -691,25 +768,11 @@ class PerfLLM(PerfBase):
                 for ch in chunks
             }
             model_mem = sum(ch.param_info.total_bytes for ch in chunks)
-            # schedule-position replay: at each op, the active chunk's
-            # own microbatch walk contributes its internal PeakPoint
-            # (which includes that microbatch's cache) on top of every
-            # OTHER outstanding microbatch's cache — no last-chunk
-            # heuristic (round-1 VERDICT weak #3).
-            live = peak_sched = 0.0
-            peak_outstanding = 0
-            outstanding = 0
-            for kind, c, _ in orders[s]:
-                if kind == "F":
-                    live += cache.get(c, 0.0)
-                    outstanding += 1
-                cand = live - cache.get(c, 0.0) + peakpt.get(c, 0.0)
-                if max(cand, live) > peak_sched:
-                    peak_sched = max(cand, live)
-                    peak_outstanding = outstanding
-                if kind == "B":
-                    live -= cache.get(c, 0.0)
-                    outstanding -= 1
+            # schedule-position replay shared with the memory ledger
+            # (see interleaved_stage_peak)
+            peak_sched, peak_outstanding, _, _ = interleaved_stage_peak(
+                orders[s], cache, peakpt
+            )
             replay_peak = max((peakpt[c] for c in peakpt), default=0.0)
             peak = model_mem + peak_sched
             stages.append(
@@ -1131,6 +1194,30 @@ class PerfLLM(PerfBase):
         from simumax_tpu.observe.ledger import Ledger
 
         return Ledger.collect(self)
+
+    def memory_ledger(self, timeline: bool = True):
+        """Collect the per-tensor HBM ledger of the current estimate
+        (``observe/memledger.py`` / ``docs/observability.md``): the full
+        live set at each stage's predicted peak as ``MemSpan`` records,
+        the peak-HBM waterfall (buckets sum to
+        ``analysis_mem()["max_peak_bytes"]`` within 1e-6), and the
+        analytical memory timeline in the simulator's snapshot schema.
+        Post-hoc and read-only like :meth:`ledger` — headline numbers
+        with and without collection are bit-identical."""
+        from simumax_tpu.observe.memledger import MemoryLedger
+
+        return MemoryLedger.collect(self, timeline=timeline)
+
+    def memory_crosscheck(self, granularity: str = "leaf"):
+        """Per-stage analytical-vs-DES peak cross-check
+        (``observe/memledger.py::mem_crosscheck``): replay the step in
+        the discrete-event simulator with memory tracking and compare
+        each stage's simulated peak against this estimate's
+        ``analysis_mem`` prediction — the memory analog of the sweep's
+        ``sim_vs_analytical`` time column."""
+        from simumax_tpu.observe.memledger import mem_crosscheck
+
+        return mem_crosscheck(self, granularity=granularity)
 
     # simulate() is provided by L5 (simulator package); bound lazily
     def simulate(self, save_path: Optional[str] = None, **kwargs):

@@ -90,7 +90,8 @@ def model_param_split(model: ModelConfig) -> Tuple[int, int]:
     return dense, expert
 
 
-def memory_lower_bound(st: StrategyConfig, model: ModelConfig) -> float:
+def memory_lower_bound(st: StrategyConfig, model: ModelConfig,
+                       audit: bool = False):
     """Closed-form lower bound (bytes) on the max per-device stage peak
     of this layout, at micro_batch_size=1 under full recompute — the
     cheapest configuration any batch/recompute search could reach.
@@ -101,7 +102,15 @@ def memory_lower_bound(st: StrategyConfig, model: ModelConfig) -> float:
     functional optimizer), optimizer state at 12 B/elem megatron-style
     or 8 B/elem functional (sharded under ZeRO>=1). Dense params shard
     over tp, expert params over etp*ep; the per-stage floor is the
-    even-split mean (max stage >= mean)."""
+    even-split mean (max stage >= mean).
+
+    ``audit=True`` returns the ``{params_term, act_term, bound}``
+    breakdown instead of the scalar, so the bound can be property-tested
+    against the memory ledger's params+grads+optimizer bucket sums
+    (``tests/test_memledger.py``): the safety-scaled params term must
+    stay under the built model's param buckets, and the whole bound
+    under the realized peak — bound drift fails loudly instead of
+    silently over-pruning."""
     dense, expert = model_param_split(model)
     dshard = max(1, st.dp_size * st.cp_size)
     eshard = max(1, st.edp_size)
@@ -127,6 +136,12 @@ def memory_lower_bound(st: StrategyConfig, model: ModelConfig) -> float:
     if st.enable_sequence_parallel:
         act_seq //= max(1, st.tp_size)
     act = act_seq * model.hidden_size * e
+    if audit:
+        return {
+            "params_term": PRUNE_SAFETY * params,
+            "act_term": act,
+            "bound": PRUNE_SAFETY * params + act,
+        }
     return PRUNE_SAFETY * params + act
 
 
@@ -142,18 +157,27 @@ def base_cell_row(st: StrategyConfig, rc: str, status: str) -> dict:
         "mbc": st.micro_batch_num, "zero": st.zero_state,
         "recompute": rc, "recompute_layers": 0,
         "mfu": 0.0, "iter_ms": 0.0, "tgs": 0.0, "peak_gib": 0.0,
-        "fits": False, "dcn_dims": "",
+        # None -> empty CSV cell: rows with no memory verdict (error /
+        # non-memory prunes) must not claim a numeric headroom
+        "fits": False, "mem_margin_gib": None, "dcn_dims": "",
         "status": status,
     }
 
 
 def pruned_row(st: StrategyConfig, rc: str, reason: str,
-               bound_bytes: Optional[float] = None) -> dict:
+               bound_bytes: Optional[float] = None,
+               usable_bytes: Optional[float] = None) -> dict:
     """A CSV-compatible ``status=pruned`` row; ``peak_gib`` carries the
-    memory floor when the prune was memory-based."""
+    memory floor and ``mem_margin_gib`` the — negative — headroom
+    against raw usable HBM (the prune decision's own threshold: like
+    every row family, the margin column measures against the exact
+    threshold THIS row's feasibility verdict used) when the prune was
+    memory-based."""
     row = base_cell_row(st, rc, "pruned")
     if bound_bytes:
         row["peak_gib"] = bound_bytes / GiB
+        if usable_bytes is not None:
+            row["mem_margin_gib"] = (usable_bytes - bound_bytes) / GiB
     row["prune_reason"] = reason
     return row
 
@@ -201,9 +225,10 @@ def enumerate_cells(
         ):
             reason = "gbs_indivisible"
         bound = None
+        usable = system.mem_bytes * st.mem_factor
         if reason is None and prune:
             floor = memory_lower_bound(st, model)
-            if floor > system.mem_bytes * st.mem_factor:
+            if floor > usable:
                 reason = "memory_lower_bound"
                 bound = floor
         for rc in recompute_types:
@@ -212,5 +237,6 @@ def enumerate_cells(
                 cells.append(SweepCell(idx, key, tp, cp, ep, pp, zero, rc))
                 idx += 1
             elif prune:
-                pruned.append(pruned_row(st, rc, reason, bound_bytes=bound))
+                pruned.append(pruned_row(st, rc, reason, bound_bytes=bound,
+                                         usable_bytes=usable))
     return cells, pruned

@@ -333,6 +333,7 @@ def evaluate_strategy(
             system.mem_bytes * strategy.mem_factor
         )
         from simumax_tpu.observe.ledger import attribution_line
+        from simumax_tpu.observe.memledger import memory_attribution_line
 
         row = {
             "tp": strategy.tp_size, "cp": strategy.cp_size,
@@ -353,6 +354,15 @@ def evaluate_strategy(
             "tgs": cost["tgs"],
             "peak_gib": mem["max_peak_gib"],
             "fits": fits,
+            # headroom in GiB against the SAME threshold THIS row's
+            # fits verdict used (usable HBM minus this family's
+            # gib_margin safety band — 1 GiB for the batch-split
+            # search, 0 for the recompute families, raw usable for
+            # pruned rows), so margin >= 0 <=> fits on every row —
+            # consumers see headroom, not just a bare boolean
+            "mem_margin_gib": (
+                mem["fits_margin_bytes"] - gib_margin * GiB
+            ) / GiB,
             "net": {k: p.describe() for k, p in perf.ctx.paths.items()},
             "dcn_dims": ",".join(
                 d for d, p in perf.ctx.paths.items() if p.on_dcn
@@ -363,6 +373,9 @@ def evaluate_strategy(
             # the already-cached analyses — no ledger is built (sweeps
             # stay on the zero-cost path).
             "attribution": attribution_line(perf),
+            # one-line peak-memory attribution, same contract: derived
+            # from the cached analysis_mem only, no ledger walk
+            "mem_attribution": memory_attribution_line(perf),
         }
         # DualPipe projection for eligible layouts (reuses the cached
         # analyses; no re-estimate) — lets a sweep surface candidates

@@ -34,9 +34,14 @@ class SimuMemoryTracker:
     must be freed exactly once with the same size."""
 
     def __init__(self, rank: int, static_bytes: float = 0.0,
-                 record_events: bool = True):
+                 record_events: bool = True, source: str = "simulated"):
         self.rank = rank
         self.static_bytes = static_bytes
+        #: which predictor produced this timeline: ``"simulated"`` (the
+        #: discrete-event engine) or ``"analytical"`` (the schedule
+        #: replay exported by ``observe/memledger.py``) — both ship the
+        #: same snapshot schema so the two predictions diff directly
+        self.source = source
         #: keep the per-event alloc/free trace for the memory-viz
         #: export; runs that will never export (no save_path) disable
         #: it to skip the dead per-event work
@@ -159,6 +164,7 @@ class SimuMemoryTracker:
     def summary(self) -> dict:
         return {
             "rank": self.rank,
+            "source": self.source,
             "static_bytes": self.static_bytes,
             "peak_bytes": self.peak,
             "peak_gib": self.peak / 2**30,
@@ -174,6 +180,7 @@ class SimuMemoryTracker:
         return {
             "schema": "simumax_tpu_memory_snapshot_v1",
             "rank": self.rank,
+            "source": self.source,
             "static_bytes": self.static_bytes,
             "peak_by_category": self.peak_by_category(),
             "peak_holders": dict(
