@@ -27,6 +27,10 @@ Usage::
     python bench_simulate.py --perturb 0:1.3,7:1.5  # straggler injection
     python bench_simulate.py --baseline BENCH_prev.json \
         --max-regression 0.1      # regression gate (exit 1 on breach)
+    python bench_simulate.py --critical-path        # overhead gate:
+        # recorder-on vs off makespans must be bit-identical, and the
+        # events/s overhead of recording + analyzing the dependency
+        # skeleton must stay under --max-critpath-overhead (0.15)
 
 Recorded alongside ``bench_sweep.py`` in the bench harness; numbers are
 committed in ``docs/simulation.md``.
@@ -100,6 +104,28 @@ def main(argv=None):
                     help="stream trace.json to a temp dir while "
                          "simulating (the bounded-RSS path)")
     ap.add_argument(
+        "--critical-path", action="store_true",
+        help="critical-path overhead gate: run the same simulation "
+             "with and without the dependency recorder, assert the "
+             "makespans are bit-identical, report the recorder-on "
+             "events/s as `value` plus `critpath_overhead` vs the "
+             "recorder-off run, and fail (exit 1) when the overhead "
+             "exceeds --max-critpath-overhead",
+    )
+    ap.add_argument(
+        "--max-critpath-overhead", type=float, default=0.15,
+        metavar="FRAC",
+        help="with --critical-path: max tolerated events/s overhead of "
+             "recorder-on vs recorder-off on THIS machine "
+             "(default 0.15)",
+    )
+    ap.add_argument(
+        "--repeats", type=int, default=5, metavar="N",
+        help="with --critical-path: timed repetitions per mode; the "
+             "min elapsed of each side is compared (wall-clock noise "
+             "robustness; default 5)",
+    )
+    ap.add_argument(
         "--baseline", metavar="JSON",
         help="previously saved bench JSON line to gate against "
              "(compares events/sec at the same world/mode/granularity)",
@@ -118,17 +144,32 @@ def main(argv=None):
     if args.stream_trace:
         tmp = tempfile.TemporaryDirectory(prefix="bench_simulate_")
         save_path = tmp.name
-    t0 = time.perf_counter()
-    r = perf.simulate(
-        save_path,
-        granularity=args.granularity,
-        world_ranks=True,
-        track_memory=False,
-        perturbation=perturbation,
-        reduce=args.mode == "reduced",
-        stream_trace=args.stream_trace,
-    )
-    elapsed = time.perf_counter() - t0
+    def one_run(critical_path=False):
+        t0 = time.perf_counter()
+        res = perf.simulate(
+            save_path,
+            granularity=args.granularity,
+            world_ranks=True,
+            track_memory=False,
+            perturbation=perturbation,
+            reduce=args.mode == "reduced",
+            stream_trace=args.stream_trace,
+            critical_path=critical_path,
+        )
+        return res, time.perf_counter() - t0
+
+    off = None
+    if args.critical_path:
+        one_run(critical_path=False)  # warmup: builds/caches off-clock
+        off, off_elapsed = one_run(critical_path=False)
+        r, elapsed = one_run(critical_path=True)
+        for _ in range(max(0, args.repeats - 1)):
+            _, t = one_run(critical_path=False)
+            off_elapsed = min(off_elapsed, t)
+            _, t = one_run(critical_path=True)
+            elapsed = min(elapsed, t)
+    else:
+        r, elapsed = one_run(critical_path=False)
     trace_bytes = None
     if save_path:
         trace_bytes = os.path.getsize(os.path.join(save_path, "trace.json"))
@@ -155,6 +196,26 @@ def main(argv=None):
     if trace_bytes is not None:
         result["trace_bytes"] = trace_bytes
     ok = True
+    if args.critical_path:
+        # the tentpole contract first: recording the dependency
+        # skeleton must not move the simulated makespan by one bit
+        if r["end_time"] != off["end_time"]:
+            print(json.dumps({
+                "error": "critical-path-on makespan differs from off: "
+                         f"{r['end_time']!r} vs {off['end_time']!r}",
+            }))
+            return 1
+        off_value = off["num_events"] / off_elapsed if off_elapsed else 0.0
+        overhead = (
+            1.0 - result["value"] / off_value if off_value else 0.0
+        )
+        result["critical_path"] = True
+        result["off_value"] = round(off_value, 1)
+        result["critpath_overhead"] = round(overhead, 4)
+        result["critpath_overhead_ok"] = (
+            overhead <= args.max_critpath_overhead
+        )
+        ok = ok and result["critpath_overhead_ok"]
     if args.baseline:
         with open(args.baseline) as f:
             base = json.load(f)

@@ -43,8 +43,14 @@ holder table from the memory ledger, with OOM forensics (top holders +
 what-if probes naming the cheapest fitting change) for non-fitting
 configs, ``--crosscheck`` for the analytical-vs-DES per-stage peak
 comparison, and ``--mem-artifacts DIR`` for the analytical memory
-timeline in the simulator's artifact formats; ``diff`` compares two
-saved ledgers (``--memory`` for memory ledgers). Every subcommand
+timeline in the simulator's artifact formats; ``critical-path`` runs
+the discrete-event simulator with the event-dependency skeleton
+recorded and reports per-event slack, the simulated critical-path
+waterfall (buckets sum to the DES makespan), sim-vs-analytical
+divergence and per-rank/per-link slack headroom (``perf --simulate
+--critical-path`` attaches the same report to a perf run); ``diff``
+compares two saved ledgers (``--memory`` for memory ledgers,
+``--critical-path`` for critical-path reports). Every subcommand
 accepts ``--log-level`` and ``--log-json`` (structured JSONL lines
 with a run_id instead of the human format).
 """
@@ -144,6 +150,25 @@ def cmd_list(args):
             log.info(f"  {n}", event="config_name", kind=kind, name=n)
 
 
+def _load_scenario(args, world_ranks):
+    """Load ``--faults`` (when given) and apply the world-ranks
+    implication: rank-scoped faults need every rank simulated. Returns
+    ``(scenario, world_ranks)`` — shared by ``perf --simulate`` and
+    ``critical-path`` so the implication rule cannot diverge."""
+    if not args.faults:
+        return None, world_ranks
+    from simumax_tpu.simulator.faults import FaultScenario
+
+    scenario = FaultScenario.from_json(args.faults)
+    if not scenario.empty and not world_ranks:
+        world_ranks = True
+        _log().info(
+            "[faults] scenario implies --world-ranks",
+            event="faults_world_ranks",
+        )
+    return scenario, world_ranks
+
+
 def cmd_perf(args):
     from simumax_tpu import PerfLLM
 
@@ -154,19 +179,9 @@ def cmd_perf(args):
         perf.run_estimate(capture_graph=args.graph)
         perf.analysis(save_path=args.save)
         if args.simulate:
-            scenario = None
-            world_ranks = args.world_ranks
-            if args.faults:
-                from simumax_tpu.simulator.faults import FaultScenario
-
-                scenario = FaultScenario.from_json(args.faults)
-                if not scenario.empty and not world_ranks:
-                    # rank-scoped faults need every rank simulated
-                    world_ranks = True
-                    _log().info(
-                        "[faults] scenario implies --world-ranks",
-                        event="faults_world_ranks",
-                    )
+            scenario, world_ranks = _load_scenario(
+                args, args.world_ranks
+            )
             with perf.diagnostics.capture(category="simulate"):
                 result = perf.simulate(
                     args.simulate,
@@ -175,6 +190,7 @@ def cmd_perf(args):
                             "off": False}[args.reduce],
                     stream_trace=args.stream_trace,
                     faults=scenario,
+                    critical_path=args.critical_path,
                 )
             outcome = result.get("faults")
             if outcome:
@@ -202,6 +218,19 @@ def cmd_perf(args):
                 num_events=result["num_events"],
                 trace_path=result.get("trace_path"),
             )
+            report = result.get("critical_path")
+            if report:
+                from simumax_tpu.observe.critpath import waterfall_lines
+
+                for line in waterfall_lines(report):
+                    _log().info(line, event="critpath_waterfall")
+                if result.get("critical_path_path"):
+                    _log().info(
+                        f"critical-path report -> "
+                        f"{result['critical_path_path']}",
+                        event="critpath_report",
+                        path=result["critical_path_path"],
+                    )
 
 
 def cmd_search(args):
@@ -487,6 +516,11 @@ def _run_explain_memory(args, perf):
 
 
 def cmd_diff(args):
+    from simumax_tpu.observe.critpath import (
+        diff_critpath,
+        format_critpath_diff_lines,
+        load_report,
+    )
     from simumax_tpu.observe.ledger import (
         Ledger,
         diff_ledgers,
@@ -498,13 +532,26 @@ def cmd_diff(args):
         format_memory_diff_lines,
     )
 
-    loader = MemoryLedger.load if args.memory else Ledger.load
+    if args.memory and args.critical_path:
+        raise SystemExit(
+            "error: --memory and --critical-path are exclusive (pick "
+            "the ledger family the inputs belong to)"
+        )
+    if args.critical_path:
+        loader = load_report
+    elif args.memory:
+        loader = MemoryLedger.load
+    else:
+        loader = Ledger.load
     try:
         a = loader(args.ledger_a)
         b = loader(args.ledger_b)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         raise SystemExit(f"error: {exc}")
-    if args.memory:
+    if args.critical_path:
+        d = diff_critpath(a, b, top=args.top)
+        lines = format_critpath_diff_lines(d, top=args.top)
+    elif args.memory:
         d = diff_memory_ledgers(a, b, top=args.top)
         lines = format_memory_diff_lines(d, top=args.top)
     else:
@@ -612,6 +659,66 @@ def _run_faults(args, perf):
             json.dump(report.to_dict(), f, indent=1)
         log.info(f"goodput report -> {args.json}", event="faults_json",
                  path=args.json)
+
+
+def cmd_critpath(args):
+    from simumax_tpu import PerfLLM
+
+    perf = PerfLLM()
+    perf.diagnostics.strict = args.strict
+    with _diagnosed(perf.diagnostics, args):
+        _run_critpath(args, perf)
+
+
+def _run_critpath(args, perf):
+    from simumax_tpu.observe.critpath import (
+        divergence_lines,
+        headroom_lines,
+        save_report,
+        waterfall_lines,
+    )
+
+    log = _log()
+    perf.configure(args.strategy, args.model, args.system)
+    perf.run_estimate()
+    scenario, world_ranks = _load_scenario(args, args.world_ranks)
+    with perf.diagnostics.capture(category="simulate"):
+        result = perf.simulate(
+            args.save,
+            granularity=args.granularity,
+            world_ranks=world_ranks,
+            reduce={"auto": "auto", "on": True, "off": False}[args.reduce],
+            faults=scenario,
+            critical_path=True,
+            track_memory=False,
+        )
+    report = result["critical_path"]
+    for line in waterfall_lines(report):
+        log.info(line, event="critpath_waterfall")
+    sl = report["slack"]
+    log.info(
+        f"slack: {sl['zero_slack_events']}/{sl['events']} events at zero "
+        f"slack, p50 {sl['p50_us']:.1f} us, p90 {sl['p90_us']:.1f} us",
+        event="critpath_slack", **sl,
+    )
+    for line in headroom_lines(report, top=args.top):
+        log.info(line, event="critpath_headroom")
+    div = report.get("divergence")
+    if div:
+        for line in divergence_lines(div, top=args.top):
+            log.info(line, event="critpath_divergence")
+    if args.save:
+        log.info(
+            f"artifacts: annotated trace -> {result.get('trace_path')}, "
+            f"report -> {result.get('critical_path_path')}",
+            event="critpath_artifacts",
+            trace_path=result.get("trace_path"),
+            report_path=result.get("critical_path_path"),
+        )
+    if args.json:
+        save_report(report, args.json)
+        log.info(f"critical-path report -> {args.json}",
+                 event="critpath_report", path=args.json)
 
 
 def cmd_dualpp(args):
@@ -751,6 +858,13 @@ def main(argv=None):
              "simulated step: rank slowdowns, preemptions, link "
              "degradation, rank deaths; implies --world-ranks",
     )
+    pp.add_argument(
+        "--critical-path", action="store_true",
+        help="with --simulate: record the event-dependency skeleton and "
+             "report per-event slack + the simulated critical-path "
+             "waterfall (critpath.json artifact, trace events gain "
+             "on_critical_path/slack_us args)",
+    )
     pp.add_argument("--graph", action="store_true", help="capture op graph")
     _add_diag_args(pp)
     _add_log_args(pp)
@@ -814,10 +928,58 @@ def main(argv=None):
         help="the inputs are memory ledgers (explain --memory --json): "
              "diff peak-HBM buckets and per-tensor holders",
     )
+    pdf.add_argument(
+        "--critical-path", action="store_true",
+        help="the inputs are critical-path reports (critical-path "
+             "--json): diff DES makespans, simulated-waterfall buckets "
+             "and slack headroom across two runs/scenarios",
+    )
     pdf.add_argument("--json", metavar="PATH",
                      help="also save the structured diff report")
     _add_log_args(pdf)
     pdf.set_defaults(fn=cmd_diff)
+
+    pcp = sub.add_parser(
+        "critical-path",
+        help="discrete-event critical path: per-event slack, the "
+             "simulated waterfall (sums to the DES makespan), "
+             "sim-vs-analytical divergence, slack-headroom summaries",
+    )
+    pcp.add_argument("--model", required=True)
+    pcp.add_argument("--strategy", required=True)
+    pcp.add_argument("--system", required=True)
+    pcp.add_argument(
+        "--world-ranks", action="store_true",
+        help="simulate every global rank (true rendezvous) instead of "
+             "one representative per pp stage",
+    )
+    pcp.add_argument(
+        "--reduce", choices=("auto", "on", "off"), default="auto",
+        help="world-rank symmetry reduction (default auto); the "
+             "reduced path expands bit-identically",
+    )
+    pcp.add_argument(
+        "--granularity", choices=("leaf", "chunk"), default="leaf",
+        help="replay granularity: leaf (default) resolves per-op "
+             "events; chunk is faster but folds recompute into compute",
+    )
+    pcp.add_argument(
+        "--faults", metavar="SCENARIO.json",
+        help="analyze the critical path under a fault scenario "
+             "(docs/faults.md); implies --world-ranks",
+    )
+    pcp.add_argument("--top", type=int, default=5,
+                     help="rows in the headroom / divergence tables "
+                          "(default 5)")
+    pcp.add_argument("--save", metavar="DIR",
+                     help="artifact directory: annotated Chrome trace "
+                          "+ critpath.json")
+    pcp.add_argument("--json", metavar="PATH",
+                     help="save the critical-path report JSON (the "
+                          "input format of `diff --critical-path`)")
+    _add_diag_args(pcp)
+    _add_log_args(pcp)
+    pcp.set_defaults(fn=cmd_critpath)
 
     ps = sub.add_parser("search", help="sweep parallel strategies")
     ps.add_argument("--model", required=True)

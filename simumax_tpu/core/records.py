@@ -307,6 +307,36 @@ class MemSpan:
         return asdict(self)
 
 
+@dataclass
+class CritSegment:
+    """One merged run of the simulated critical path (consecutive path
+    events on the same rank landing in the same blame bucket) — the
+    critical-path engine's record (``observe/critpath.py``,
+    ``docs/observability.md``). ``work`` is the time beyond the binding
+    dependency; the segments of one path sum to the DES makespan within
+    1e-6 relative."""
+
+    rank: int  # global rank (class-expanded under symmetry reduction)
+    stage: int  # pipeline stage of that rank
+    bucket: str  # simulated-waterfall blame bucket (compute | comm:tp | ...)
+    name: str  # representative event name (first event of the run)
+    start: float  # engine seconds (pre-straggler)
+    end: float
+    work: float  # seconds on the critical path beyond the binding pred
+    events: int  # path events merged into this segment
+    fault_extra: float  # fault-injected share of ``work``
+
+    def to_dict(self) -> Dict[str, Any]:
+        # hand-rolled (not asdict): a pod-size path has thousands of
+        # segments and asdict's deepcopy dominated the whole post-pass
+        return {
+            "rank": self.rank, "stage": self.stage,
+            "bucket": self.bucket, "name": self.name,
+            "start": self.start, "end": self.end, "work": self.work,
+            "events": self.events, "fault_extra": self.fault_extra,
+        }
+
+
 @_addable
 @dataclass
 class GoodputBuckets:

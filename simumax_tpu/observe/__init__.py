@@ -1,6 +1,8 @@
 """Observability layer (L8-adjacent): the cost-attribution ledger, the
 MFU-loss waterfall, the per-tensor HBM memory ledger with its
-peak-memory waterfall and OOM forensics, ledger diffing, the analytical
+peak-memory waterfall and OOM forensics, the discrete-event
+critical-path engine (slack, blame, simulated waterfall,
+sim-vs-analytical divergence), ledger diffing, the analytical
 Chrome-trace / memory-timeline exports, and the shared structured
 reporter.
 
@@ -8,6 +10,11 @@ See ``docs/observability.md`` for the ledger schemas, the waterfall
 bucket definitions, and worked triage examples.
 """
 
+from simumax_tpu.observe.critpath import (
+    DependencySkeleton,
+    diff_critpath,
+    diverge,
+)
 from simumax_tpu.observe.ledger import Ledger, attribution_line, build_waterfall, diff_ledgers
 from simumax_tpu.observe.memledger import (
     MemoryLedger,
@@ -20,6 +27,7 @@ from simumax_tpu.observe.memledger import (
 from simumax_tpu.observe.report import Reporter, configure_reporter, get_reporter
 
 __all__ = [
+    "DependencySkeleton",
     "Ledger",
     "MemoryLedger",
     "Reporter",
@@ -27,8 +35,10 @@ __all__ = [
     "build_memory_waterfall",
     "build_waterfall",
     "configure_reporter",
+    "diff_critpath",
     "diff_ledgers",
     "diff_memory_ledgers",
+    "diverge",
     "get_reporter",
     "mem_crosscheck",
     "memory_attribution_line",

@@ -1227,10 +1227,24 @@ class PerfLLM(PerfBase):
         ``perturbation`` ({rank: compute multiplier} straggler
         injection), ``reduce`` (rank-symmetry reduction: "auto" / True /
         False), ``track_memory``, ``stream_trace`` (bounded-RSS
-        incremental trace write). Reports into ``self.diagnostics``."""
+        incremental trace write), ``critical_path`` (record the
+        event-dependency skeleton and attach the slack / blame /
+        divergence report — ``observe/critpath.py``,
+        ``docs/observability.md``). Reports into
+        ``self.diagnostics``."""
         from simumax_tpu.simulator.runner import run_simulation
 
         return run_simulation(self, save_path, **kwargs)
+
+    def critical_path(self, save_path: Optional[str] = None, **kwargs):
+        """Convenience wrapper: :meth:`simulate` with
+        ``critical_path=True``, returning just the critical-path report
+        (per-event slack, the cross-rank path, the simulated waterfall
+        summing to the DES makespan, sim-vs-analytical divergence, and
+        per-rank / per-link slack headroom)."""
+        return self.simulate(
+            save_path, critical_path=True, **kwargs
+        )["critical_path"]
 
     def predict_goodput(self, scenario, **kwargs):
         """Goodput prediction for a fault scenario over its job horizon

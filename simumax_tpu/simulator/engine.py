@@ -68,6 +68,7 @@ deleted eagerly for the same reason.
 from __future__ import annotations
 
 import sys
+import time as _time
 from dataclasses import dataclass, field
 from heapq import heappop, heappush
 from typing import Callable, Dict, Generator, List, Optional, Tuple
@@ -121,6 +122,9 @@ class _Rendezvous:
     #: op name, retained so a deferred completion (a dead peer resolved
     #: by the fault model) can still emit a labelled trace span
     name: str = ""
+    #: seconds the fault model added on top of the nominal duration
+    #: (link degradation at rendezvous start) — critical-path blame
+    fault_extra: float = 0.0
 
     @property
     def complete(self) -> bool:
@@ -138,13 +142,31 @@ class SimuEngine:
 
     def __init__(self, num_ranks: int,
                  event_sink: Optional[Callable[[TraceEvent], None]] = None,
-                 fault_model=None):
+                 fault_model=None, dep_recorder=None,
+                 event_delays: Optional[Dict[Tuple[int, int], float]] = None,
+                 progress: Optional[Callable[..., None]] = None,
+                 progress_every: int = 0):
         #: optional fault-injection hook (see ``simulator/faults.py::
         #: StepFaultModel``) consulted at event-service time: piecewise
         #: compute-rate multipliers, comm-time multipliers per
         #: collective dim, and rank death times. ``None`` keeps every
         #: code path bit-identical to the fault-free engine.
         self._fault = fault_model
+        #: optional event-dependency recorder (see ``observe/critpath.
+        #: py::DependencySkeleton``, duck-typed so the engine never
+        #: imports the observability layer): purely observational —
+        #: recorder-on and recorder-off runs are bit-identical
+        self._rec = dep_recorder
+        #: {(rank, per-rank emit index): extra seconds} service-time
+        #: perturbations — the slack-correctness test hook: delay ONE
+        #: recorded event and compare makespans (``None`` = untouched)
+        self._delays = event_delays or None
+        #: progress heartbeat: ``progress(served=..., events=...,
+        #: clock_s=..., blocked_ranks=..., elapsed_s=...)`` every
+        #: ``progress_every`` served requests (0 disables; the runner
+        #: wires this to the Reporter at debug level)
+        self._progress = progress if progress_every > 0 else None
+        self._progress_every = progress_every
         self.num_ranks = num_ranks
         self.clock = [0.0] * num_ranks  # per-rank main lane clock
         #: retained trace records (unused when ``event_sink`` streams
@@ -176,6 +198,11 @@ class SimuEngine:
         #: sendrecv: publish time of the outbound send of an in-flight
         #: batched pair (keyed like _sends; removed on completion)
         self._sr_done: Dict[tuple, float] = {}
+        #: effective outbound duration of an in-flight sendrecv, pinned
+        #: at publish time — populated only under ``event_delays`` (a
+        #: re-serve attempt recomputes the nominal duration and would
+        #: otherwise drop the injected perturbation)
+        self._sr_dur: Dict[tuple, float] = {}
         self._flow_ids: Dict[tuple, int] = {}
         self._next_flow = 0
         #: async comm-stream state: per-(stream,peers) chained end time,
@@ -202,6 +229,9 @@ class SimuEngine:
         for r in range(self.num_ranks):
             self._advance_rank(r, None)
         ready = self._ready
+        served = 0
+        every = self._progress_every if self._progress is not None else 0
+        t0 = _time.monotonic() if every else 0.0
         while True:
             while ready:
                 _, r = heappop(ready)
@@ -210,6 +240,19 @@ class SimuEngine:
                     continue
                 if not self._try_serve(r):
                     self._block(r)
+                elif every:
+                    served += 1
+                    if served % every == 0:
+                        elapsed = _time.monotonic() - t0
+                        self._progress(
+                            served=served,
+                            events=self.num_events,
+                            clock_s=max(self.clock) if self.clock else 0.0,
+                            blocked_ranks=sum(
+                                1 for w in self._waiting_on if w
+                            ),
+                            elapsed_s=elapsed,
+                        )
             if self._n_done >= self.num_ranks:
                 break
             # heap drained with live ranks left: nothing can wake them —
@@ -327,6 +370,7 @@ class SimuEngine:
         dur = rv.duration
         if self._fault is not None:
             dur *= self._fault.comm_scale(key, rv.peers, start)
+            rv.fault_extra = dur - rv.duration
         rv.end = start + dur
         self._publish(pub_key)
 
@@ -339,6 +383,8 @@ class SimuEngine:
         self._dead[rank] = True
         self._death_at[rank] = t
         self.deaths.append((rank, t))
+        if self._rec is not None:
+            self._rec.on_death(rank, t)
         self._emit(TraceEvent(rank, "comp", "rank_death", t, t,
                               kind="fault"))
         proc = self._procs[rank]
@@ -391,6 +437,14 @@ class SimuEngine:
         else:
             self.events.append(ev)
 
+    def _delay(self, rank: int) -> float:
+        """Service-time perturbation of the event this rank is about to
+        emit (keyed by its per-rank emit index) — the slack-correctness
+        test hook. Zero for untouched events and untouched runs."""
+        if self._delays is None:
+            return 0.0
+        return self._delays.get((rank, self.events_by_rank[rank]), 0.0)
+
     def _advance_rank(self, rank: int, value):
         proc = self._procs[rank]
         try:
@@ -422,19 +476,30 @@ class SimuEngine:
                     # the rank dies mid-op: emit the truncated span,
                     # then let the kill resolve its partners
                     if dt > start:
+                        if self._rec is not None:
+                            self._rec.on_compute(rank, name, lane, start,
+                                                 dt, 0.0)
                         self._emit(TraceEvent(rank, lane, name, start, dt))
                     self.clock[rank] = dt
                     self._kill(rank)
                     return True
             else:
                 end = start + duration
-            self.clock[rank] = end
             if end > start:
+                # fault share of the span (slowdown stretch) for blame
+                extra = end - (start + duration)
+                end += self._delay(rank)
+                if self._rec is not None:
+                    self._rec.on_compute(rank, name, lane, start, end,
+                                         extra)
                 self._emit(TraceEvent(rank, lane, name, start, end))
+            self.clock[rank] = end
             self._advance_rank(rank, self.clock[rank])
             return True
         if kind == "advance":
             _, t = req
+            if self._rec is not None and t > self.clock[rank]:
+                self._rec.on_advance(rank, self.clock[rank], t)
             self.clock[rank] = max(self.clock[rank], t)
             self._advance_rank(rank, self.clock[rank])
             return True
@@ -442,6 +507,8 @@ class SimuEngine:
             # zero-advance visibility span (e.g. overlapped async comm)
             _, duration, name, lane = req
             start = self.clock[rank]
+            if self._rec is not None:
+                self._rec.on_trace(rank, name, start, start + duration)
             self._emit(
                 TraceEvent(rank, lane, name, start, start + duration,
                            kind="comm")
@@ -469,6 +536,8 @@ class SimuEngine:
                         phase="simulate", rank=rank, collective=str(key),
                     )
                 rv.arrivals[rank] = self.clock[rank]
+                if self._rec is not None:
+                    self._rec.on_coll_arrive(ckey, rank)
                 if rv.duration != duration:
                     raise SimulationError(
                         f"collective {key}#{seq}: mismatched durations "
@@ -489,7 +558,14 @@ class SimuEngine:
             if rv.end is None:
                 return False  # stay blocked until the last peer arrives
             start = self.clock[rank]
-            end = rv.end
+            end = rv.end + self._delay(rank)
+            if self._rec is not None:
+                dead = [] if fault is None else [
+                    p for p in rv.peers
+                    if p not in rv.arrivals and self._dead[p]
+                ]
+                self._rec.on_coll_serve(ckey, key, rank, name, start, end,
+                                        rv.fault_extra, dead)
             self._emit(
                 TraceEvent(rank, "comm", name, start, end, kind="comm")
             )
@@ -501,6 +577,8 @@ class SimuEngine:
             )
             if rv.consumed >= live:
                 del self._collectives[ckey]
+                if self._rec is not None:
+                    self._rec.on_coll_done(ckey)
             self._advance_rank(rank, end)
             return True
         if kind == "async_collective":
@@ -528,6 +606,8 @@ class SimuEngine:
                     phase="simulate", rank=rank, stream=str(stream),
                 )
             rv.arrivals[rank] = self.clock[rank]
+            if self._rec is not None:
+                self._rec.on_async_post(ckey, rank)
             self._async_pending[rank].add(ckey)
             if rv.complete:
                 self._finish_async(ckey, rv, name)
@@ -543,7 +623,10 @@ class SimuEngine:
         if kind == "wait_comm":
             if self._async_pending[rank]:
                 return False  # some posted op is waiting on peers
-            self.clock[rank] = max(self.clock[rank], self.comm_done[rank])
+            new = max(self.clock[rank], self.comm_done[rank])
+            if self._rec is not None:
+                self._rec.on_wait_comm(rank, self.clock[rank], new)
+            self.clock[rank] = new
             self._advance_rank(rank, self.clock[rank])
             return True
         if kind == "send":
@@ -558,14 +641,22 @@ class SimuEngine:
                     phase="simulate", rank=rank, send=str(skey),
                 )
             post = self.clock[rank]
+            extra = 0.0
             if fault is not None:
-                duration = duration * fault.comm_scale(
+                scaled = duration * fault.comm_scale(
                     "pp", (rank, dst), post
                 )
+                extra = scaled - duration
+                duration = scaled
+            duration += self._delay(rank)
             self._sends[skey] = (post, duration)
             fid = self._next_flow
             self._next_flow += 1
             self._flow_ids[skey] = fid
+            if self._rec is not None:
+                self._rec.on_send(skey, rank, name, lane, post,
+                                  post + duration, extra,
+                                  advance_tail=False, rendezvous=False)
             self._emit(
                 TraceEvent(rank, lane, name, post, post + duration,
                            kind="p2p", flow_id=fid)
@@ -587,6 +678,11 @@ class SimuEngine:
                     self._send_seq[(rank, dst, tag)] = seq + 1
                     end = max(self.clock[rank], self._death_at[dst])
                     if end > self.clock[rank]:
+                        if self._rec is not None:
+                            self._rec.on_fault_span(
+                                rank, f"abort_{name}", self.clock[rank],
+                                end,
+                            )
                         self._emit(
                             TraceEvent(rank, lane, f"abort_{name}",
                                        self.clock[rank], end, kind="fault")
@@ -597,16 +693,24 @@ class SimuEngine:
                 return False  # peer not at its recv yet: stay blocked
             self._send_seq[(rank, dst, tag)] = seq + 1
             start = max(self.clock[rank], recv_post)
+            extra = 0.0
             if fault is not None:
-                duration = duration * fault.comm_scale(
+                scaled = duration * fault.comm_scale(
                     "pp", (rank, dst), start
                 )
+                extra = scaled - duration
+                duration = scaled
+            duration += self._delay(rank)
             end = start + duration
             # publish as a completed transfer for the recv side
             self._sends[skey] = (start, duration)
             fid = self._next_flow
             self._next_flow += 1
             self._flow_ids[skey] = fid
+            if self._rec is not None:
+                self._rec.on_send(skey, rank, name, lane,
+                                  self.clock[rank], end, extra,
+                                  advance_tail=True, rendezvous=True)
             self._emit(
                 TraceEvent(rank, lane, name, self.clock[rank], end,
                            kind="p2p", flow_id=fid)
@@ -624,6 +728,8 @@ class SimuEngine:
                 # record when this recv was first posted (sync sends
                 # rendezvous against it)
                 self._recv_posts[skey] = self.clock[rank]
+                if self._rec is not None:
+                    self._rec.on_recv_post(skey, rank)
                 self._publish(("recvpost", skey))
             if skey not in self._sends:
                 if fault is not None and self._dead[src]:
@@ -633,6 +739,11 @@ class SimuEngine:
                     self._recv_seq[(rank, src, tag)] = seq + 1
                     end = max(self.clock[rank], self._death_at[src])
                     if end > self.clock[rank]:
+                        if self._rec is not None:
+                            self._rec.on_fault_span(
+                                rank, f"abort_{name}", self.clock[rank],
+                                end,
+                            )
                         self._emit(
                             TraceEvent(rank, lane, f"abort_{name}",
                                        self.clock[rank], end, kind="fault")
@@ -652,7 +763,13 @@ class SimuEngine:
             self._recv_posts.pop(skey, None)
             self._recv_seq[(rank, src, tag)] = seq + 1
             arrive = max(self.clock[rank], post + duration)
-            if arrive > self.clock[rank]:
+            emitted = arrive > self.clock[rank]
+            if emitted:
+                arrive += self._delay(rank)
+            if self._rec is not None:
+                self._rec.on_recv_serve(skey, rank, name, self.clock[rank],
+                                        arrive, emitted)
+            if emitted:
                 self._emit(
                     TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
                                arrive, kind="wait",
@@ -667,6 +784,7 @@ class SimuEngine:
             _, dst, stag, sdur, src, rtag, name, *rest = req
             lane = rest[0] if rest else "pp_fwd"
             post_t = self.clock[rank]
+            sdur0 = sdur
             if fault is not None and dst is not None:
                 # a blocked request re-serves at an unchanged clock, so
                 # this samples the same multiplier on every attempt
@@ -682,16 +800,29 @@ class SimuEngine:
                     out_key = (rank, dst, stag, seq)
                 if out_key not in self._sends and out_key not in self._sr_done:
                     self._send_seq[(rank, dst, stag)] = seq + 1
+                    extra = sdur - sdur0
+                    sdur += self._delay(rank)
+                    if self._delays is not None:
+                        self._sr_dur[out_key] = sdur
                     self._sends[out_key] = (post_t, sdur)
                     self._sr_done[out_key] = post_t
                     fid = self._next_flow
                     self._next_flow += 1
                     self._flow_ids[out_key] = fid
+                    if self._rec is not None:
+                        self._rec.on_send(out_key, rank, f"send_{name}",
+                                          lane, post_t, post_t + sdur,
+                                          extra, advance_tail=False,
+                                          rendezvous=False)
                     self._emit(
                         TraceEvent(rank, lane, f"send_{name}", post_t,
                                    post_t + sdur, kind="p2p", flow_id=fid)
                     )
                     self._publish(("send", out_key))
+                elif self._delays is not None and out_key in self._sr_dur:
+                    # re-serve attempt: keep the duration the publish
+                    # actually used (incl. any injected perturbation)
+                    sdur = self._sr_dur[out_key]
                 post_t = self._sr_done[out_key]
             in_key = None
             if src is not None:
@@ -699,6 +830,8 @@ class SimuEngine:
                 in_key = (src, rank, rtag, seq)
                 if in_key not in self._recv_posts:
                     self._recv_posts[in_key] = self.clock[rank]
+                    if self._rec is not None:
+                        self._rec.on_recv_post(in_key, rank)
                     self._publish(("recvpost", in_key))
                 if in_key not in self._sends:
                     if fault is not None and self._dead[src]:
@@ -710,8 +843,14 @@ class SimuEngine:
                         self._recv_seq[(rank, src, rtag)] = seq + 1
                         if out_key is not None:
                             self._sr_done.pop(out_key, None)
+                            self._sr_dur.pop(out_key, None)
                         end = max(self.clock[rank], self._death_at[src])
                         if end > self.clock[rank]:
+                            if self._rec is not None:
+                                self._rec.on_fault_span(
+                                    rank, f"abort_{name}",
+                                    self.clock[rank], end,
+                                )
                             self._emit(
                                 TraceEvent(rank, lane, f"abort_{name}",
                                            self.clock[rank], end,
@@ -736,8 +875,14 @@ class SimuEngine:
                         # peer died before posting the matching recv:
                         # the sender aborts the rendezvous
                         self._sr_done.pop(out_key, None)
+                        self._sr_dur.pop(out_key, None)
                         end = max(self.clock[rank], self._death_at[dst])
                         if end > self.clock[rank]:
+                            if self._rec is not None:
+                                self._rec.on_fault_span(
+                                    rank, f"abort_{name}",
+                                    self.clock[rank], end,
+                                )
                             self._emit(
                                 TraceEvent(rank, lane, f"abort_{name}",
                                            self.clock[rank], end,
@@ -768,7 +913,16 @@ class SimuEngine:
                     send_end = self._sr_done[out_key] + sdur
                 end = max(end, send_end)
                 del self._sr_done[out_key]
-            if end > self.clock[rank]:
+                self._sr_dur.pop(out_key, None)
+            emitted = end > self.clock[rank]
+            if emitted:
+                end += self._delay(rank)
+            if self._rec is not None:
+                self._rec.on_sendrecv_serve(
+                    rank, f"wait_{name}", self.clock[rank], end,
+                    in_key, out_key, emitted,
+                )
+            if emitted:
                 self._emit(
                     TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
                                end, kind="wait")
@@ -798,21 +952,29 @@ class SimuEngine:
             *dead_times,
         )
         dur = rv.duration
+        extra = 0.0
         if self._fault is not None:
             dur *= self._fault.comm_scale(stream, pset, start)
+            extra = dur - rv.duration
         end = start + dur
         self._async_chain[chain_key] = end
         for peer in pset:
             if self._fault is not None and self._dead[peer]:
                 self._async_pending[peer].discard(ckey)
                 continue
-            self.comm_done[peer] = max(self.comm_done[peer], end)
+            pend = end + self._delay(peer)
+            self.comm_done[peer] = max(self.comm_done[peer], pend)
             self._async_pending[peer].discard(ckey)
             if not self._async_pending[peer]:
                 self._publish(("async", peer))
+            if self._rec is not None:
+                self._rec.on_async_finish_peer(ckey, chain_key, name,
+                                               start, pend, peer, extra)
             self._emit(
-                TraceEvent(peer, "comm", name, start, end, kind="comm")
+                TraceEvent(peer, "comm", name, start, pend, kind="comm")
             )
+        if self._rec is not None:
+            self._rec.on_async_done(ckey)
         del self._async_rv[ckey]
 
     # -- diagnostics (reference ``base_struct.py:1415-1474``) --------------

@@ -296,16 +296,22 @@ class FaultScenario:
 # --------------------------------------------------------------------------
 
 
-def _key_dim(key) -> Optional[str]:
+def key_dim(key) -> Optional[str]:
     """Collective dim of an engine rendezvous key. Keys are either
     ``(dim, group)`` tuples (leaf collectives), strings like
     ``"grad_rs:dp_cp"`` / ``"param_ag:edp"`` (bucketed DP streams and
-    their async-stream names), or ``"optimizer_barrier"``."""
+    their async-stream names), or ``"optimizer_barrier"``. Shared with
+    the critical-path engine (``observe/critpath.py``), which blames
+    exposed rendezvous time onto the same dims the fault model scales."""
     if isinstance(key, tuple):
         key = key[0]
     if not isinstance(key, str):
         return None
     return key.rsplit(":", 1)[-1] if ":" in key else key
+
+
+#: backwards-compatible private alias (pre-critpath internal name)
+_key_dim = key_dim
 
 
 class StepFaultModel:

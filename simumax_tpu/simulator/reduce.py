@@ -63,6 +63,13 @@ class ReductionPlan:
 
     @property
     def reps(self) -> List[int]:
+        """Representative global rank per engine (class) rank — each
+        class's smallest member. Critical-path expansion maps path
+        nodes through this list (``observe/critpath.py``): binding
+        ties break toward smaller ranks in both the reduced and the
+        exact engine, and every representative is its class's minimum,
+        so the reduced path expands bit-identically to the exact
+        full-world path."""
         return [members[0] for members in self.classes]
 
     @property

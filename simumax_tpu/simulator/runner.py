@@ -82,6 +82,9 @@ def run_simulation(
     reduce="auto",
     stream_trace: bool = False,
     faults=None,
+    critical_path: bool = False,
+    progress_every: int = 200_000,
+    event_delays: Optional[dict] = None,
 ) -> dict:
     """Discrete-event replay of one training iteration. ``perf`` must
     have completed ``run_estimate()``.
@@ -119,7 +122,29 @@ def run_simulation(
     bit-identical to no scenario at all. The result then carries a
     structured ``"faults"`` outcome block — a rank death degrades
     gracefully (partners resolve via the fault model) instead of
-    deadlocking."""
+    deadlocking.
+
+    ``critical_path=True`` records the event-dependency skeleton during
+    the run and attaches a ``"critical_path"`` report
+    (``observe/critpath.py``): per-event slack, the cross-rank critical
+    path, a simulated waterfall whose buckets sum to ``end_time``
+    within 1e-6, sim-vs-analytical ``divergence``, and per-rank /
+    per-link slack-headroom summaries. Recording is observational —
+    on vs off makespans are bit-identical. With ``save_path`` the
+    report lands in ``critpath.json`` and (batch-trace mode) the Chrome
+    trace gains ``on_critical_path`` / ``slack_us`` args; under
+    ``stream_trace`` only the bounded skeleton is retained, so the
+    streamed trace is not annotated (the report still is).
+
+    ``progress_every`` emits a debug-level Reporter heartbeat every N
+    served engine events (events/s, virtual clock, blocked-rank count)
+    so pod-scale runs are observable mid-flight; 0 disables. Default
+    output is byte-identical (debug lines are suppressed at the
+    default log level).
+
+    ``event_delays`` ({(engine rank, per-rank emit index): extra
+    seconds}) perturbs single events at service time — the
+    slack-correctness test hook."""
     from simumax_tpu.core.errors import ConfigError
 
     if not perf.chunks:
@@ -172,6 +197,44 @@ def run_simulation(
                 "stream_trace=True needs save_path to stream to; ignored",
             )
 
+    rec = None
+    if critical_path:
+        from simumax_tpu.observe.critpath import DependencySkeleton
+
+        rec = DependencySkeleton()
+    progress = None
+    if progress_every:
+        from simumax_tpu.observe.report import LEVELS, get_reporter
+
+        _rep = get_reporter()
+        if _rep.threshold > LEVELS["debug"]:
+            # heartbeat lines would be dropped by the reporter anyway:
+            # don't add per-served-event counter work to the engine's
+            # hottest loop for output nobody sees
+            progress_every = 0
+        else:
+            def progress(served, events, clock_s, blocked_ranks,
+                         elapsed_s):
+                # rate in emitted trace events/s — the same unit as
+                # num_events and bench_simulate's events/s metric (a
+                # served request emits 0-2 trace events)
+                rate = events / elapsed_s if elapsed_s else 0.0
+                _rep.debug(
+                    f"[simulate] {events} events emitted "
+                    f"({rate:,.0f} ev/s), clock "
+                    f"{clock_s * 1e3:.1f} ms, {blocked_ranks} ranks "
+                    f"blocked",
+                    event="sim_progress", served=served, events=events,
+                    clock_ms=clock_s * 1e3,
+                    blocked_ranks=blocked_ranks, events_per_sec=rate,
+                )
+
+    engine_kw = dict(
+        dep_recorder=rec,
+        event_delays=event_delays,
+        progress=progress,
+        progress_every=progress_every,
+    )
     plan = None
     trackers = []
     fault_model = None
@@ -205,7 +268,7 @@ def run_simulation(
         if plan is not None:
             k = plan.n_classes
             engine = SimuEngine(k, event_sink=sink,
-                                fault_model=fault_model)
+                                fault_model=fault_model, **engine_kw)
             barrier = list(range(k))
             for i in range(k):
                 groups = {
@@ -230,7 +293,7 @@ def run_simulation(
 
             memberships = _world_memberships(st)
             engine = SimuEngine(n, event_sink=sink,
-                                fault_model=fault_model)
+                                fault_model=fault_model, **engine_kw)
             for r in range(n):
                 stage = rank_coords(r, st)["pp"]
                 proc = StageProcess(
@@ -247,7 +310,7 @@ def run_simulation(
                 )
                 engine.add_rank(r, proc.process())
     else:
-        engine = SimuEngine(pp, event_sink=sink)
+        engine = SimuEngine(pp, event_sink=sink, **engine_kw)
         for s in range(pp):
             static = sum(
                 c.param_info.total_bytes for c in perf.stage_chunks(s)
@@ -274,6 +337,7 @@ def run_simulation(
     # machine-variance inflation, same as the analytical path
     # (perf-vs-simulator agreement must survive the straggler model)
     ratio = perf.straggler_ratio()
+    raw_end = end_time
     end_time *= ratio
 
     if plan is not None:
@@ -328,6 +392,53 @@ def run_simulation(
             "engine_events": engine.num_events,
             "max_class_size": max(plan.weights),
         }
+    annotations = None
+    if rec is not None:
+        from simumax_tpu.observe.critpath import analyze, diverge
+
+        if plan is not None:
+            rank_map = plan.reps
+            weights = plan.weights
+            stages = plan.stages
+
+            def stage_of(r):
+                return stages[r]
+        elif world_ranks:
+            from simumax_tpu.parallel.mesh import rank_coords
+
+            world_stages = [
+                rank_coords(r, st)["pp"] for r in range(st.world_size)
+            ]
+            rank_map = weights = None
+
+            def stage_of(r):
+                return world_stages[r]
+        else:
+            rank_map = weights = None
+
+            def stage_of(r):
+                return r  # merged mode: one engine rank per pp stage
+        report, annotations = analyze(
+            rec, raw_end, straggle_ratio=ratio, rank_map=rank_map,
+            weights=weights, stage_of=stage_of,
+            # share the analytical anchor stage so the two waterfalls'
+            # compute-vs-bubble split diverges only on model drift
+            ref_stage=perf.analysis_cost()["binding_stage_rs"],
+            meta={
+                "model": perf.model_config.model_name,
+                "system": perf.system.sys_name,
+                "world_size": st.world_size,
+                "mode": ("reduced" if plan is not None
+                         else "world" if world_ranks else "merged"),
+                "granularity": granularity,
+                "faulted": fault_model is not None,
+            },
+        )
+        # top=32 matches the slack-sample depth so the CLI's --top can
+        # go deeper than diverge()'s display default without the saved
+        # report silently capping the op table
+        report["divergence"] = diverge(perf, report, top=32)
+        result["critical_path"] = report
     if do_memory:
         result["memory"] = [t.summary() for t in trackers]
         for t in trackers:
@@ -339,12 +450,23 @@ def run_simulation(
         os.makedirs(save_path, exist_ok=True)
         trace_path = os.path.join(save_path, "trace.json")
         if sink is not None:
+            # streamed events already left the process: the trace stays
+            # un-annotated (the critpath report still lands below —
+            # only the bounded skeleton was retained)
             sink.close(trackers if do_memory else None)
         else:
             write_chrome_trace(
-                trace_path, engine.events, trackers if do_memory else None
+                trace_path, engine.events, trackers if do_memory else None,
+                annotations=annotations,
             )
         result["trace_path"] = trace_path
+        if rec is not None:
+            from simumax_tpu.observe.critpath import save_report
+
+            result["critical_path_path"] = save_report(
+                result["critical_path"],
+                os.path.join(save_path, "critpath.json"),
+            )
         if do_memory:
             snaps = [t.snapshot() for t in trackers]
             with open(

@@ -32,6 +32,18 @@ def _leaf_calls(leaf, phase: str, point: str):
 class StageProcess:
     """Builds the generator coroutine for one PP stage."""
 
+    #: model-equivalence pin (docs/simulation.md "Blocking-send
+    #: model"): when True, non-interleaved blocking 1F1B issues its
+    #: steady-state sends as true Megatron batched isend/irecv pairs
+    #: (engine ``sendrecv``, the send batched with the next op's recv
+    #: — ``send_forward_recv_backward`` semantics) instead of the
+    #: default async-send + sender transfer-stall approximation. On a
+    #: symmetric schedule the two are timing-identical; the regression
+    #: test ``tests/test_critpath.py::TestSteadyStateSendrecvParity``
+    #: pins that equivalence across the blocking parity grid, which is
+    #: why the lean default model is sound.
+    _steady_sendrecv = False
+
     def __init__(
         self,
         perf,
@@ -511,17 +523,59 @@ class StageProcess:
         # blocking-pipeline send semantics: warmup forward sends and
         # cooldown backward sends have a peer in a recv-only phase, so a
         # true rendezvous (send_sync) is cycle-free there; steady-state
-        # sends are issued as Megatron batched isend/irecv pairs, whose
-        # symmetric-schedule effect equals async-send + a sender stall
-        # of the transfer time (see TODO analysis, commit 03ecd04).
+        # sends use the async-send + sender transfer-stall
+        # approximation, which is timing-identical to Megatron's real
+        # batched isend/irecv pairs on a symmetric schedule — pinned by
+        # the ``_steady_sendrecv`` variant below + the parity
+        # regression test (docs/simulation.md "Blocking-send model";
+        # unfused blocking sends would deadlock the warmup ring, which
+        # is exactly why Megatron fuses them).
         warmup = pp - 1 - stage
-        for kind, mb in one_f_one_b_order(pp, stage, mbc):
+        order = list(one_f_one_b_order(pp, stage, mbc))
+
+        def recv_spec(op):
+            """(peer, tag, name, lane) of one schedule op's inbound
+            p2p, or None (boundary stages)."""
+            kind, mb = op
+            if kind == "F":
+                if stage == 0:
+                    return None
+                return (self._neighbor(stage - 1), f"fwd{mb}",
+                        f"recv_fwd{mb}", "pp_fwd")
+            if stage == pp - 1:
+                return None
+            return (self._neighbor(stage + 1), f"bwd{mb}",
+                    f"recv_bwd{mb}", "pp_bwd")
+
+        def steady_send(dst, tag, name, lane, i):
+            """Steady-state blocking send: batched with the next op's
+            recv when ``_steady_sendrecv`` (true Megatron pairing),
+            else async publish + sender transfer stall."""
+            if self._steady_sendrecv:
+                nxt = recv_spec(order[i + 1]) if i + 1 < len(order) else None
+                if nxt is not None:
+                    t = yield ("sendrecv", dst, tag, self.p2p_time,
+                               nxt[0], nxt[1], f"{name}+{nxt[2]}", lane)
+                    clock[0] = t
+                    return True
+                t = yield ("sendrecv", dst, tag, self.p2p_time,
+                           None, None, name, lane)
+                clock[0] = t
+                return False
+            t = yield ("send", dst, tag, self.p2p_time, name, lane)
+            clock[0] = t
+            yield ("advance", clock[0] + self.p2p_time)
+            return False
+
+        recv_batched = False  # next op's input already received by a pair
+        for i, (kind, mb) in enumerate(order):
             if kind == "F":
                 f_seen += 1
-                if stage > 0:
+                if stage > 0 and not recv_batched:
                     t = yield ("recv", self._neighbor(stage - 1), f"fwd{mb}",
                                f"recv_fwd{mb}", "pp_fwd")
                     clock[0] = t
+                recv_batched = False
                 yield from self._fwd(mb, clock)
                 if ag_join_pending:
                     # params must be resident once the first microbatch's
@@ -530,37 +584,50 @@ class StageProcess:
                     clock[0] = t
                     ag_join_pending = False
                 if stage < pp - 1:
-                    sync = not st.pp_comm_async and f_seen <= warmup
-                    t = yield (
-                        "send_sync" if sync else "send",
-                        self._neighbor(stage + 1), f"fwd{mb}",
-                        self.p2p_time, f"send_fwd{mb}", "pp_fwd",
-                    )
-                    clock[0] = t
-                    if not st.pp_comm_async and not sync:
-                        yield ("advance", clock[0] + self.p2p_time)
+                    if st.pp_comm_async:
+                        t = yield ("send", self._neighbor(stage + 1),
+                                   f"fwd{mb}", self.p2p_time,
+                                   f"send_fwd{mb}", "pp_fwd")
+                        clock[0] = t
+                    elif f_seen <= warmup:
+                        t = yield ("send_sync", self._neighbor(stage + 1),
+                                   f"fwd{mb}", self.p2p_time,
+                                   f"send_fwd{mb}", "pp_fwd")
+                        clock[0] = t
+                    else:
+                        recv_batched = yield from steady_send(
+                            self._neighbor(stage + 1), f"fwd{mb}",
+                            f"send_fwd{mb}", "pp_fwd", i,
+                        )
             else:
                 b_seen += 1
                 if st.overlap_grad_reduce and (
                     st.zero_state == 2 or b_seen == mbc
                 ):
                     self._begin_rs_window()
-                if stage < pp - 1:
+                if stage < pp - 1 and not recv_batched:
                     t = yield ("recv", self._neighbor(stage + 1), f"bwd{mb}",
                                f"recv_bwd{mb}", "pp_bwd")
                     clock[0] = t
+                recv_batched = False
                 yield from self._bwd(mb, clock)
                 yield from self._flush_rs_window()
                 if stage > 0:
-                    sync = not st.pp_comm_async and b_seen > mbc - warmup
-                    t = yield (
-                        "send_sync" if sync else "send",
-                        self._neighbor(stage - 1), f"bwd{mb}",
-                        self.p2p_time, f"send_bwd{mb}", "pp_bwd",
-                    )
-                    clock[0] = t
-                    if not st.pp_comm_async and not sync:
-                        yield ("advance", clock[0] + self.p2p_time)
+                    if st.pp_comm_async:
+                        t = yield ("send", self._neighbor(stage - 1),
+                                   f"bwd{mb}", self.p2p_time,
+                                   f"send_bwd{mb}", "pp_bwd")
+                        clock[0] = t
+                    elif b_seen > mbc - warmup:
+                        t = yield ("send_sync", self._neighbor(stage - 1),
+                                   f"bwd{mb}", self.p2p_time,
+                                   f"send_bwd{mb}", "pp_bwd")
+                        clock[0] = t
+                    else:
+                        recv_batched = yield from steady_send(
+                            self._neighbor(stage - 1), f"bwd{mb}",
+                            f"send_bwd{mb}", "pp_bwd", i,
+                        )
         yield from self._optimizer(clock)
 
     def _process_interleaved(self) -> Generator:

@@ -118,7 +118,13 @@ def to_chrome_trace(
     events: List[TraceEvent],
     trackers: Optional[List[SimuMemoryTracker]] = None,
     max_counter_samples: int = 4000,
+    annotations: Optional[dict] = None,
 ) -> dict:
+    """``annotations`` maps ``(rank, per-rank emission index) ->
+    (slack_seconds, on_critical_path)`` (the critical-path post-pass,
+    ``observe/critpath.py``): matching X events gain ``slack_us`` /
+    ``on_critical_path`` args. The events list is in engine emission
+    order, so the per-rank index is reconstructed while converting."""
     out = []
     # a flow arrow needs both ends: a send whose recv never waited (data
     # already arrived -> no wait event) must not emit a dangling `s`
@@ -132,8 +138,21 @@ def to_chrome_trace(
     ranks.update(tr.rank for tr in trackers or [] if tr.timeline)
     for rank in sorted(ranks):
         out.extend(_meta_dicts(rank))
+    emit_idx: dict = {}
     for e in events:
-        out.append(_x_dict(e))
+        d = _x_dict(e)
+        if annotations is not None:
+            idx = emit_idx.get(e.rank, 0)
+            emit_idx[e.rank] = idx + 1
+            ann = annotations.get((e.rank, idx))
+            if ann is not None:
+                slack, on_path = ann
+                d["args"]["on_critical_path"] = bool(on_path)
+                if slack == float("inf"):
+                    d["args"]["slack_us"] = None
+                else:
+                    d["args"]["slack_us"] = round(slack * 1e6, 3)
+        out.append(d)
         if e.flow_id in paired_flows and e.kind == "p2p":
             out.append(
                 _flow_start_dict(e.flow_id, e.rank, _event_tid(e),
@@ -146,9 +165,10 @@ def to_chrome_trace(
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
-def write_chrome_trace(path: str, events, trackers=None):
+def write_chrome_trace(path: str, events, trackers=None, annotations=None):
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(events, trackers), f)
+        json.dump(to_chrome_trace(events, trackers,
+                                  annotations=annotations), f)
     return path
 
 

@@ -1,0 +1,487 @@
+"""Critical-path engine tests (ISSUE 7): the simulated waterfall's
+sum-to-makespan contract, recorder-on == recorder-off bit-identity,
+reduced-graph path expansion parity, the slack-correctness property
+(perturb-and-replay through the engine's ``event_delays`` hook), the
+DES progress heartbeat, fault-path flow-arrow pairing, and the pinned
+steady-state batched-isend/irecv == async-send + sender-stall
+equivalence (the ``schedule.py`` blocking-send model)."""
+
+import io
+import json
+import os
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import get_model_config, get_strategy_config
+from simumax_tpu.observe.report import configure_reporter
+from simumax_tpu.simulator.faults import FaultEvent, FaultScenario
+
+from tests.test_trace_validity import check_chrome_trace
+
+
+def run(strategy, model="llama3-8b", system="tpu_v5e_256", layers=None,
+        **overrides):
+    p = PerfLLM()
+    st = (get_strategy_config(strategy) if isinstance(strategy, str)
+          else strategy)
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    m = get_model_config(model) if isinstance(model, str) else model
+    if layers:
+        m.layer_num = layers
+    p.configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+def checked(p, **kw):
+    """Simulate with and without the recorder: makespans bit-identical,
+    waterfall buckets sum to the reported end_time within 1e-6."""
+    base = p.simulate(None, track_memory=False, **kw)
+    r = p.simulate(None, track_memory=False, critical_path=True, **kw)
+    assert r["end_time"] == base["end_time"], (
+        "critical-path recording perturbed the makespan"
+    )
+    cp = r["critical_path"]
+    total = sum(cp["waterfall"]["buckets"].values())
+    assert total == pytest.approx(r["end_time"], rel=1e-6), (
+        cp["waterfall"]["buckets"], r["end_time"]
+    )
+    assert cp["waterfall"]["total"] == pytest.approx(
+        r["end_time"], rel=1e-12
+    )
+    # path segments' works are the binding-predecessor walk: they
+    # telescope to the raw engine makespan
+    assert not cp["path_truncated"]
+    path_work = sum(s["work"] for s in cp["path"])
+    assert path_work == pytest.approx(
+        r["end_time"] / r["straggle_ratio"], rel=1e-6
+    )
+    return r
+
+
+SLOW_LINK = FaultScenario(events=[
+    # constant-rate faults (whole-step windows): the max-plus model the
+    # slack property is exact under
+    FaultEvent("slowdown", start_ms=0.0, duration_ms=None, rank=1,
+               multiplier=1.4),
+    FaultEvent("link_degradation", start_ms=0.0, duration_ms=None,
+               dim="pp", multiplier=2.0),
+])
+
+
+class TestSimulatedWaterfall:
+    """Acceptance grid: buckets sum to the DES makespan within 1e-6
+    across dense/MoE/MLA x pp{1,2,4} x recompute/VPP x faults, and
+    critical-path-on vs off makespans are bit-identical."""
+
+    @pytest.mark.parametrize("strat,model,pp", [
+        ("tp2_pp1_dp4_mbs1", "llama3-8b", 1),
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 2),
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 4),
+        ("ep4_pp2_dp4_mbs1", "mixtral-8x7b", 2),
+        ("tp2_pp1_dp4_mbs1", "deepseekv2-lite", 1),
+        ("tp1_pp2_dp4_mbs1", "deepseekv2-lite", 2),
+    ])
+    def test_grid_sums_and_bit_identity(self, strat, model, pp):
+        st = get_strategy_config(strat)
+        if pp != st.pp_size:
+            st.world_size = st.world_size * pp // st.pp_size
+            st.pp_size = pp
+        p = run(st, model, layers=max(pp * 2, 4))
+        r = checked(p, granularity="chunk")
+        assert r["critical_path"]["waterfall"]["buckets"]["compute"] > 0
+
+    def test_recompute_bucket(self):
+        p = run("tp2_pp1_dp4_mbs1_full_recompute", layers=4)
+        r = checked(p, granularity="leaf")
+        assert r["critical_path"]["waterfall"]["buckets"]["recompute"] > 0
+
+    def test_vpp_interleaved(self):
+        p = run("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt")
+        checked(p, granularity="chunk")
+
+    def test_blocking_pipeline(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=8, pp_size=4, world_size=8,
+                micro_batch_num=4, pp_comm_async=False)
+        checked(p, granularity="chunk")
+
+    def test_world_leaf_collective_dims(self):
+        p = run("tp2_pp1_dp4_mbs1", layers=4)
+        r = checked(p, world_ranks=True, granularity="leaf")
+        assert r["critical_path"]["waterfall"]["buckets"]["comm:tp"] > 0
+
+    @pytest.mark.parametrize("scenario,expect_fault", [
+        (SLOW_LINK, True),
+        (FaultScenario(events=[
+            FaultEvent("rank_death", start_ms=150.0, rank=5),
+        ]), False),
+    ])
+    def test_fault_scenarios(self, scenario, expect_fault):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = checked(p, world_ranks=True, faults=scenario)
+        buckets = r["critical_path"]["waterfall"]["buckets"]
+        if expect_fault:
+            assert buckets.get("fault", 0.0) > 0
+        assert r["critical_path"]["meta"]["faulted"]
+
+    def test_straggler_bucket(self):
+        # 2 x 256-chip v5p slices: hosts > 1, so the closed-form
+        # straggler model activates (test_observability's pattern)
+        from simumax_tpu.core.config import get_system_config
+
+        system = get_system_config("tpu_v5p_256")
+        system.num_slices = 2
+        p = run("tp4_pp4_dp32_multislice_dcn", system=system, layers=4,
+                enable_straggler_model=True)
+        assert p.straggler_ratio() > 1.0
+        r = checked(p, granularity="chunk")
+        buckets = r["critical_path"]["waterfall"]["buckets"]
+        assert buckets["straggler"] == pytest.approx(
+            (r["end_time"] / r["straggle_ratio"])
+            * (r["straggle_ratio"] - 1.0), rel=1e-9,
+        )
+
+    def test_divergence_clean_config_aligns(self):
+        """On a config where DES and analytical agree, every aligned
+        bucket pair agrees too — divergence measures model drift, not
+        anchor mismatch."""
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = checked(p, granularity="leaf")
+        div = r["critical_path"]["divergence"]
+        total = div["analytical_total_ms"] or 1.0
+        for row in div["buckets"]:
+            assert abs(row["delta_ms"]) <= 1e-3 * total, row
+
+    def test_divergence_per_op_needs_leaf(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = checked(p, granularity="chunk")
+        div = r["critical_path"]["divergence"]
+        assert div["top_op_deltas"] == []
+        assert "leaf" in div["note"]
+
+
+class TestReducedPathExpansion:
+    """Acceptance: the symmetry-reduced graph's critical path expands
+    bit-identically to the exact full-world path (segments, waterfall,
+    headroom) — including under stragglers and faults."""
+
+    def _assert_parity(self, p, **kw):
+        exact = p.simulate(None, world_ranks=True, reduce=False,
+                           track_memory=False, critical_path=True,
+                           granularity="chunk", **kw)
+        red = p.simulate(None, world_ranks=True, reduce=True,
+                         track_memory=False, critical_path=True,
+                         granularity="chunk", **kw)
+        assert red["end_time"] == exact["end_time"]
+        ce, cr = exact["critical_path"], red["critical_path"]
+        assert cr["waterfall"]["buckets"] == ce["waterfall"]["buckets"]
+        assert cr["path"] == ce["path"]
+        assert cr["ref_rank"] == ce["ref_rank"]
+        assert cr["makespan_rank"] == ce["makespan_rank"]
+        return cr
+
+    @pytest.mark.parametrize("strat,model,pp", [
+        ("tp2_pp1_dp4_mbs1", "llama3-8b", 1),
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 2),
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 4),
+        ("ep4_pp2_dp4_mbs1", "mixtral-8x7b", 2),
+        ("tp1_pp2_dp4_mbs1", "deepseekv2-lite", 2),
+    ])
+    def test_parity(self, strat, model, pp):
+        st = get_strategy_config(strat)
+        if pp != st.pp_size:
+            st.world_size = st.world_size * pp // st.pp_size
+            st.pp_size = pp
+        p = run(st, model, layers=max(pp * 2, 4))
+        self._assert_parity(p)
+        self._assert_parity(p, perturbation={1: 1.25})
+
+    def test_parity_under_faults(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        self._assert_parity(p, faults=SLOW_LINK)
+
+
+class TestSlackProperty:
+    """Satellite: perturbing any zero-slack event by delta moves the
+    makespan by >= delta - eps; perturbing an event with slack s >
+    delta moves it by exactly 0. Replayed through the engine's
+    ``event_delays`` service-time hook, keyed by the (engine rank,
+    emit index) samples the report publishes."""
+
+    DELTA = 2e-3  # 2 ms — far above float noise, far below any slack
+
+    def _check(self, p, n_zero=3, n_loose=2, **kw):
+        r = p.simulate(None, track_memory=False, critical_path=True, **kw)
+        ratio = r["straggle_ratio"]
+        samples = r["critical_path"]["slack_samples"]
+        tight = [s for s in samples["tightest"] if s["slack_us"] == 0.0]
+        loose = [s for s in samples["loosest"]
+                 if s["slack_us"] * 1e-6 > 2 * self.DELTA]
+        assert tight, "no zero-slack events sampled"
+        for s in tight[:n_zero]:
+            key = (s["engine_rank"], s["emit_idx"])
+            r2 = p.simulate(None, track_memory=False,
+                            event_delays={key: self.DELTA}, **kw)
+            moved = (r2["end_time"] - r["end_time"]) / ratio
+            assert moved >= self.DELTA - 1e-9, (s, moved)
+        for s in loose[:n_loose]:
+            key = (s["engine_rank"], s["emit_idx"])
+            delta = min(self.DELTA, s["slack_us"] * 1e-6 / 2)
+            r2 = p.simulate(None, track_memory=False,
+                            event_delays={key: delta}, **kw)
+            assert r2["end_time"] == r["end_time"], (
+                s, r2["end_time"] - r["end_time"]
+            )
+
+    @pytest.mark.parametrize("strat,model,pp", [
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 2),
+        ("tp1_pp2_dp4_mbs1", "llama3-8b", 4),
+        ("ep4_pp2_dp4_mbs1", "mixtral-8x7b", 2),
+        ("tp2_pp1_dp4_mbs1", "deepseekv2-lite", 1),
+    ])
+    def test_merged(self, strat, model, pp):
+        st = get_strategy_config(strat)
+        if pp != st.pp_size:
+            st.world_size = st.world_size * pp // st.pp_size
+            st.pp_size = pp
+        p = run(st, model, layers=max(pp * 2, 4))
+        self._check(p, granularity="leaf")
+
+    def test_world_with_constant_faults(self):
+        # constant-rate windows keep the system purely max-plus, where
+        # the property is exact (a window edge could otherwise absorb
+        # or amplify a shifted op)
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        self._check(p, world_ranks=True, granularity="chunk",
+                    faults=SLOW_LINK)
+
+    def test_vpp_blocking(self):
+        p = run("tp1_pp4_vp2_sync_mbs1_mbc8_no_ckpt",
+                pp_comm_async=False)
+        self._check(p, granularity="chunk")
+
+
+class TestHeartbeat:
+    """Satellite: a debug-level progress event every N served events;
+    human output byte-identical at the default level."""
+
+    def _capture(self, p, level, **kw):
+        from simumax_tpu.observe.report import get_reporter
+
+        buf = io.StringIO()
+        configure_reporter(level=level, stream=buf)
+        try:
+            p.simulate(None, track_memory=False, **kw)
+        finally:
+            # configure(stream=None) keeps the current stream, so the
+            # lazy resolve-sys.stdout-at-emit default must be restored
+            # by hand or later CLI/capsys tests write into our buffer
+            configure_reporter(level="info")
+            get_reporter().stream = None
+        return buf.getvalue()
+
+    def test_debug_level_emits_heartbeat(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        out = self._capture(p, "debug", progress_every=500)
+        lines = [ln for ln in out.splitlines() if "[simulate]" in ln]
+        assert lines, out[:200]
+        assert "ev/s" in lines[0] and "ranks blocked" in lines[0]
+
+    def test_default_level_is_byte_identical(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        assert self._capture(p, "info", progress_every=500) == ""
+
+    def test_zero_disables(self):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        assert self._capture(p, "debug", progress_every=0) == ""
+
+
+class TestDeathFlowArrows:
+    """Satellite: a rank dying mid-rendezvous must leave no unpaired
+    s/f flow arrows in either trace writer, and the killed rank's lane
+    terminates cleanly at its death."""
+
+    def _scenario(self, p):
+        # kill rank 5 mid-step: well inside the schedule, while its
+        # peers are repeatedly in p2p/collective rendezvous with it
+        healthy = p.simulate(None, track_memory=False, world_ranks=True)
+        t = healthy["end_time_ms"] / healthy["straggle_ratio"] * 0.4
+        return FaultScenario(events=[
+            FaultEvent("rank_death", start_ms=t, rank=5),
+        ]), t
+
+    def _check_trace(self, trace, death_ms):
+        check_chrome_trace(trace)  # includes s/f pairing
+        by_pid = {}
+        for e in trace["traceEvents"]:
+            if e.get("ph") == "X":
+                by_pid.setdefault(e["pid"], []).append(e)
+        dead = by_pid[5]
+        assert any(e["name"] == "rank_death" for e in dead)
+        last = max(e["ts"] + e["dur"] for e in dead)
+        assert last <= death_ms * 1e3 + 1e-3, (
+            "killed rank's lane continues past its death"
+        )
+
+    def test_batch_writer(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        scenario, t = self._scenario(p)
+        r = p.simulate(str(tmp_path), track_memory=False,
+                       world_ranks=True, reduce=False, faults=scenario)
+        assert r["faults"]["deaths"]
+        self._check_trace(json.load(open(r["trace_path"])), t)
+
+    def test_streaming_writer(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        scenario, t = self._scenario(p)
+        r = p.simulate(str(tmp_path), track_memory=False,
+                       world_ranks=True, reduce=False, faults=scenario,
+                       stream_trace=True)
+        self._check_trace(json.load(open(r["trace_path"])), t)
+
+
+class TestSteadyStateSendrecvParity:
+    """Satellite (the pinned ``schedule.py`` TODO): on the blocking
+    1F1B grid, issuing steady-state sends as true Megatron batched
+    isend/irecv pairs is timing-IDENTICAL to the default async-send +
+    sender transfer-stall approximation — which is why the lean default
+    model is sound (docs/simulation.md "Blocking-send model"). Warmup
+    rings would deadlock with unfused blocking sends; the fused pairs
+    must also traverse them cleanly."""
+
+    @pytest.mark.parametrize("pp,mbc", [
+        (2, 1), (2, 4), (3, 2), (4, 2), (4, 8),
+    ])
+    def test_batched_equals_sender_stall(self, monkeypatch, pp, mbc):
+        from simumax_tpu.simulator.schedule import StageProcess
+
+        p = run("tp1_pp2_dp4_mbs1", layers=pp * 2, pp_size=pp,
+                world_size=2 * pp, micro_batch_num=mbc,
+                pp_comm_async=False)
+        stall = p.simulate(None, granularity="chunk",
+                           track_memory=False)["end_time"]
+        monkeypatch.setattr(StageProcess, "_steady_sendrecv", True)
+        fused = p.simulate(None, granularity="chunk",
+                           track_memory=False)["end_time"]
+        assert fused == stall  # bit-identical, not approx
+
+    def test_default_stays_stall_model(self):
+        from simumax_tpu.simulator.schedule import StageProcess
+
+        assert StageProcess._steady_sendrecv is False
+
+
+class TestArtifactsAndReport:
+    def test_save_path_artifacts(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = p.simulate(str(tmp_path), critical_path=True)
+        assert os.path.exists(r["critical_path_path"])
+        trace = json.load(open(r["trace_path"]))
+        check_chrome_trace(trace)
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        ann = [e for e in xs if "on_critical_path" in e["args"]]
+        assert len(ann) == len(xs), "every X event gets annotated"
+        assert any(e["args"]["on_critical_path"] for e in ann)
+        assert all("slack_us" in e["args"] for e in ann)
+        # zero-slack iff potentially on path: path events have 0 slack
+        for e in ann:
+            if e["args"]["on_critical_path"]:
+                assert e["args"]["slack_us"] == 0.0, e
+
+    def test_streaming_keeps_report_skips_annotation(self, tmp_path):
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = p.simulate(str(tmp_path), critical_path=True,
+                       stream_trace=True, track_memory=False)
+        assert os.path.exists(r["critical_path_path"])
+        trace = json.load(open(r["trace_path"]))
+        check_chrome_trace(trace)
+        assert not any(
+            "on_critical_path" in e.get("args", {})
+            for e in trace["traceEvents"] if e.get("ph") == "X"
+        )
+
+    def test_report_roundtrip_and_diff(self, tmp_path):
+        from simumax_tpu.observe.critpath import (
+            diff_critpath,
+            load_report,
+            save_report,
+        )
+
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        rep = p.critical_path(granularity="chunk", track_memory=False)
+        path = save_report(rep, str(tmp_path / "cp.json"))
+        loaded = load_report(path)
+        d = diff_critpath(loaded, loaded)
+        assert d["identical"]
+        with pytest.raises(ValueError, match="not a simumax"):
+            bad = tmp_path / "bad.json"
+            bad.write_text('{"schema": "other"}')
+            load_report(str(bad))
+
+    def test_headroom_math(self):
+        """A uniform slowdown of a rank inside its reported headroom
+        must not move the makespan (the bound's soundness contract)."""
+        p = run("tp1_pp2_dp4_mbs1", layers=4)
+        r = p.simulate(None, track_memory=False, critical_path=True,
+                       world_ranks=True)
+        entries = {
+            e["rank"]: e for e in
+            r["critical_path"]["per_rank_headroom"]
+        }
+        slackful = [e for e in entries.values()
+                    if (e.get("tolerates_slowdown_pct") or 0) > 0.01]
+        for e in slackful[:2]:
+            mult = 1.0 + e["tolerates_slowdown_pct"] / 100.0 * 0.5
+            r2 = p.simulate(None, track_memory=False, world_ranks=True,
+                            perturbation={e["rank"]: mult})
+            assert r2["end_time"] == pytest.approx(
+                r["end_time"], rel=1e-12
+            ), e
+
+
+class TestCli:
+    def _main(self, argv, capsys):
+        from simumax_tpu.cli import main
+
+        main(argv)
+        return capsys.readouterr().out
+
+    def test_critical_path_subcommand(self, tmp_path, capsys):
+        out = self._main([
+            "critical-path", "--model", "llama2-tiny",
+            "--strategy", "tp1_pp2_dp4_mbs1", "--system", "tpu_v5e_256",
+            "--granularity", "chunk",
+            "--json", str(tmp_path / "cp.json"),
+        ], capsys)
+        assert "simulated critical-path waterfall" in out
+        assert "= makespan" in out
+        assert "sim vs analytical" in out
+        assert os.path.exists(tmp_path / "cp.json")
+
+    def test_diff_critical_path(self, tmp_path, capsys):
+        cp = str(tmp_path / "cp.json")
+        self._main([
+            "critical-path", "--model", "llama2-tiny",
+            "--strategy", "tp1_pp2_dp4_mbs1", "--system", "tpu_v5e_256",
+            "--granularity", "chunk", "--json", cp,
+        ], capsys)
+        out = self._main(["diff", "--critical-path", cp, cp], capsys)
+        assert "identical" in out
+
+    def test_perf_simulate_critical_path(self, tmp_path, capsys):
+        out = self._main([
+            "perf", "--model", "llama2-tiny",
+            "--strategy", "tp1_pp2_dp4_mbs1", "--system", "tpu_v5e_256",
+            "--simulate", str(tmp_path), "--critical-path",
+        ], capsys)
+        assert "simulated critical-path waterfall" in out
+        assert os.path.exists(tmp_path / "critpath.json")
+
+    def test_diff_memory_and_critpath_exclusive(self, capsys):
+        from simumax_tpu.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["diff", "--memory", "--critical-path", "a", "b"])
