@@ -2,30 +2,36 @@
 engine on a fixed synthetic grid (no TPU required — the workload is the
 analytical meta-model itself).
 
-Measures the PR-2 perf stack end to end: grid enumeration + pruning
-(``search/prune.py``), per-layout build reuse (``PerfLLM.rebatch``),
-and serial vs process-pool cell evaluation (``search/executor.py``).
+Measures the sweep perf stack end to end: grid enumeration + pruning +
+dedup (``search/prune.py``), per-layout build reuse
+(``PerfLLM.rebatch``), serial vs process-pool cell evaluation
+(``search/executor.py``), and — with ``--engine batched`` — the
+vectorized cost kernel (``search/batched.py``) including its scalar
+re-verification of the top-k rows.
 
 Prints exactly ONE JSON line::
 
     {"metric": "sweep_cells_per_sec", "value": ..., "unit": "cells/s",
-     "cells": ..., "jobs": ..., "elapsed_s": ..., "pruned_cells": ...,
-     "prune_rate": ..., "serial_cells_per_sec": ..., "speedup": ...}
+     "engine": ..., "cells": ..., "jobs": ..., "elapsed_s": ...,
+     "pruned_cells": ..., "prune_rate": ..., ...}
 
 Usage::
 
-    python bench_sweep.py                 # serial baseline
+    python bench_sweep.py                 # serial scalar baseline
+    python bench_sweep.py --engine batched --grid wide
     python bench_sweep.py --jobs 4        # pool run + serial baseline
     python bench_sweep.py --grid oversubscribed   # prune-heavy grid
     python bench_sweep.py --no-prune
-    python bench_sweep.py --jobs 4 --baseline BENCH_prev.json \
-        --max-regression 0.05     # regression gate (exit 1 on breach)
+    python bench_sweep.py --engine batched --grid wide \
+        --baseline results/bench_sweep_batched_baseline.json \
+        --max-regression 0.7      # regression gate (exit 1 on breach)
 
 The sweep always runs with the cost-attribution ledger OFF (sweeps never
 collect it — ledger collection is post-hoc and opt-in, see
 ``docs/observability.md``); ``--baseline`` gates that the ledger-off
 throughput has not regressed more than ``--max-regression`` (default
-5%) against a previously saved bench JSON line.
+5%) against a previously saved bench JSON line recorded with the same
+grid/jobs/prune/engine flags.
 """
 
 import argparse
@@ -48,10 +54,20 @@ from simumax_tpu.core.config import (
 from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.search import search_best_parallel_strategy
 
+# the first sweep in a process otherwise pays the lazy observe-layer
+# imports inside the timed region — load them up front for BOTH engines
+# (module import time is not sweep throughput)
+import simumax_tpu.observe.report  # noqa: F401
+import simumax_tpu.observe.ledger  # noqa: F401
+import simumax_tpu.observe.memledger  # noqa: F401
+
 #: fixed synthetic grids — "standard" measures raw sweep throughput on
 #: a big-chip system where most cells evaluate; "oversubscribed" puts an
 #: 8B model on 16 GiB chips with replication-heavy ZeRO levels so the
-#: closed-form memory bound prunes a large share of cells up front
+#: closed-form memory bound prunes a large share of cells up front;
+#: "wide" is the batched engine's target workload — the full
+#: tp x pp x ZeRO grid whose hundreds of cells amortize the fixed
+#: scalar re-verification tail (docs/search_throughput.md)
 GRIDS = {
     "standard": dict(
         model="llama3-8b", system="tpu_v5p_256", world=64, gbs=64,
@@ -61,10 +77,15 @@ GRIDS = {
         model="llama3-8b", system="tpu_v5e_256", world=64, gbs=64,
         tp_list=(1, 2, 4, 8), pp_list=(1, 2, 4), zero_list=(0, 1, 3),
     ),
+    "wide": dict(
+        model="llama3-8b", system="tpu_v5p_256", world=64, gbs=64,
+        tp_list=(1, 2, 4, 8), pp_list=(1, 2, 4, 8),
+        zero_list=(0, 1, 2, 3),
+    ),
 }
 
 
-def run_sweep(spec, jobs, prune):
+def run_sweep(spec, jobs, prune, engine="scalar", verify_topk=None):
     model = get_model_config(spec["model"])
     system = get_system_config(spec["system"])
     base = get_strategy_config("tp1_pp1_dp8_mbs1")
@@ -76,6 +97,7 @@ def run_sweep(spec, jobs, prune):
         tp_list=spec["tp_list"], pp_list=spec["pp_list"],
         zero_list=spec["zero_list"], topk=5,
         jobs=jobs, prune=prune, diagnostics=diag,
+        engine=engine, verify_topk=verify_topk,
     )
     elapsed = time.perf_counter() - t0
     c = diag.counters
@@ -86,7 +108,13 @@ def run_sweep(spec, jobs, prune):
         "elapsed_s": elapsed,
         "cells": total,
         "pruned": pruned,
+        "deduped": int(c.get("sweep_cells_deduped", 0)),
         "evaluated": int(c.get("sweep_cells_evaluated", 0)),
+        "batched_cells": int(c.get("sweep_cells_batched", 0)),
+        "max_score_batch": int(c.get("sweep_batched_max_batch", 0)),
+        "candidates_scored": int(
+            c.get("sweep_batched_candidates_scored", 0)),
+        "verified_rows": int(c.get("sweep_rows_verified", 0)),
         # throughput counts every *dispatched* grid cell: pruning a cell
         # in O(closed-form) instead of O(model build) is the point
         "cells_per_sec": total / elapsed if elapsed > 0 else 0.0,
@@ -98,6 +126,16 @@ def main(argv=None):
     ap.add_argument("--jobs", type=int, default=1,
                     help="pool width for the measured run (1 = serial)")
     ap.add_argument("--grid", choices=sorted(GRIDS), default="standard")
+    ap.add_argument(
+        "--engine", choices=("scalar", "batched"), default="scalar",
+        help="candidate scoring engine (batched = vectorized cost "
+             "kernel + scalar re-verification of the top-k rows)",
+    )
+    ap.add_argument(
+        "--verify-topk", type=int, default=None, metavar="K",
+        help="with --engine batched: ranked rows re-verified with the "
+             "scalar oracle (default: topk = 5); recorded in the JSON",
+    )
     ap.add_argument("--no-prune", action="store_true")
     ap.add_argument(
         "--baseline", metavar="JSON",
@@ -113,7 +151,9 @@ def main(argv=None):
     spec = GRIDS[args.grid]
     prune = not args.no_prune
 
-    measured = run_sweep(spec, jobs=args.jobs, prune=prune)
+    measured = run_sweep(spec, jobs=args.jobs, prune=prune,
+                         engine=args.engine,
+                         verify_topk=args.verify_topk)
     result = {
         "metric": "sweep_cells_per_sec",
         "value": round(measured["cells_per_sec"], 2),
@@ -121,10 +161,12 @@ def main(argv=None):
         # sweeps never collect the attribution ledger; this run measures
         # the ledger-off path the --baseline gate protects
         "ledger": "off",
+        "engine": args.engine,
         "grid": args.grid,
         "cells": measured["cells"],
         "evaluated_cells": measured["evaluated"],
         "pruned_cells": measured["pruned"],
+        "deduped_cells": measured["deduped"],
         "prune_rate": round(
             measured["pruned"] / measured["cells"], 3
         ) if measured["cells"] else 0.0,
@@ -132,8 +174,21 @@ def main(argv=None):
         "prune": prune,
         "elapsed_s": round(measured["elapsed_s"], 3),
     }
+    if args.engine == "batched":
+        # the batched engine's contract: how many cells rode the
+        # kernel (vs scalar fallback), the largest candidate batch one
+        # kernel call scored, and the scalar-verified row count
+        result["batched_cells"] = measured["batched_cells"]
+        result["max_score_batch"] = measured["max_score_batch"]
+        result["candidates_scored"] = measured["candidates_scored"]
+        result["verify_topk"] = (
+            args.verify_topk if args.verify_topk is not None else 5
+        )
+        result["verified_rows"] = measured["verified_rows"]
     if args.jobs > 1:
-        serial = run_sweep(spec, jobs=1, prune=prune)
+        serial = run_sweep(spec, jobs=1, prune=prune,
+                           engine=args.engine,
+                           verify_topk=args.verify_topk)
         result["serial_cells_per_sec"] = round(serial["cells_per_sec"], 2)
         result["serial_elapsed_s"] = round(serial["elapsed_s"], 3)
         result["speedup"] = round(
@@ -166,8 +221,14 @@ def main(argv=None):
         # the gate compares like with like: a --jobs 4 baseline vs a
         # serial run (or prune on vs off) differs by 1.5-3x for reasons
         # that have nothing to do with a code regression
+        verify_resolved = (
+            (args.verify_topk if args.verify_topk is not None else 5)
+            if args.engine == "batched" else None
+        )
         for key, ours in (("grid", args.grid), ("jobs", args.jobs),
-                          ("prune", prune)):
+                          ("prune", prune),
+                          ("engine", args.engine),
+                          ("verify_topk", verify_resolved)):
             theirs = base.get(key, ours)  # older baselines: assume ours
             if theirs != ours:
                 print(json.dumps({

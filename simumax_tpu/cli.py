@@ -288,6 +288,8 @@ def _run_search(args, diag):
             jobs=jobs,
             prune=not args.no_prune,
             simulate=args.simulate_check,
+            engine=args.engine,
+            verify_topk=args.verify_topk,
         )
     counters = diag.counters
     if counters.get("sweep_cells_pruned"):
@@ -1024,6 +1026,18 @@ def main(argv=None):
              "of status=pruned CSV rows; structurally impossible "
              "layouts (divisibility) are still skipped, silently, as "
              "the sweep always has",
+    )
+    ps.add_argument(
+        "--engine", choices=("scalar", "batched"), default="scalar",
+        help="candidate scoring engine: 'scalar' walks a PerfLLM per "
+             "candidate; 'batched' scores whole candidate batches with "
+             "the vectorized cost kernel and re-verifies the top-k "
+             "rows with the scalar oracle (see docs/search.md)",
+    )
+    ps.add_argument(
+        "--verify-topk", type=int, default=None, metavar="K",
+        help="with --engine batched: how many ranked rows to re-verify "
+             "with the scalar oracle (default: --topk)",
     )
     ps.add_argument(
         "--simulate-check", action="store_true",

@@ -1276,6 +1276,21 @@ class SystemConfig(ConfigBase):
             lat_t = stage_lat(span, 1.0)
         return bw_t, lat_t
 
+    def net_op_coeffs(
+        self, op: str, path: CommPath, comm_num: Optional[int] = None
+    ) -> Tuple[float, float]:
+        """Linear-cost coefficients of a collective over ``path``:
+        ``(bw_per_byte, lat_seconds)`` such that
+        ``compute_net_op_terms(op, size, path)`` equals
+        ``(bw_per_byte * size, lat_seconds)`` up to float rounding (the
+        bandwidth term of the hierarchical ring model is proportional to
+        the tensor size; the latency term is size-independent). Side-effect free —
+        the batched sweep kernel (``search/batched.py``) lowers each
+        (dim, op) pair to these two numbers once per layout and costs
+        whole candidate batches with one multiply-add."""
+        bw_t, lat_t = self.compute_net_op_terms(op, 1.0, path, comm_num)
+        return bw_t, lat_t
+
     # ----------------------------------------------------------------------
     # Cost primitive (d): roofline combiner
     # (reference ``compute_end2end_time`` config.py:1019-1035)

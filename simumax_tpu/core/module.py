@@ -77,31 +77,36 @@ class MetaModule:
     op_category = "other"
 
     def __init__(self, ctx: BuildContext, name: str = ""):
-        self.ctx = ctx
-        self.name = name or type(self).__name__
-        self._children: List[Tuple[str, "MetaModule"]] = []
-        self.parent: Optional["MetaModule"] = None
+        # direct __dict__ writes: none of these values are MetaModules,
+        # so routing them through the child-registering __setattr__ is
+        # pure interpreter overhead — at sweep scale module construction
+        # is a measured hot path (docs/search_throughput.md)
+        d = self.__dict__
+        d["ctx"] = ctx
+        d["name"] = name or type(self).__name__
+        d["_children"] = []
+        d["parent"] = None
         # recompute wiring
-        self.recompute = False  # whole-subtree checkpoint flag
-        self.recompute_status = RecomputeStatus.NONE
-        self.in_recompute = False
+        d["recompute"] = False  # whole-subtree checkpoint flag
+        d["recompute_status"] = RecomputeStatus.NONE
+        d["in_recompute"] = False
         #: variance-tail leaf (reference ``base_struct.py:314,335-337``):
         #: last leaf of its checkpoint segment; its fwd replay is skipped
         #: under ``recompute_variance`` because its backward consumes the
         #: recomputed *input*, not its own output.
-        self.variance_tail = False
+        d["variance_tail"] = False
         # filled by __call__
-        self.inputs: Tuple[TensorSpec, ...] = ()
-        self.outputs: Tuple[TensorSpec, ...] = ()
-        self.compute_info = ComputeInfo()
-        self.act_info = ActivationInfo()
-        self.raw_act_info = ActivationInfo()
-        self.param_info = ParamInfo()
-        self.cost_info = CostInfo()
-        self.collective_calls: List[CollectiveCall] = []
-        self._called = False
-        self._pre_hooks: List[Callable] = []
-        self._post_hooks: List[Callable] = []
+        d["inputs"] = ()
+        d["outputs"] = ()
+        d["compute_info"] = ComputeInfo()
+        d["act_info"] = ActivationInfo()
+        d["raw_act_info"] = ActivationInfo()
+        d["param_info"] = ParamInfo()
+        d["cost_info"] = CostInfo()
+        d["collective_calls"] = []
+        d["_called"] = False
+        d["_pre_hooks"] = []
+        d["_post_hooks"] = []
 
     # -- structure ---------------------------------------------------------
     _NON_CHILD_ATTRS = ("parent", "recompute_segment")
@@ -193,18 +198,22 @@ class MetaModule:
         assert type(self) is type(rep) and len(self._children) == len(
             rep._children
         ), f"adopt_call_from: structure mismatch at {self.path_name()}"
-        self.inputs = tuple(i for i in ins if isinstance(i, TensorSpec))
-        self.outputs = rep.outputs
-        self.compute_info = rep.compute_info
-        self.act_info = rep.act_info
-        self.raw_act_info = rep.raw_act_info
-        self.param_info = rep.param_info
-        self.cost_info = rep.cost_info
-        self.collective_calls = rep.collective_calls
+        # direct __dict__ writes (nothing here is a child module): this
+        # adoption runs once per deduped layer and measurably bounds
+        # sweep-verification throughput
+        d = self.__dict__
+        d["inputs"] = tuple(i for i in ins if isinstance(i, TensorSpec))
+        d["outputs"] = rep.outputs
+        d["compute_info"] = rep.compute_info
+        d["act_info"] = rep.act_info
+        d["raw_act_info"] = rep.raw_act_info
+        d["param_info"] = rep.param_info
+        d["cost_info"] = rep.cost_info
+        d["collective_calls"] = rep.collective_calls
         for (_, mine), (_, theirs) in zip(self._children, rep._children):
             if theirs._called:
                 mine.adopt_call_from(theirs, *theirs.inputs)
-        self._called = True
+        d["_called"] = True
         return self.outputs if len(self.outputs) != 1 else self.outputs[0]
 
     def _post_forward(self):
@@ -465,14 +474,26 @@ class GemmBase(LeafModule):
         """Return (b, m, k, n) of the GEMM executed in ``phase``."""
         raise NotImplementedError
 
-    def gemm_shape_key(self, phase: str) -> str:
-        b, m, k, n = self.gemm_mnk(phase)
+    @staticmethod
+    def render_gemm_shape_key(b: int, m: int, k: int, n: int, phase: str,
+                              dtype: str, fp32_accum: bool) -> str:
+        """The canonical matmul efficiency-table key for one (shape,
+        phase). Static single source shared with the batched sweep
+        kernel (``search/batched.py``), so a calibrated per-shape table
+        can never be hit by one engine and missed by the other."""
         layout = {"fwd": "NN", "bwd_act": "NT", "bwd_w": "TN"}[phase]
-        acc = phase == "bwd_w" and self.ctx.strategy.use_fp32_accum_grad
-        out_dtype = "fp32" if acc else self.ctx.strategy.dtype
+        acc = phase == "bwd_w" and fp32_accum
+        out_dtype = "fp32" if acc else dtype
         return (
             f"b={b}, m={m}, k={k}, n={n}, layout={layout}, "
             f"accumulate={acc}, out_dtype={out_dtype}"
+        )
+
+    def gemm_shape_key(self, phase: str) -> str:
+        b, m, k, n = self.gemm_mnk(phase)
+        return self.render_gemm_shape_key(
+            b, m, k, n, phase, self.ctx.strategy.dtype,
+            self.ctx.strategy.use_fp32_accum_grad,
         )
 
     def comp_key(self, phase: str):

@@ -483,16 +483,25 @@ class CoreAttention(LeafModule):
         score = b * hl * sq * skv * 4.0
         return {"fwd": qo + kv + 2 * score, "bwd_act": 2 * (qo + kv) + 4 * score}
 
+    @staticmethod
+    def render_sdp_shape_key(b, sq, skv, hn, kv_hn, hd, hd_v, causal,
+                             flash, dtype, backend="xla") -> str:
+        """Canonical sdp efficiency-table key — static single source
+        shared with the batched sweep kernel (``search/batched.py``)."""
+        prefix = "" if backend == "xla" else f"backend={backend}, "
+        return (
+            f"{prefix}b={b}, sq={sq}, skv={skv}, hn={hn}, kv_hn={kv_hn}, "
+            f"hd={hd}, hd_v={hd_v}, causal={causal}, "
+            f"flash={flash}, dtype={dtype}"
+        )
+
     def comp_key(self, phase):
         st = _st(self.ctx)
         b, sq, skv, hl, d, dv = self._dims()
         kvl = self.inputs[1].shape[2]
-        causal = self._causal()
-        prefix = "" if st.sdp_backend == "xla" else f"backend={st.sdp_backend}, "
-        key = (
-            f"{prefix}b={b}, sq={sq}, skv={skv}, hn={hl}, kv_hn={kvl}, "
-            f"hd={d}, hd_v={dv}, causal={causal}, "
-            f"flash={st.use_flash_sdp}, dtype={st.dtype}"
+        key = self.render_sdp_shape_key(
+            b, sq, skv, hl, kvl, d, dv, self._causal(),
+            st.use_flash_sdp, st.dtype, backend=st.sdp_backend,
         )
         return ("sdp_fwd" if phase == "fwd" else "sdp_bwd", key)
 

@@ -235,6 +235,18 @@ class GroupLinearBase(GemmBase):
             return (self.ng, tokens, n, k)
         return (self.ng, k, tokens, n)
 
+    @staticmethod
+    def render_group_shape_key(ng, m, k, n, phase, dtype,
+                               fp32_accum) -> str:
+        """Canonical grouped-GEMM efficiency-table key — static single
+        source shared with the batched sweep kernel
+        (``search/batched.py``)."""
+        acc = phase == "bwd_w" and fp32_accum
+        return (
+            f"ng={ng}, M={m}, N={n}, K={k}, dtype={dtype}, "
+            f"stage={phase}, accumulate={acc}"
+        )
+
     def gemm_shape_key(self, phase: str):
         if self.sequential:
             # dense-matmul grammar (batch=ng) so the matmul efficiency
@@ -242,10 +254,9 @@ class GroupLinearBase(GemmBase):
             # already returns a (b, m, k, n)-compatible tuple
             return super().gemm_shape_key(phase)
         ng, m, k, n = self.gemm_mnk(phase)
-        acc = phase == "bwd_w" and self.ctx.strategy.use_fp32_accum_grad
-        return (
-            f"ng={ng}, M={m}, N={n}, K={k}, dtype={self.ctx.strategy.dtype}, "
-            f"stage={phase}, accumulate={acc}"
+        return self.render_group_shape_key(
+            ng, m, k, n, phase, self.ctx.strategy.dtype,
+            self.ctx.strategy.use_fp32_accum_grad,
         )
 
     def _tokens(self) -> int:

@@ -84,6 +84,107 @@ def interleaved_stage_peak(order, cache, peakpt):
     return peak_sched, peak_outstanding, peak_counts, peak_active
 
 
+def place_strategy_paths(strategy: StrategyConfig,
+                         system: SystemConfig) -> Dict[str, CommPath]:
+    """Mesh placement of every parallel dim for one strategy (reference
+    ``analysis_net`` perf_llm.py:369-474) — extracted to module level so
+    the batched sweep kernel (``search/batched.py``) places layouts with
+    exactly the code :meth:`PerfLLM.analysis_net` uses."""
+    st, sysc = strategy, system
+    tp, cp, dp, pp = st.tp_size, st.cp_size, st.dp_size, st.pp_size
+    ep, etp = st.ep_size, st.etp_size
+    sizes = {"tp": tp, "cp": cp, "dp": dp, "pp": pp}
+    order = st.mesh_order.split(",")
+
+    def inner(dim: str) -> int:
+        n = 1
+        for d in order:
+            if d == dim:
+                return n
+            n *= sizes[d]
+        raise KeyError(dim)
+
+    paths = {
+        d: sysc.place_group(d, inner(d), sizes[d]) for d in sizes
+    }
+    # dp_cp (ZeRO sharding + grad reduce group) = the cp and dp dims
+    # combined. With the default order they are adjacent and a single
+    # placement reproduces the round-3 anchor behavior exactly; with
+    # dp moved outermost the group is strided across pp, which the
+    # hierarchical span concatenation expresses (innermost first).
+    if st.mesh_order == "tp,cp,dp,pp":
+        paths["dp_cp"] = sysc.place_group("dp_cp", tp, cp * dp)
+    else:
+        first, second = sorted(("cp", "dp"), key=order.index)
+        combined = CommPath(dim="dp_cp", group_size=cp * dp)
+        combined.spans = list(paths[first].spans) + list(
+            paths[second].spans
+        )
+        paths["dp_cp"] = combined
+    # MoE dims: etp shares the tp placement; ep strides over etp
+    paths["etp"] = sysc.place_group("etp", 1, etp)
+    paths["ep"] = sysc.place_group("ep", etp, ep)
+    if st.mesh_order == "tp,cp,dp,pp":
+        paths["edp"] = sysc.place_group("edp", etp * ep, st.edp_size)
+    else:
+        # non-default orders are guarded to ep=etp=1, where the edp
+        # group is exactly tp x cp x dp — strided across pp when pp
+        # is not outermost. Reuse those dims' placements so expert
+        # gradients see the same DCN spans the dense dims do.
+        assert ep == 1 and etp == 1, (ep, etp)
+        combined = CommPath(dim="edp", group_size=st.edp_size)
+        for d in order:
+            if d != "pp":
+                combined.spans.extend(paths[d].spans)
+        paths["edp"] = combined
+    return paths
+
+
+def stage_layer_split(strategy: StrategyConfig,
+                      model: ModelConfig) -> List[List[int]]:
+    """counts[stage][vpp_rank] = transformer layers in that chunk
+    (reference ``get_num_layers_to_build`` perf_llm.py:539) — extracted
+    to module level for the same reason as
+    :func:`place_strategy_paths`."""
+    st, m = strategy, model
+    pp, vp = st.pp_size, st.vp_size
+    total_v = pp * vp
+    counts = [[0] * vp for _ in range(pp)]
+    layers = m.layer_num
+    eff = layers
+    if st.account_for_embedding_in_pipeline_split:
+        eff += 1
+    if st.account_for_loss_in_pipeline_split:
+        eff += 1
+    first = st.num_layers_in_first_pipeline_stage
+    last = st.num_layers_in_last_pipeline_stage
+    per_v = [0] * total_v
+    if first or last:
+        rem_v = total_v - (1 if first else 0) - (1 if last else 0)
+        rem_layers = layers - (first or 0) - (last or 0)
+        base = rem_layers // max(rem_v, 1)
+        for v in range(total_v):
+            per_v[v] = base
+        if first:
+            per_v[0] = first
+        if last:
+            per_v[-1] = last
+    else:
+        base = eff // total_v
+        for v in range(total_v):
+            per_v[v] = base
+        if st.account_for_embedding_in_pipeline_split:
+            per_v[0] -= 1
+        if st.account_for_loss_in_pipeline_split:
+            per_v[-1] -= 1
+    # virtual stage v = chunk * pp + stage (Megatron interleaving)
+    for v in range(total_v):
+        chunk, stage = divmod(v, pp)
+        counts[stage][chunk] = per_v[v]
+    assert sum(sum(c) for c in counts) == layers
+    return counts
+
+
 def _resolve(cfg, cls, getter):
     if isinstance(cfg, cls):
         return cfg
@@ -244,97 +345,14 @@ class PerfLLM(PerfBase):
     # Net placement (reference ``analysis_net`` perf_llm.py:369-474)
     # ------------------------------------------------------------------
     def analysis_net(self) -> Dict[str, object]:
-        st, sysc = self.strategy, self.system
-        tp, cp, dp, pp = st.tp_size, st.cp_size, st.dp_size, st.pp_size
-        ep, etp = st.ep_size, st.etp_size
-        sizes = {"tp": tp, "cp": cp, "dp": dp, "pp": pp}
-        order = st.mesh_order.split(",")
-
-        def inner(dim: str) -> int:
-            n = 1
-            for d in order:
-                if d == dim:
-                    return n
-                n *= sizes[d]
-            raise KeyError(dim)
-
-        paths = {
-            d: sysc.place_group(d, inner(d), sizes[d]) for d in sizes
-        }
-        # dp_cp (ZeRO sharding + grad reduce group) = the cp and dp dims
-        # combined. With the default order they are adjacent and a single
-        # placement reproduces the round-3 anchor behavior exactly; with
-        # dp moved outermost the group is strided across pp, which the
-        # hierarchical span concatenation expresses (innermost first).
-        if st.mesh_order == "tp,cp,dp,pp":
-            paths["dp_cp"] = sysc.place_group("dp_cp", tp, cp * dp)
-        else:
-            first, second = sorted(("cp", "dp"), key=order.index)
-            combined = CommPath(dim="dp_cp", group_size=cp * dp)
-            combined.spans = list(paths[first].spans) + list(
-                paths[second].spans
-            )
-            paths["dp_cp"] = combined
-        # MoE dims: etp shares the tp placement; ep strides over etp
-        paths["etp"] = sysc.place_group("etp", 1, etp)
-        paths["ep"] = sysc.place_group("ep", etp, ep)
-        if st.mesh_order == "tp,cp,dp,pp":
-            paths["edp"] = sysc.place_group("edp", etp * ep, st.edp_size)
-        else:
-            # non-default orders are guarded to ep=etp=1, where the edp
-            # group is exactly tp x cp x dp — strided across pp when pp
-            # is not outermost. Reuse those dims' placements so expert
-            # gradients see the same DCN spans the dense dims do.
-            assert ep == 1 and etp == 1, (ep, etp)
-            combined = CommPath(dim="edp", group_size=st.edp_size)
-            for d in order:
-                if d != "pp":
-                    combined.spans.extend(paths[d].spans)
-            paths["edp"] = combined
-        return paths
+        return place_strategy_paths(self.strategy, self.system)
 
     # ------------------------------------------------------------------
     # Stage chunking (reference ``get_num_layers_to_build`` perf_llm.py:539)
     # ------------------------------------------------------------------
     def stage_layer_counts(self) -> List[List[int]]:
         """Return counts[stage][vpp_rank] = number of transformer layers."""
-        st, m = self.strategy, self.model_config
-        pp, vp = st.pp_size, st.vp_size
-        total_v = pp * vp
-        counts = [[0] * vp for _ in range(pp)]
-        layers = m.layer_num
-        eff = layers
-        if st.account_for_embedding_in_pipeline_split:
-            eff += 1
-        if st.account_for_loss_in_pipeline_split:
-            eff += 1
-        first = st.num_layers_in_first_pipeline_stage
-        last = st.num_layers_in_last_pipeline_stage
-        per_v = [0] * total_v
-        if first or last:
-            rem_v = total_v - (1 if first else 0) - (1 if last else 0)
-            rem_layers = layers - (first or 0) - (last or 0)
-            base = rem_layers // max(rem_v, 1)
-            for v in range(total_v):
-                per_v[v] = base
-            if first:
-                per_v[0] = first
-            if last:
-                per_v[-1] = last
-        else:
-            base = eff // total_v
-            for v in range(total_v):
-                per_v[v] = base
-            if st.account_for_embedding_in_pipeline_split:
-                per_v[0] -= 1
-            if st.account_for_loss_in_pipeline_split:
-                per_v[-1] -= 1
-        # virtual stage v = chunk * pp + stage (Megatron interleaving)
-        for v in range(total_v):
-            chunk, stage = divmod(v, pp)
-            counts[stage][chunk] = per_v[v]
-        assert sum(sum(c) for c in counts) == layers
-        return counts
+        return stage_layer_split(self.strategy, self.model_config)
 
     def build(self):
         """Construct per-(stage, vpp_rank) model chunks

@@ -3,6 +3,10 @@ from simumax_tpu.search.executor import (  # noqa: F401
     CellOutcome,
     run_cells,
 )
+from simumax_tpu.search.batched import (  # noqa: F401
+    BatchedScorer,
+    UnsupportedBatched,
+)
 from simumax_tpu.search.prune import (  # noqa: F401
     SweepCell,
     enumerate_cells,

@@ -1,0 +1,1930 @@
+"""Batched vectorized cost kernel (L7): score whole candidate-strategy
+batches per evaluation instead of walking a Python module graph per cell.
+
+The scalar path (``PerfLLM`` build -> ``estimate()`` -> ``analysis_*``)
+re-constructs and re-walks a ``MetaModule`` tree for every candidate; at
+sweep scale that object-protocol overhead dominates (ROADMAP item 1,
+``results/bench_sweep_baseline.json``). SimuMax's static-analytical
+design makes every number the sweep ranks on pure arithmetic over
+shapes, so this module *lowers* the scalar model into numpy array
+programs whose leading axis is the candidate batch:
+
+* per-op roofline times — the leaf tables of ``models/{dense,moe,mla}``
+  (FLOPs / HBM bytes / efficiency-table keys per backprop phase)
+  re-derived in closed form, with the canonical shape keys rendered by
+  the SAME static renderers the scalar ops use
+  (``GemmBase.render_gemm_shape_key`` etc.), so calibrated per-shape
+  tables hit identically;
+* collective costs — each (dim, op) pair lowered once per layout to the
+  ``(bw_per_byte, latency)`` coefficients of
+  ``SystemConfig.net_op_coeffs`` and costed with one multiply-add per
+  candidate;
+* activation-peak replay — ``LLMModel.activation_events`` mirrored per
+  *block kind* (plain / recomputed x dense / MoE) and composed across a
+  stage's layer runs in closed form instead of walking every layer;
+* the 1F1B pipeline replay — evaluated with a lean exact re-implementation
+  of ``PerfLLM.calculate_1f1b_bubble``'s recurrence (the replay's values
+  are order-independent max/+ algebra, so the lean loop reproduces them
+  bit-for-bit).
+
+The scalar path stays the **oracle**: the sweep's ``engine="batched"``
+mode re-verifies its top-k rows with ``evaluate_strategy`` (see
+``searcher.py``), and ``tests/test_batched.py`` pins batched == scalar
+within 1e-9 for every non-pruned candidate across the
+dense/MoE/MLA x pp x recompute/ZeRO parity grid.
+
+Configurations outside the supported surface raise
+:class:`UnsupportedBatched` and the caller silently falls back to the
+scalar path per cell (documented in ``docs/search.md``).
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from simumax_tpu.core.config import (
+    GiB,
+    ModelConfig,
+    StrategyConfig,
+    SystemConfig,
+)
+from simumax_tpu.core.errors import FeasibilityError
+from simumax_tpu.core.module import GemmBase
+from simumax_tpu.models.dense import CoreAttention
+from simumax_tpu.models.moe import GroupLinearBase
+from simumax_tpu.parallel.pipeline import one_f_one_b_order
+from simumax_tpu.perf import place_strategy_paths, stage_layer_split
+from simumax_tpu.search.prune import clone_strategy
+
+
+class UnsupportedBatched(Exception):
+    """The batched kernel does not model this configuration; the caller
+    falls back to the scalar oracle for the cell."""
+
+
+# --------------------------------------------------------------------------
+# Support surface
+# --------------------------------------------------------------------------
+
+
+def check_supported(st: StrategyConfig, model: ModelConfig,
+                    system: SystemConfig) -> None:
+    """Raise :class:`UnsupportedBatched` for strategy/model features the
+    kernel does not lower. The list is the documented fallback contract
+    (docs/search.md): anything here silently uses the scalar path."""
+    rc = st.recompute
+
+    def need(cond: bool, what: str):
+        if not cond:
+            raise UnsupportedBatched(what)
+
+    need(st.vp_size == 1, "interleaved pipeline (vp > 1)")
+    need(st.cp_size == 1, "context parallelism (cp > 1)")
+    need(not st.fp8, "quantized matmul path (fp8)")
+    need(not st.enable_dropout, "dropout modeling")
+    need(st.sdp_backend == "xla", "non-xla sdp backend")
+    need(not st.overlap_grad_reduce and not st.overlap_param_gather,
+         "grad-reduce/param-gather overlap modeling")
+    need(not st.dispatch_probs, "dispatch_probs combine fusion")
+    need(not st.offload_groupgemm_col_inputs,
+         "groupgemm input host offload")
+    need(not rc.moe_act_recompute and not rc.mla_up_proj_recompute,
+         "moe_act/mla_up_proj module recompute")
+    need(not rc.variance and not rc.tail_modules,
+         "recompute variance-tail model")
+    need(rc.granularity in ("none", "selective", "full_block"),
+         f"recompute granularity {rc.granularity!r}")
+    need(model.model_type in ("dense", "moe"), model.model_type)
+    need(model.attention_type in ("gqa", "mla"), model.attention_type)
+    # shapes the scalar walk would reject with an AssertionError
+    # (quarantined cell): fall back so both engines quarantine alike
+    if model.use_swiglu:
+        tp = st.tp_size
+        fan = 2 * model.intermediate_size
+        has_dense_mlp = model.model_type == "dense" or \
+            model.dense_layer_num > 0
+        need(not has_dense_mlp or (fan // tp) % 2 == 0,
+             "swiglu fan not splittable under tp")
+        if model.model_type == "moe":
+            efan = 2 * model.moe_ffn_hidden_size
+            need((efan // max(1, st.etp_size)) % 2 == 0,
+                 "moe swiglu fan not splittable under etp")
+            if model.moe_shared_expert_intermediate_size:
+                sfan = 2 * model.moe_shared_expert_intermediate_size
+                need((sfan // tp) % 2 == 0,
+                     "shared-expert swiglu fan not splittable under tp")
+
+
+# --------------------------------------------------------------------------
+# Family validity: the ConfigError surface of configure()+sanity checks
+# --------------------------------------------------------------------------
+
+
+def _family_invalid_reason(st: StrategyConfig, model: ModelConfig,
+                           system: SystemConfig) -> Optional[str]:
+    """Mirror of the candidate-dependent ``ConfigError`` guards a scalar
+    ``evaluate_strategy`` hits (strategy ``sanity_check`` + PerfBase
+    ``_cross_sanity_check``): a non-None reason means every batch split
+    of this family evaluates to ``row = None`` in the scalar path."""
+    m = model
+    if st.world_size <= 0:
+        return "world_size"
+    if st.world_size % (st.tp_size * st.cp_size * st.pp_size):
+        return "world % tp*cp*pp"
+    if st.dp_size < 1:
+        return "dp < 1"
+    if st.world_size % (st.etp_size * st.ep_size * st.pp_size):
+        return "world % etp*ep*pp"
+    if st.etp_size > st.tp_size or st.tp_size % st.etp_size:
+        return "etp vs tp"
+    if st.enable_sequence_parallel and \
+            st.seq_len % (st.tp_size * st.cp_size):
+        return "seq % tp*cp"
+    if st.world_size > system.total_chips:
+        return "world > chips"
+    head_shard = st.tp_size
+    if m.head_num % head_shard:
+        return "head_num % tp"
+    if m.model_type == "moe" and m.expert_num % st.ep_size:
+        return "expert_num % ep"
+    # layer split over virtual stages (PerfBase._cross_sanity_check)
+    total_stages = st.pp_size * st.vp_size
+    layers = m.layer_num
+    if st.num_layers_in_first_pipeline_stage:
+        layers -= st.num_layers_in_first_pipeline_stage
+    if st.num_layers_in_last_pipeline_stage:
+        layers -= st.num_layers_in_last_pipeline_stage
+    rem = total_stages
+    if st.num_layers_in_first_pipeline_stage:
+        rem -= 1
+    if st.num_layers_in_last_pipeline_stage:
+        rem -= 1
+    eff = layers + (
+        1 if st.account_for_embedding_in_pipeline_split else 0
+    ) + (1 if st.account_for_loss_in_pipeline_split else 0)
+    if eff % max(rem, 1):
+        return "layer split"
+    return None
+
+
+# --------------------------------------------------------------------------
+# Lean exact 1F1B replay
+# --------------------------------------------------------------------------
+
+_ORDER_CACHE: Dict[Tuple[int, int], list] = {}
+
+
+def _flat_1f1b_order(pp: int, mbc: int) -> list:
+    """One dependency-consistent flat op order for the non-interleaved
+    1F1B replay, computed once per (pp, mbc) and cached. Readiness in
+    the replay's retry loop is structural (an op waits only for another
+    op to have been *processed*), never time-based, so a single valid
+    topological order serves every (fwd, bwd, p2p) instance."""
+    key = (pp, mbc)
+    flat = _ORDER_CACHE.get(key)
+    if flat is not None:
+        return flat
+    orders = [one_f_one_b_order(pp, s, mbc) for s in range(pp)]
+    done = [[[False] * mbc, [False] * mbc] for _ in range(pp)]
+    idx = [0] * pp
+    flat = []
+    remaining = 2 * pp * mbc
+    while remaining:
+        progressed = False
+        for s in range(pp):
+            o = orders[s]
+            while idx[s] < len(o):
+                kind, i = o[idx[s]]
+                if kind == "F":
+                    if s > 0 and not done[s - 1][0][i]:
+                        break
+                    done[s][0][i] = True
+                    flat.append((s, 0, i))
+                else:
+                    if s < pp - 1 and not done[s + 1][1][i]:
+                        break
+                    done[s][1][i] = True
+                    flat.append((s, 1, i))
+                idx[s] += 1
+                remaining -= 1
+                progressed = True
+        assert progressed, "1F1B schedule deadlocked (internal error)"
+    if len(_ORDER_CACHE) > 64:
+        _ORDER_CACHE.clear()
+    _ORDER_CACHE[key] = flat
+    return flat
+
+
+def fold_1f1b(pp: int, mbc: int, fwd: Sequence[float],
+              bwd: Sequence[float], p2p: float,
+              p2p_async: bool) -> Tuple[float, List[float]]:
+    """Exact lean re-implementation of the non-interleaved replay in
+    ``PerfLLM.calculate_1f1b_bubble`` (pp > 1): returns
+    ``(total, per_stage_end)``. The replay's values are the unique
+    solution of a max-plus recurrence, so evaluation order does not
+    matter; this single pass over a cached topological op order
+    reproduces the scalar numbers bit-for-bit (property-tested in
+    ``tests/test_batched.py``)."""
+    flat = _flat_1f1b_order(pp, mbc)
+    F = [[0.0] * mbc for _ in range(pp)]
+    B = [[0.0] * mbc for _ in range(pp)]
+    clock = [0.0] * pp
+    blocking = 0.0 if p2p_async else p2p
+    last = pp - 1
+    for s, kind, i in flat:
+        c = clock[s]
+        if kind == 0:
+            if s == 0:
+                start = c
+            else:
+                dep = F[s - 1][i] + p2p
+                start = c if c >= dep else dep
+            end = start + fwd[s]
+            F[s][i] = end
+            if s < last:
+                end += blocking
+        else:
+            if s == last:
+                start = c
+            else:
+                dep = B[s + 1][i] + p2p
+                start = c if c >= dep else dep
+            end = start + bwd[s]
+            B[s][i] = end
+            if s > 0:
+                end += blocking
+        clock[s] = end
+    return max(clock), clock
+
+
+# --------------------------------------------------------------------------
+# Leaf records
+# --------------------------------------------------------------------------
+
+
+class _Leaf:
+    """One leaf op of a block kind, quantities as (ncand,) arrays."""
+
+    __slots__ = (
+        "name", "flops", "accessed", "op_key", "key_fn", "bw_key",
+        "cache_raw", "cache_eff", "fwd_temp", "bwd_temp", "in_b", "out_b",
+        "numel", "moe", "coll", "rc", "seg", "variance_tail",
+        "cost_fwd", "cost_bwd_act", "cost_bwd_w",
+        "net_fwd", "net_bwd_act", "net_bwd_w", "fsdp",
+    )
+
+    def __init__(self, name):
+        self.name = name
+        self.flops = {}      # phase -> array
+        self.accessed = {}   # phase -> array
+        self.op_key = {}     # phase -> str
+        self.key_fn = {}     # phase -> callable(i) -> str, or absent
+        self.bw_key = {}     # phase -> str (default "default")
+        self.cache_raw = 0.0
+        self.cache_eff = None  # filled by wiring
+        self.fwd_temp = 0.0
+        self.bwd_temp = 0.0
+        self.in_b = 0.0
+        self.out_b = 0.0
+        self.numel = 0.0
+        self.moe = False
+        #: [(phase, op, dim, size_array, exposed, is_fsdp)]
+        self.coll = []
+        self.rc = False
+        self.seg = None
+        self.variance_tail = False
+
+
+class _Kernel:
+    """The lowered cost program of one strategy *family* — every
+    strategy field fixed except the batch split ``(mbs, mbc)`` and (for
+    full-block recompute) the recompute layer count. ``score`` evaluates
+    a whole candidate batch in one call.
+
+    ``shared_cache`` (provided by :class:`BatchedScorer`) memoizes
+    block-kind profiles across families: a block's leaf tables depend on
+    the intra-layer sharding (tp/ep/etp), the recompute wiring, and —
+    only at ZeRO >= 2 — the data-parallel group sizes, but never on
+    ``pp`` or the batch counts, so sibling layouts of one sweep reuse
+    them wholesale."""
+
+    def __init__(self, st: StrategyConfig, model: ModelConfig,
+                 system: SystemConfig, shared_cache: Optional[dict] = None):
+        check_supported(st, model, system)
+        self.st = st
+        self.system = system
+        self.invalid = _family_invalid_reason(st, model, system)
+        self.model = copy.copy(model)
+        self._shared = shared_cache if shared_cache is not None else {}
+        if self.invalid is not None:
+            return
+        self.model.maybe_pad_vocab_size(st.tp_size)
+        self.paths = place_strategy_paths(st, system)
+        self.counts = stage_layer_split(st, self.model)
+        self._net_coeffs: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        acc = system.accelerator
+        self._roofline = acc.mode != "compute_only"
+        # straggler inflation is layout-only (perf.straggler_ratio)
+        self.straggle = self._straggler_ratio()
+        # model FLOPs/token walks every layer — layout-constant, cache it
+        self._flops_per_token = self.model.train_flops_per_token(
+            st.seq_len)
+
+    #: strategy fields a block-kind profile can depend on. pp/world and
+    #: the batch/recompute-layer axes are deliberately absent (profiles
+    #: are pp- and batch-independent; the recompute wiring is keyed
+    #: separately in normalized form), and at ZeRO >= 2 the
+    #: data-parallel group sizes are appended explicitly.
+    _KIND_FIELDS = (
+        "seq_len", "dtype", "quant_dtype", "tp_size", "cp_size",
+        "ep_size", "etp_size", "moe_capacity_factor",
+        "group_linear_mode", "enable_sequence_parallel", "cp_comm_type",
+        "cp_a2a_mode", "zero_state", "use_fused_norm", "use_math_sdp",
+        "use_flash_sdp", "sdp_backend", "use_fused_ce",
+        "use_fp32_accum_grad", "optimizer_style",
+        "attention_sparse_ratio", "mesh_order",
+    )
+
+    def _kind_key(self, tag, ub: tuple, wiring) -> tuple:
+        """Shared-cache key of one block-kind profile: everything it can
+        depend on that may vary across the scorer's kernels (the scorer
+        itself is per (model, system))."""
+        st = self.st
+        groups = (st.dp_size * st.cp_size, st.edp_size) \
+            if st.zero_state >= 2 else ()
+        base = getattr(self, "_kind_base", None)
+        if base is None:
+            base = tuple(getattr(st, f) for f in self._KIND_FIELDS)
+            self._kind_base = base
+        return (tag, ub, wiring, groups) + base
+
+    # -- cost primitives ---------------------------------------------------
+    def _coeffs(self, dim: str, op: str) -> Tuple[float, float]:
+        key = (dim, op)
+        got = self._net_coeffs.get(key)
+        if got is None:
+            got = self.system.net_op_coeffs(op, self.paths[dim])
+            self._net_coeffs[key] = got
+        return got
+
+    def _net_time(self, dim: str, op: str, size):
+        k, lat = self._coeffs(dim, op)
+        return k * size + lat
+
+    def _mem_time(self, bytes_arr, bw_key="default"):
+        # only called with positive byte counts (scalar mode)
+        spec = (self.system.accelerator.bandwidth.get(bw_key)
+                or self.system.accelerator.bandwidth["default"])
+        return bytes_arr / (spec.gbps * 1e9 * spec.efficient_factor) \
+            + spec.latency_us * 1e-6
+
+    def _comp_time(self, op_key, flops, key_fn):
+        # only called with positive flops (scalar mode)
+        spec = (self.system.accelerator.op.get(op_key)
+                or self.system.accelerator.op["default"])
+        table = spec.accurate_efficient_factor
+        if table and key_fn is not None:
+            eff = table.get(key_fn(), spec.efficient_factor)
+        else:
+            eff = spec.efficient_factor
+        return flops / (spec.tflops * 1e12 * eff)
+
+    def _straggler_ratio(self) -> float:
+        st = self.st
+        if not st.enable_straggler_model:
+            return 1.0
+        sysc = self.system
+        hosts = max(1, st.world_size // max(1, sysc.chips_per_slice))
+        n = min(hosts, st.dp_size, max(st.edp_size, 1))
+        if n <= 1:
+            return 1.0
+        nhat = math.log2(n)
+        return 1.0 + nhat / (nhat + 1.0) * 0.09 * math.sqrt(nhat)
+
+    # -- param accounting --------------------------------------------------
+    def _pinfo(self, numel: float, moe: bool) -> Tuple[float, float, float]:
+        """(weight, grad, state) bytes — mirror of
+        ``MetaModule.make_param_info``."""
+        st = self.st
+        if numel <= 0:
+            return 0.0, 0.0, 0.0
+        w = numel * st.element_size
+        if st.optimizer_style == "functional":
+            g = 0.0
+            state = numel * 8.0
+        else:
+            g = numel * st.grad_element_size
+            state = numel * 12.0
+        shard = st.edp_size if moe else st.dp_size * st.cp_size
+        if st.zero_state >= 1:
+            state = state / max(1, shard)
+        if st.zero_state >= 2:
+            g = g / max(1, shard)
+        if st.zero_state >= 3:
+            w = w / max(1, shard)
+        return w, g, state
+
+    def _fsdp_group(self, moe: bool) -> int:
+        st = self.st
+        return st.edp_size if moe else st.dp_size * st.cp_size
+
+    def _fsdp_temp(self, numel: float, moe: bool) -> float:
+        st = self.st
+        group = self._fsdp_group(moe)
+        if st.zero_state < 3 or numel <= 0 or group <= 1:
+            return 0.0
+        return numel * st.element_size * (1 - 1 / group)
+
+    def _zero_grad_temp(self, numel: float, moe: bool) -> float:
+        st = self.st
+        group = self._fsdp_group(moe)
+        if st.zero_state < 2 or numel <= 0 or group <= 1:
+            return 0.0
+        return numel * st.grad_element_size * (1 - 1 / group)
+
+    def _fsdp_calls(self, leaf: _Leaf, numel: float, moe: bool):
+        st = self.st
+        group = self._fsdp_group(moe)
+        if st.zero_state < 3 or numel <= 0 or group <= 1:
+            return
+        dim = "edp" if moe else "dp_cp"
+        w = numel * st.element_size
+        g = numel * st.grad_element_size
+        leaf.coll.append(("fwd", "all_gather", dim, w, False, True))
+        leaf.coll.append(("bwd_act", "all_gather", dim, w, False, True))
+        leaf.coll.append(("bwd_w", "reduce_scatter", dim, g, False, True))
+
+    # -- leaf builders -----------------------------------------------------
+    # The builders run in SCALAR mode: one block-kind profile is built
+    # per single micro_batch_size value with plain Python floats (bit-
+    # identical to elementwise float64 array math), and ``score``
+    # assembles candidate-batch arrays by concatenating cached per-b
+    # profiles — maximizing cross-layout reuse and keeping numpy
+    # overhead out of the build path.
+    def _gemm_keyfn(self, phase, m, k, n, batch=1):
+        """Lazy key renderer for a dense-grammar GEMM."""
+        st = self.st
+
+        def fn(_phase=phase, _m=int(m), _k=k, _n=n, _b=batch):
+            if _phase == "fwd":
+                t = (_b, _m, _k, _n)
+            elif _phase == "bwd_act":
+                t = (_b, _m, _n, _k)
+            else:
+                t = (_b, _k, _m, _n)
+            return GemmBase.render_gemm_shape_key(
+                t[0], t[1], t[2], t[3], _phase, st.dtype,
+                st.use_fp32_accum_grad,
+            )
+        return fn
+
+    def _linear(self, name, rows_in, k, n, numel, *,
+                sp_comm: bool, col: bool, moe_param=False,
+                count_params=True):
+        """Shared LinearCol/LinearRow lowering.
+
+        ``rows_in`` — the GEMM rows m (already gathered for col layers
+        under SP); ``k``/``n`` the local contraction/output dims;
+        ``sp_comm`` — the layer issues the SP/TP collectives; ``col`` —
+        column-parallel (AG-in) vs row-parallel (RS-out)."""
+        st = self.st
+        e = st.element_size
+        g = st.grad_element_size
+        lf = _Leaf(name)
+        m = rows_in
+        f = 2.0 * m * k * n
+        lf.flops = {"fwd": f, "bwd_act": f, "bwd_w": f}
+        io = (m * k + k * n + m * n) * e
+        wextra = k * n * (g - e)
+        lf.accessed = {"fwd": io, "bwd_act": io, "bwd_w": io + wextra}
+        for ph in ("fwd", "bwd_act", "bwd_w"):
+            lf.op_key[ph] = "matmul"
+            lf.key_fn[ph] = self._gemm_keyfn(ph, rows_in, k, n)
+        pn = numel if count_params else 0.0
+        lf.numel = pn
+        lf.moe = moe_param
+        fsdp_t = self._fsdp_temp(pn, moe_param)
+        lf.bwd_temp = fsdp_t + self._zero_grad_temp(pn, moe_param)
+        lf.fwd_temp = fsdp_t
+        self._fsdp_calls(lf, pn, moe_param)
+        if sp_comm and st.tp_size > 1:
+            if col:
+                full_in = m * k * e
+                if st.enable_sequence_parallel:
+                    lf.coll += [
+                        ("fwd", "all_gather", "tp", full_in, True, False),
+                        ("bwd_act", "reduce_scatter", "tp", full_in, True,
+                         False),
+                        ("bwd_w", "all_gather", "tp", full_in, True, False),
+                    ]
+                else:
+                    lf.coll.append(
+                        ("bwd_act", "all_reduce", "tp", full_in, True,
+                         False))
+            else:
+                full_out = m * n * e
+                if st.enable_sequence_parallel:
+                    lf.coll += [
+                        ("fwd", "reduce_scatter", "tp", full_out, True,
+                         False),
+                        ("bwd_act", "all_gather", "tp", full_out, True,
+                         False),
+                    ]
+                else:
+                    lf.coll.append(
+                        ("fwd", "all_reduce", "tp", full_out, True, False))
+        return lf
+
+    def _norm(self, name, nb, rows, hidden):
+        st = self.st
+        lf = _Leaf(name)
+        numel_in = rows * hidden  # elements of the input
+        lf.flops = {"fwd": 4.0 * numel_in, "bwd_act": 8.0 * numel_in}
+        fused = st.use_fused_norm
+        lf.accessed = {
+            "fwd": (2 if fused else 3) * nb,
+            "bwd_act": (3 if fused else 4) * nb,
+            "bwd_w": nb,
+        }
+        for ph in ("fwd", "bwd_act", "bwd_w"):
+            lf.op_key[ph] = "default"
+        lf.cache_raw = nb + rows * 4.0
+        lf.numel = float(hidden)
+        lf.in_b = nb
+        lf.out_b = nb
+        return lf
+
+    # -- block kinds -------------------------------------------------------
+    def _attention_leaves(self, b: int) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_cp = st.seq_len // st.cp_size
+        s_sp = s_cp // tp if sp else s_cp
+        s_out = s_sp * tp if (sp and tp > 1) else s_sp
+        A = b * s_sp * m.hidden_size * e
+        out: List[_Leaf] = []
+        if m.attention_type == "mla":
+            out += self._mla_leaves(b)
+            return out
+        hd = m.head_size
+        q_out = m.head_num * hd
+        kv_out = m.kv_head_num * hd
+        qkv_out = q_out + 2 * kv_out
+        out_local = qkv_out // tp
+        rows = b * s_out
+        qkv = self._linear("qkv_proj", rows, m.hidden_size,
+                           out_local, float(m.hidden_size * out_local),
+                           sp_comm=True, col=True)
+        qkv.cache_raw = A
+        if sp and tp > 1:
+            qkv.fwd_temp = qkv.fwd_temp + A * tp
+            qkv.bwd_temp = qkv.bwd_temp + A * tp
+        qkv.in_b = A
+        qkv.out_b = rows * out_local * e
+        out.append(qkv)
+
+        hl = m.head_num // tp
+        kvl = max(m.kv_head_num // tp, 1)
+        qb = b * s_out * hl * hd * e
+        kb = b * s_out * kvl * hd * e
+        rope = _Leaf("rope")
+        rope.accessed = {"fwd": 2 * (qb + kb), "bwd_act": 2 * (qb + kb)}
+        rope.op_key = {"fwd": "default", "bwd_act": "default"}
+        rope.in_b = qb + kb
+        rope.out_b = qb + kb
+        out.append(rope)
+
+        out.append(self._core_leaf(b, s_out, hl, kvl, hd, hd))
+
+        in_local = q_out // tp
+        op = self._linear("out_proj", rows, in_local,
+                          m.hidden_size, float(in_local * m.hidden_size),
+                          sp_comm=True, col=False)
+        op.cache_raw = rows * in_local * e
+        op.in_b = rows * in_local * e
+        op.out_b = A
+        out.append(op)
+        return out
+
+    def _core_leaf(self, b, s_out, hl, kvl, d, dv) -> _Leaf:
+        st, m = self.st, self.model
+        e = st.element_size
+        lf = _Leaf("core_attention")
+        sq = skv = s_out
+        causal = bool(m.use_causal_attention)
+        sparse = st.attention_sparse_ratio if causal else 0.0
+        qk = 2.0 * b * hl * sq * skv * d
+        pv = 2.0 * b * hl * sq * skv * dv
+        fwd = (qk + pv) * (1.0 - sparse)
+        bwd = 2.5 * fwd if st.use_flash_sdp else 2.0 * fwd
+        lf.flops = {"fwd": fwd, "bwd_act": bwd}
+        qo = b * sq * hl * (d + dv) * e
+        kv = b * skv * kvl * (d + dv) * e
+        lse = b * hl * sq * 4.0
+        if st.use_flash_sdp:
+            lf.accessed = {"fwd": qo + kv + lse,
+                           "bwd_act": 2 * (qo + kv) + lse}
+        else:
+            score = b * hl * sq * skv * 4.0
+            lf.accessed = {"fwd": qo + kv + 2 * score,
+                           "bwd_act": 2 * (qo + kv) + 4 * score}
+        lf.op_key = {"fwd": "sdp_fwd", "bwd_act": "sdp_bwd"}
+
+        def keyfn(_b=int(b), _sq=sq, _skv=skv, _hl=hl, _kvl=kvl, _d=d,
+                  _dv=dv, _causal=causal):
+            return CoreAttention.render_sdp_shape_key(
+                _b, _sq, _skv, _hl, _kvl, _d, _dv, _causal,
+                st.use_flash_sdp, st.dtype, backend=st.sdp_backend,
+            )
+        lf.key_fn = {"fwd": keyfn, "bwd_act": keyfn}
+        qbytes = b * sq * hl * d * e
+        obytes = b * sq * hl * dv * e
+        if st.use_flash_sdp:
+            lf.cache_raw = qbytes + b * skv * kvl * (d + dv) * e \
+                + obytes + lse
+        else:
+            probs = b * hl * sq * skv * 4.0
+            lf.cache_raw = qbytes + b * skv * kvl * (d + dv) * e + probs
+            lf.bwd_temp = b * hl * sq * skv * e
+        lf.in_b = qbytes + b * skv * kvl * (d + dv) * e
+        lf.out_b = obytes
+        return lf
+
+    def _mla_leaves(self, b: int) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        s_out = s_sp * tp if (sp and tp > 1) else s_sp
+        h = m.hidden_size
+        A = b * s_sp * h * e
+        qk_dim = m.qk_head_dim + m.qk_pos_emb_head_dim
+        q_out = m.head_num * qk_dim
+        hl = m.head_num // tp
+        rows_sp = b * s_sp
+        rows_out = b * s_out
+        out: List[_Leaf] = []
+        if m.q_lora_rank:
+            qd = self._linear("q_down", rows_sp, h,
+                              m.q_lora_rank, float(h * m.q_lora_rank),
+                              sp_comm=False, col=True)
+            qd.cache_raw = A
+            qd.in_b = A
+            qd.out_b = rows_sp * m.q_lora_rank * e
+            out.append(qd)
+            qn = self._norm("q_norm", rows_sp * m.q_lora_rank * e,
+                            rows_sp, m.q_lora_rank)
+            out.append(qn)
+            qu = self._linear("q_up", rows_out, m.q_lora_rank,
+                              q_out // tp, float(m.q_lora_rank
+                                                 * (q_out // tp)),
+                              sp_comm=True, col=True)
+            qu.cache_raw = rows_sp * m.q_lora_rank * e
+            if sp and tp > 1:
+                qu.fwd_temp = qu.fwd_temp + qu.cache_raw * tp
+                qu.bwd_temp = qu.bwd_temp + qu.cache_raw * tp
+            qu.in_b = rows_sp * m.q_lora_rank * e
+            qu.out_b = rows_out * (q_out // tp) * e
+            out.append(qu)
+        else:
+            qp = self._linear("q_proj", rows_out, h,
+                              q_out // tp, float(h * (q_out // tp)),
+                              sp_comm=True, col=True)
+            qp.cache_raw = A
+            if sp and tp > 1:
+                qp.fwd_temp = qp.fwd_temp + A * tp
+                qp.bwd_temp = qp.bwd_temp + A * tp
+            qp.in_b = A
+            qp.out_b = rows_out * (q_out // tp) * e
+            out.append(qp)
+        kvd_out = m.kv_lora_rank + m.qk_pos_emb_head_dim
+        kvd = self._linear("kv_down", rows_sp, h, kvd_out,
+                           float(h * kvd_out), sp_comm=False, col=True)
+        kvd.cache_raw = A
+        kvd.in_b = A
+        kvd.out_b = rows_sp * kvd_out * e
+        out.append(kvd)
+        kvn = self._norm("kv_norm", rows_sp * m.kv_lora_rank * e,
+                         rows_sp, m.kv_lora_rank)
+        out.append(kvn)
+        kvu_out = m.head_num * (m.qk_head_dim + m.v_head_dim)
+        kvu = self._linear("kv_up", rows_out, m.kv_lora_rank,
+                           kvu_out // tp,
+                           float(m.kv_lora_rank * (kvu_out // tp)),
+                           sp_comm=True, col=True)
+        kvu.cache_raw = rows_sp * m.kv_lora_rank * e
+        if sp and tp > 1:
+            kvu.fwd_temp = kvu.fwd_temp + kvu.cache_raw * tp
+            kvu.bwd_temp = kvu.bwd_temp + kvu.cache_raw * tp
+        kvu.in_b = rows_sp * m.kv_lora_rank * e
+        kvu.out_b = rows_out * (kvu_out // tp) * e
+        out.append(kvu)
+        if sp and tp > 1:
+            rg = _Leaf("rope_k_gather")
+            rope_in = rows_sp * m.qk_pos_emb_head_dim * e
+            full = rope_in * tp
+            rg.coll = [("fwd", "all_gather", "tp", full, True, False),
+                       ("bwd_act", "reduce_scatter", "tp", full, True,
+                        False)]
+            rg.fwd_temp = full
+            rg.in_b = rope_in
+            rg.out_b = full
+            out.append(rg)
+        qb = b * s_out * hl * qk_dim * e
+        kb = qb
+        rope = _Leaf("rope")
+        rope.accessed = {"fwd": 2 * (qb + kb), "bwd_act": 2 * (qb + kb)}
+        rope.op_key = {"fwd": "default", "bwd_act": "default"}
+        rope.in_b = qb + kb
+        rope.out_b = qb + kb
+        out.append(rope)
+        out.append(self._core_leaf(b, s_out, hl, hl, qk_dim,
+                                   m.v_head_dim))
+        in_feats = m.head_num * m.v_head_dim
+        op = self._linear("out_proj", rows_out,
+                          in_feats // tp, h, float((in_feats // tp) * h),
+                          sp_comm=True, col=False)
+        op.cache_raw = rows_out * (in_feats // tp) * e
+        op.in_b = rows_out * (in_feats // tp) * e
+        op.out_b = A
+        out.append(op)
+        return out
+
+    def _mlp_leaves(self, b: int, ffn=None, prefix="") -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        s_out = s_sp * tp if (sp and tp > 1) else s_sp
+        h = m.hidden_size
+        A = b * s_sp * h * e
+        f = ffn or m.intermediate_size
+        fan = 2 * f if m.use_swiglu else f
+        rows = b * s_out
+        up = self._linear(prefix + "up_proj", rows, h,
+                          fan // tp, float(h * (fan // tp)),
+                          sp_comm=True, col=True)
+        up.cache_raw = A
+        if sp and tp > 1:
+            up.fwd_temp = up.fwd_temp + A * tp
+            up.bwd_temp = up.bwd_temp + A * tp
+        up.in_b = A
+        up.out_b = rows * (fan // tp) * e
+        act = _Leaf(prefix + ("swiglu" if m.use_swiglu else "gelu"))
+        i_b = rows * (fan // tp) * e
+        if m.use_swiglu:
+            o_b = rows * ((fan // tp) // 2) * e
+            act.accessed = {"fwd": i_b + o_b, "bwd_act": 2 * i_b + o_b}
+        else:
+            o_b = i_b
+            act.accessed = {"fwd": 2 * i_b, "bwd_act": 3 * i_b}
+        act.op_key = {"fwd": "default", "bwd_act": "default"}
+        act.cache_raw = i_b
+        act.in_b = i_b
+        act.out_b = o_b
+        down = self._linear(prefix + "down_proj", rows,
+                            f // tp, h, float((f // tp) * h),
+                            sp_comm=True, col=False)
+        down.cache_raw = rows * (f // tp) * e
+        down.in_b = rows * (f // tp) * e
+        down.out_b = A
+        return [up, act, down]
+
+    def _moe_leaves(self, b: int) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        etp = st.etp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        h = m.hidden_size
+        A = b * s_sp * h * e
+        E = m.expert_num
+        ng = E // st.ep_size
+        out: List[_Leaf] = []
+
+        router = _Leaf("router")
+        rows = b * s_sp
+        f = 2.0 * rows * h * E
+        router.flops = {"fwd": f, "bwd_act": f, "bwd_w": f}
+        o_b = rows * E * 4.0
+        router.accessed = {"fwd": A + 3 * o_b, "bwd_act": A + 3 * o_b,
+                           "bwd_w": A + o_b}
+        router.op_key = {ph: "default" for ph in
+                         ("fwd", "bwd_act", "bwd_w")}
+        router.cache_raw = A + o_b + rows * m.topk * 4.0
+        router.numel = float(h * E)
+        router.in_b = A
+        router.out_b = o_b
+        out.append(router)
+
+        cap = st.moe_capacity_factor or 1.0
+        t1 = int(b * s_sp * m.topk * cap)
+        if etp > 1 and sp:
+            t1 *= etp
+        disp = _Leaf("dispatch")
+        permuted = t1 * h * e
+        disp.accessed = {"fwd": 2 * permuted, "bwd_act": 2 * permuted}
+        disp.op_key = {"fwd": "default", "bwd_act": "default"}
+        disp.bw_key = {"fwd": "permute_fwd", "bwd_act": "permute_bwd"}
+        disp.cache_raw = b * s_sp * m.topk * 4.0
+        disp.fwd_temp = permuted
+        disp.in_b = A
+        disp.out_b = permuted
+        pre = permuted
+        if etp > 1 and sp:
+            disp.coll.append(("fwd", "all_gather", "etp", permuted, True,
+                              False))
+            disp.coll.append(("bwd_act", "reduce_scatter", "etp", permuted,
+                              True, False))
+            pre = permuted / etp
+        if st.ep_size > 1:
+            full = pre * st.ep_size
+            disp.coll.append(("fwd", "all2all", "ep", full, True, False))
+            disp.coll.append(("bwd_act", "all2all", "ep", full, True,
+                              False))
+        out.append(disp)
+
+        fan = 2 * m.moe_ffn_hidden_size if m.use_swiglu \
+            else m.moe_ffn_hidden_size
+        out.append(self._group_linear("group_linear_col", t1,
+                                      h, fan // etp, ng))
+        act = _Leaf("expert_swiglu" if m.use_swiglu else "expert_gelu")
+        i_b = t1 * (fan // etp) * e
+        if m.use_swiglu:
+            o_b = t1 * (((fan // etp)) // 2) * e
+            act.accessed = {"fwd": i_b + o_b, "bwd_act": 2 * i_b + o_b}
+        else:
+            o_b = i_b
+            act.accessed = {"fwd": 2 * i_b, "bwd_act": 3 * i_b}
+        act.op_key = {"fwd": "default", "bwd_act": "default"}
+        act.cache_raw = i_b
+        act.in_b = i_b
+        act.out_b = o_b
+        out.append(act)
+        out.append(self._group_linear("group_linear_row", t1,
+                                      m.moe_ffn_hidden_size // etp, h, ng))
+        comb = _Leaf("combine")
+        in_b = t1 * h * e
+        comb.accessed = {"fwd": in_b + A, "bwd_act": in_b + A}
+        comb.op_key = {"fwd": "default", "bwd_act": "default"}
+        comb.bw_key = {"fwd": "permute_fwd", "bwd_act": "permute_bwd"}
+        comb.cache_raw = in_b
+        comb.in_b = in_b
+        comb.out_b = A
+        pre = in_b
+        if etp > 1 and sp:
+            comb.coll.append(("fwd", "reduce_scatter", "etp", in_b, True,
+                              False))
+            comb.coll.append(("bwd_act", "all_gather", "etp", in_b, True,
+                              False))
+            pre = in_b / etp
+        if st.ep_size > 1:
+            full = pre * st.ep_size
+            comb.coll.append(("fwd", "all2all", "ep", full, True, False))
+            comb.coll.append(("bwd_act", "all2all", "ep", full, True,
+                              False))
+        out.append(comb)
+
+        if m.moe_shared_expert_intermediate_size:
+            out += self._mlp_leaves(
+                b, ffn=m.moe_shared_expert_intermediate_size,
+                prefix="shared_",
+            )
+            add_sh = _Leaf("add_shared")
+            add_sh.accessed = {"fwd": 3 * A}
+            add_sh.op_key = {"fwd": "default"}
+            add_sh.in_b = 2 * A
+            add_sh.out_b = A
+            out.append(add_sh)
+        return out
+
+    def _group_linear(self, name, t1, k, n, ng) -> _Leaf:
+        st = self.st
+        e = st.element_size
+        g = st.grad_element_size
+        lf = _Leaf(name)
+        f = 2.0 * t1 * k * n
+        lf.flops = {"fwd": f, "bwd_act": f, "bwd_w": f}
+        io = (t1 * k + ng * k * n + t1 * n) * e
+        wextra = ng * k * n * (g - e)
+        lf.accessed = {"fwd": io, "bwd_act": io, "bwd_w": io + wextra}
+        sequential = st.group_linear_mode == "sequential"
+        op_key = "matmul" if sequential else "group_matmul"
+        for ph in ("fwd", "bwd_act", "bwd_w"):
+            lf.op_key[ph] = op_key
+
+            def keyfn(_ph=ph, _k=k, _n=n, _ng=ng, _seq=sequential):
+                tokens = int(t1)
+                if _seq:
+                    tokens = max(tokens // _ng, 1)
+                    if _ph == "fwd":
+                        t = (_ng, tokens, _k, _n)
+                    elif _ph == "bwd_act":
+                        t = (_ng, tokens, _n, _k)
+                    else:
+                        t = (_ng, _k, tokens, _n)
+                    return GemmBase.render_gemm_shape_key(
+                        t[0], t[1], t[2], t[3], _ph, st.dtype,
+                        st.use_fp32_accum_grad,
+                    )
+                if _ph == "fwd":
+                    t = (_ng, tokens, _k, _n)
+                elif _ph == "bwd_act":
+                    t = (_ng, tokens, _n, _k)
+                else:
+                    t = (_ng, _k, tokens, _n)
+                return GroupLinearBase.render_group_shape_key(
+                    t[0], t[1], t[2], t[3], _ph, st.dtype,
+                    st.use_fp32_accum_grad,
+                )
+            lf.key_fn[ph] = keyfn
+        numel = float(ng * k * n)
+        lf.numel = numel
+        lf.moe = True
+        fsdp_t = self._fsdp_temp(numel, True)
+        lf.fwd_temp = fsdp_t
+        lf.bwd_temp = fsdp_t + self._zero_grad_temp(numel, True)
+        self._fsdp_calls(lf, numel, True)
+        lf.cache_raw = t1 * k * e
+        lf.in_b = t1 * k * e
+        lf.out_b = t1 * n * e
+        return lf
+
+    def _block_leaves(self, b: int, is_moe: bool) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        A = b * s_sp * m.hidden_size * e
+        leaves: List[_Leaf] = []
+        inorm = self._norm("input_norm", A, b * s_sp, m.hidden_size)
+        leaves.append(inorm)
+        attn = self._attention_leaves(b)
+        leaves += attn
+        add1 = _Leaf("residual_attn")
+        add1.accessed = {"fwd": 3 * A}
+        add1.op_key = {"fwd": "default"}
+        add1.in_b = 2 * A
+        add1.out_b = A
+        leaves.append(add1)
+        pnorm = self._norm("pre_mlp_norm", A, b * s_sp, m.hidden_size)
+        leaves.append(pnorm)
+        if is_moe:
+            mlp = self._moe_leaves(b)
+        else:
+            mlp = self._mlp_leaves(b)
+        leaves += mlp
+        add2 = _Leaf("residual_mlp")
+        add2.accessed = {"fwd": 3 * A}
+        add2.op_key = {"fwd": "default"}
+        add2.in_b = 2 * A
+        add2.out_b = A
+        leaves.append(add2)
+        # stash sub-lists for recompute wiring
+        self._last_block_parts = {
+            "input_norm": inorm, "pre_mlp_norm": pnorm,
+            "attention": attn, "mlp": mlp,
+        }
+        return leaves
+
+    def _pre_leaves(self, b: int) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_cp = st.seq_len // st.cp_size
+        s_out = s_cp // tp if sp else s_cp
+        emb = _Leaf("embedding")
+        out_b = b * s_out * m.hidden_size * e
+        full = out_b * (tp if sp else 1)
+        ids_b = b * s_cp * 4.0
+        emb.accessed = {"fwd": 2 * full, "bwd_w": 2 * full + ids_b}
+        emb.op_key = {"fwd": "default", "bwd_w": "default"}
+        numel = float(m.padded_vocab_size * m.hidden_size // tp)
+        emb.numel = numel
+        emb.cache_raw = ids_b
+        fsdp_t = self._fsdp_temp(numel, False)
+        emb.fwd_temp = fsdp_t
+        emb.bwd_temp = fsdp_t + self._zero_grad_temp(numel, False)
+        self._fsdp_calls(emb, numel, False)
+        if tp > 1:
+            if sp:
+                emb.coll.append(("fwd", "reduce_scatter", "tp", full, True,
+                                 False))
+                emb.coll.append(("bwd_w", "all_gather", "tp", full, True,
+                                 False))
+            else:
+                emb.coll.append(("fwd", "all_reduce", "tp", full, True,
+                                 False))
+        emb.in_b = ids_b
+        emb.out_b = out_b
+        return [emb]
+
+    def _post_leaves(self, b: int, preprocess: bool) -> List[_Leaf]:
+        st, m = self.st, self.model
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        s_out = s_sp * tp if (sp and tp > 1) else s_sp
+        h = m.hidden_size
+        A = b * s_sp * h * e
+        fnorm = self._norm("final_norm", A, b * s_sp, h)
+        count = m.untie_embeddings or not preprocess
+        out_local = m.padded_vocab_size // tp
+        rows = b * s_out
+        head = self._linear("lm_head", rows, h, out_local,
+                            float(h * out_local), sp_comm=True, col=True,
+                            count_params=count)
+        head.cache_raw = A
+        if sp and tp > 1:
+            head.fwd_temp = head.fwd_temp + A * tp
+            head.bwd_temp = head.bwd_temp + A * tp
+        head.in_b = A
+        head.out_b = rows * out_local * e
+
+        ce = _Leaf("parallel_ce")
+        lg = rows * out_local * e
+        ce.accessed = {"fwd": 2 * lg, "bwd_act": 2 * lg}
+        ce.op_key = {"fwd": "default", "bwd_act": "default"}
+        bw = "ce_fusion" if st.use_fused_ce else "ce"
+        ce.bw_key = {"fwd": bw, "bwd_act": bw}
+        ce.cache_raw = lg + rows * 4.0
+        if tp > 1:
+            scalar = rows * 4.0
+            ncalls = 2 if st.use_fused_ce else 3
+            for _ in range(ncalls):
+                ce.coll.append(("fwd", "all_reduce", "tp", scalar, True,
+                                False))
+        ce.in_b = lg
+        ce.out_b = rows * 4.0
+        return [fnorm, head, ce]
+
+    # -- recompute wiring --------------------------------------------------
+    def _wire_block(self, leaves: List[_Leaf], recompute: bool):
+        """Apply the recompute segment marking of
+        ``LLMBlock._wire_recompute`` + the cache override of
+        ``MetaModule._comp_leaf_info`` to one block's leaf list."""
+        rc = self.st.recompute
+        for lf in leaves:
+            lf.cache_eff = lf.cache_raw
+            lf.rc = False
+            lf.seg = None
+        if not recompute or not rc.enabled:
+            return
+        parts = self._last_block_parts
+        segments: List[List[_Leaf]] = []
+
+        def mark(seg_leaves: List[_Leaf]):
+            fresh = [l for l in seg_leaves if not l.rc]
+            if not fresh:
+                return
+            seg_id = len(segments)
+            segments.append(fresh)
+            for i, l in enumerate(fresh):
+                l.rc = True
+                l.seg = seg_id
+                l.cache_eff = 0.0
+                if i == 0:
+                    # FIRST leaf keeps the segment input cached
+                    l.cache_eff = l.in_b
+        if rc.granularity == "full_block":
+            mark(list(leaves))
+            return
+        # selective — same claim order as _wire_recompute
+        attn = parts["attention"]
+        if rc.sdp_recompute:
+            core = [l for l in attn if l.name in
+                    ("core_attention", "mla_core_attention")]
+            for c in core:
+                mark([c])
+        if rc.attn_recompute:
+            mark(list(attn))
+        if rc.attn_norm_recompute:
+            mark([parts["input_norm"]])
+            for l in attn:
+                if l.name in ("kv_norm", "q_norm"):
+                    mark([l])
+        if rc.mlp_recompute:
+            mark(list(parts["mlp"]))
+        if rc.mlp_norm_recompute:
+            mark([parts["pre_mlp_norm"]])
+
+    # -- leaf costing ------------------------------------------------------
+    def _cost_leaves(self, leaves: List[_Leaf]):
+        """Fill per-leaf per-phase cost values (mirror of
+        ``MetaModule._comp_leaf_info``; scalar mode)."""
+        roofline = self._roofline
+        for lf in leaves:
+            for ph in ("fwd", "bwd_act", "bwd_w"):
+                f = lf.flops.get(ph, 0.0)
+                a = lf.accessed.get(ph, 0.0)
+                have_f = f > 0
+                have_a = a > 0
+                if not have_f and not have_a:
+                    setattr(lf, f"cost_{ph}", 0.0)
+                    continue
+                comp = self._comp_time(lf.op_key.get(ph, "default"), f,
+                                       lf.key_fn.get(ph)) \
+                    if have_f else 0.0
+                mem = self._mem_time(a, lf.bw_key.get(ph, "default")) \
+                    if have_a else 0.0
+                t = max(comp, mem) if roofline else comp
+                setattr(lf, f"cost_{ph}", t)
+            net = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+            fsdp = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+            for (ph, op, dim, size, exposed, is_fsdp) in lf.coll:
+                t = self._net_time(dim, op, size)
+                if exposed:
+                    net[ph] = net[ph] + t
+                if is_fsdp:
+                    fsdp[ph] = fsdp[ph] + t
+            lf.net_fwd, lf.net_bwd_act, lf.net_bwd_w = (
+                net["fwd"], net["bwd_act"], net["bwd_w"])
+            lf.fsdp = fsdp
+
+    def _block_totals(self, leaves: List[_Leaf],
+                      expose_fsdp: bool = True) -> dict:
+        """Aggregate one block kind: times (incl. the FSDP overlap
+        re-exposure of ``LLMBlock._post_forward`` — transformer blocks
+        only; embedding/head leaves sit directly under ``LLMModel``,
+        which has no re-exposure hook, so their FSDP collectives stay
+        hidden), caches, params, and the activation-replay probe
+        profile. Scalar mode: one float per quantity."""
+        comp = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        net = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        fsdp_tot = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        fsdp_rc_fwd = 0.0
+        recompute_t = 0.0
+        for lf in leaves:
+            comp["fwd"] += lf.cost_fwd
+            comp["bwd_act"] += lf.cost_bwd_act
+            comp["bwd_w"] += lf.cost_bwd_w
+            net["fwd"] += lf.net_fwd
+            net["bwd_act"] += lf.net_bwd_act
+            net["bwd_w"] += lf.net_bwd_w
+            for ph in ("fwd", "bwd_act", "bwd_w"):
+                fsdp_tot[ph] += lf.fsdp[ph]
+            if lf.rc and not lf.variance_tail:
+                recompute_t += lf.cost_fwd + lf.net_fwd
+                fsdp_rc_fwd += lf.fsdp["fwd"]
+        # FSDP re-exposure (zero>=3): hidden beyond the block's own
+        # compute budget returns to the critical path; the recompute
+        # replay picks up its leaves' share of the fwd extra
+        if expose_fsdp and self.st.zero_state >= 3:
+            for ph in ("fwd", "bwd_act", "bwd_w"):
+                hidden = fsdp_tot[ph]
+                if hidden <= 0:
+                    continue
+                budget = max(comp[ph], 0.0)
+                extra = max(hidden - budget, 0.0)
+                net[ph] += extra
+                if ph == "fwd":
+                    recompute_t += extra * (fsdp_rc_fwd / hidden)
+        fwd_time = comp["fwd"] + net["fwd"]
+        bwd_time = (comp["bwd_act"] + net["bwd_act"]
+                    + comp["bwd_w"] + net["bwd_w"] + recompute_t)
+        cache = 0.0
+        for lf in leaves:
+            cache = cache + lf.cache_eff
+        dn = mn = 0.0
+        for lf in leaves:
+            if lf.moe:
+                mn += lf.numel
+            else:
+                dn += lf.numel
+        probes, delta = self._profile(leaves)
+        return {
+            "fwd": fwd_time, "bwd": bwd_time, "cache": cache,
+            "dense_numel": dn, "moe_numel": mn,
+            # every probe of one block shares its entry-live anchor, so
+            # the stage composition only ever needs the block's max
+            "probe_max": max(probes) if probes else float("-inf"),
+            "delta": delta,
+        }
+
+    @staticmethod
+    def _profile(leaves: List[_Leaf]):
+        """Activation replay of ONE block kind — the exact event stream
+        of ``LLMModel.activation_events`` restricted to these leaves.
+        Returns (probe values relative to block-entry live, cache
+        delta); scalar mode."""
+        live = 0.0
+        probes: List[float] = []
+        for lf in leaves:
+            live = live + lf.cache_eff
+            probes.append(live + lf.fwd_temp)
+        delta = live
+        done = set()
+        i = len(leaves) - 1
+        while i >= 0:
+            lf = leaves[i]
+            if id(lf) in done:
+                i -= 1
+                continue
+            if lf.rc and lf.seg is not None:
+                seg_leaves = [l for l in leaves if l.seg == lf.seg]
+                saved = seg_leaves[0].cache_eff
+                tail_is_first = seg_leaves[0].variance_tail
+                for sl in seg_leaves:
+                    if sl.variance_tail:
+                        continue
+                    live = live + sl.cache_raw
+                    cand = live + (-saved)
+                    cand = cand + sl.fwd_temp
+                    probes.append(cand)
+                if not tail_is_first:
+                    live = live - saved
+                for sl in reversed(seg_leaves):
+                    cand = live + sl.bwd_temp
+                    cand = cand + (sl.in_b + sl.out_b)
+                    probes.append(cand)
+                    if sl.variance_tail:
+                        if sl is seg_leaves[0]:
+                            live = live - saved
+                    else:
+                        live = live - sl.cache_raw
+                    done.add(id(sl))
+                i -= 1
+                continue
+            cand = live + lf.bwd_temp
+            cand = cand + (lf.in_b + lf.out_b)
+            probes.append(cand)
+            live = live - lf.cache_eff
+            done.add(id(lf))
+            i -= 1
+        assert abs(live) < 1024, (
+            "batched activation conservation violated"
+        )
+        return probes, delta
+
+    # -- scoring -----------------------------------------------------------
+    def score(self, mbs: Sequence[int], mbc: Sequence[int],
+              nrc: Optional[Sequence[int]] = None,
+              cost_margin: Optional[float] = None) -> Optional[dict]:
+        """Score a candidate batch: arrays of ``micro_batch_size``,
+        ``micro_batch_num``, and (for full-block recompute) the probed
+        ``recompute_layer_num`` per candidate. Returns per-candidate
+        arrays mirroring the scalar ``analysis_mem``/``analysis_cost``
+        headline numbers, or ``None`` when the whole family is invalid
+        (the scalar path would raise ``ConfigError`` for every split).
+
+        ``cost_margin`` (GiB) enables the selection fast path: the 1F1B
+        replay is skipped for candidates that do not fit under that
+        feasibility margin (their ``iter_time`` comes back ``inf`` /
+        ``mfu`` 0) — the selection walks never consume the cost of a
+        non-fitting candidate. Pass ``None`` for full scoring (the
+        parity tests do)."""
+        if self.invalid is not None:
+            return None
+        st, m = self.st, self.model
+        bi = [int(x) for x in mbs]
+        ncand = len(bi)
+        mbc_a = np.array([int(x) for x in mbc], dtype=float)
+        rc = st.recompute
+        if nrc is None:
+            if rc.enabled:
+                nrc_a = np.full(ncand, rc.recompute_layer_num)
+            else:
+                nrc_a = np.zeros(ncand)
+        else:
+            nrc_a = np.array([int(x) for x in nrc], dtype=float)
+        # -1 => all layers in the stage recompute
+        pp = st.pp_size
+        e = st.element_size
+        tp = st.tp_size
+        sp = st.enable_sequence_parallel
+        s_sp = (st.seq_len // st.cp_size) // tp if sp \
+            else st.seq_len // st.cp_size
+        zeros = np.zeros(ncand)
+
+        # unique-mbs dedup: profiles are elementwise in mbs, so build at
+        # unique-b resolution and expand via fancy indexing
+        ub = sorted(set(bi))
+        ub_t = tuple(ub)
+        idx = np.array([ub.index(x) for x in bi])
+        bu = np.array(ub, dtype=float)
+        nu = len(ub)
+        b = bu[idx]  # per-candidate float mbs (used for shapes below)
+
+        def expand(v):
+            return v[idx] if isinstance(v, np.ndarray) else v
+
+        rc_active = rc.enabled or nrc is not None
+        wiring = (
+            ("rc", rc.granularity, rc.sdp_recompute, rc.attn_recompute,
+             rc.attn_norm_recompute, rc.mlp_recompute,
+             rc.mlp_norm_recompute)
+            if rc.enabled else ("plain",)
+        )
+        dense_layers = m.dense_layer_num if m.model_type == "moe" \
+            else m.layer_num
+
+        def _assemble(parts: List[dict]) -> dict:
+            return {
+                "fwd": np.array([p["fwd"] for p in parts]),
+                "bwd": np.array([p["bwd"] for p in parts]),
+                "cache": np.array([p["cache"] for p in parts]),
+                "delta": np.array([p["delta"] for p in parts]),
+                "dense_numel": parts[0]["dense_numel"],
+                "moe_numel": parts[0]["moe_numel"],
+                "probe_max": np.array([p["probe_max"] for p in parts]),
+            }
+
+        def kind(is_moe: bool, recompute: bool) -> dict:
+            wir = wiring if (recompute and rc.enabled) else ("plain",)
+            akey = self._kind_key(("block-batch", is_moe), ub_t, wir)
+            got = self._shared.get(akey)
+            if got is None:
+                parts = []
+                for bv in ub:
+                    k1 = self._kind_key(("block", is_moe), bv, wir)
+                    p = self._shared.get(k1)
+                    if p is None:
+                        leaves = self._block_leaves(bv, is_moe)
+                        self._wire_block(leaves, recompute and rc.enabled)
+                        self._cost_leaves(leaves)
+                        p = self._block_totals(leaves)
+                        self._shared[k1] = p
+                    parts.append(p)
+                got = _assemble(parts)
+                self._shared[akey] = got
+            return got
+
+        def boundary_totals(tag, builder) -> dict:
+            akey = self._kind_key(tag + ("batch",), ub_t, ())
+            got = self._shared.get(akey)
+            if got is None:
+                parts = []
+                for bv in ub:
+                    k1 = self._kind_key(tag, bv, ())
+                    p = self._shared.get(k1)
+                    if p is None:
+                        leaves = builder(bv)
+                        self._wire_block(leaves, False)
+                        self._cost_leaves(leaves)
+                        p = self._block_totals(leaves, expose_fsdp=False)
+                        self._shared[k1] = p
+                    parts.append(p)
+                got = _assemble(parts)
+                self._shared[akey] = got
+            return got
+
+        NEG = np.full(ncand, -np.inf)
+        stage_fwd, stage_bwd = [], []
+        stage_peak, stage_cache, stage_model = [], [], []
+        stage_params = []
+        offset = 0
+        for s in range(pp):
+            L_s = self.counts[s][0]
+            preprocess = s == 0
+            postprocess = s == pp - 1
+            boundary = min(max(dense_layers - offset, 0), L_s)
+            # run lengths (arrays): rc region = idx_in_stage < nrc
+            nrc_s = np.where(nrc_a < 0, float(L_s),
+                             np.minimum(nrc_a, float(L_s)))
+            if not rc_active:
+                nrc_s = zeros
+            n_rcd = np.minimum(nrc_s, float(boundary))
+            n_rcm = nrc_s - n_rcd
+            n_pld = float(boundary) - n_rcd
+            n_plm = (float(L_s) - float(boundary)) - n_rcm
+            runs = []
+            if L_s:
+                need_rc = rc_active and float(np.max(nrc_s)) > 0
+                need_plain = (not rc_active
+                              or float(np.min(nrc_s)) < float(L_s))
+                if boundary and need_rc:
+                    runs.append((kind(False, True), n_rcd))
+                if L_s - boundary and need_rc:
+                    runs.append((kind(True, True), n_rcm))
+                if boundary and need_plain:
+                    runs.append((kind(False, False), n_pld))
+                if L_s - boundary and need_plain:
+                    runs.append((kind(True, False), n_plm))
+            fwd = zeros
+            bwd = zeros
+            cache = zeros
+            dn = mn = 0.0
+            peak_rows = []
+            live = zeros
+            pre_tot = None
+            if preprocess:
+                pre_tot = boundary_totals(
+                    ("pre",), lambda bv: self._pre_leaves(bv))
+                fwd = fwd + expand(pre_tot["fwd"])
+                bwd = bwd + expand(pre_tot["bwd"])
+                cache = cache + expand(pre_tot["cache"])
+                dn += pre_tot["dense_numel"]
+                peak_rows.append(live + expand(pre_tot["probe_max"]))
+                live = live + expand(pre_tot["delta"])
+            for tot, cnt in runs:
+                fwd = fwd + cnt * expand(tot["fwd"])
+                bwd = bwd + cnt * expand(tot["bwd"])
+                cache = cache + cnt * expand(tot["cache"])
+                delta = expand(tot["delta"])
+                peak_entry = live + (cnt - 1.0) * delta
+                peak_rows.append(
+                    np.where(cnt > 0,
+                             peak_entry + expand(tot["probe_max"]), NEG))
+                live = live + cnt * delta
+            # params are batch/recompute-independent: count by layer
+            # kind (the rc and plain variants own identical parameters)
+            if L_s and boundary:
+                dk = (kind(False, True) if (rc_active
+                                            and float(np.max(nrc_s)) > 0)
+                      else kind(False, False))
+                dn += boundary * dk["dense_numel"]
+                mn += boundary * dk["moe_numel"]
+            if L_s and L_s - boundary:
+                mk = (kind(True, True) if (rc_active
+                                           and float(np.max(nrc_s)) > 0)
+                      else kind(True, False))
+                dn += (L_s - boundary) * mk["dense_numel"]
+                mn += (L_s - boundary) * mk["moe_numel"]
+            if postprocess:
+                post_tot = boundary_totals(
+                    ("post", preprocess),
+                    lambda bv: self._post_leaves(bv, preprocess))
+                fwd = fwd + expand(post_tot["fwd"])
+                bwd = bwd + expand(post_tot["bwd"])
+                cache = cache + expand(post_tot["cache"])
+                dn += post_tot["dense_numel"]
+                peak_rows.append(live + expand(post_tot["probe_max"]))
+                live = live + expand(post_tot["delta"])
+            peak_pt = np.maximum(
+                np.max(np.stack(peak_rows), axis=0) if peak_rows else zeros,
+                0.0)
+            w, g, s_b = self._pinfo(dn, False)
+            mw, mg, ms = self._pinfo(mn, True)
+            model_bytes = w + g + s_b + mw + mg + ms
+            stage_fwd.append(fwd)
+            stage_bwd.append(bwd)
+            stage_cache.append(cache)
+            stage_peak.append(peak_pt)
+            stage_model.append(model_bytes)
+            stage_params.append({
+                "dense_numel": dn, "moe_numel": mn,
+            })
+            offset += L_s
+
+        # ---- memory (analysis_mem, vp=1)
+        cap = self.system.mem_bytes * st.mem_factor
+        peaks = []
+        for s in range(pp):
+            live_mb = np.minimum(mbc_a, float(pp - s))
+            peaks.append(stage_model[s]
+                         + np.maximum(live_mb - 1.0, 0.0) * stage_cache[s]
+                         + stage_peak[s])
+        max_peak = np.max(np.stack(peaks), axis=0)
+
+        # ---- cost (analysis_cost)
+        boundary_bytes = b * s_sp * m.hidden_size * e
+        p2p_t = self._net_time("pp", "p2p", boundary_bytes) if pp > 1 \
+            else zeros
+        dp_rs, dp_ag = [], []
+        optim = []
+        for s in range(pp):
+            rs, ag = self._dp_terms(s, stage_params[s], mbc_a, ncand)
+            dp_rs.append(rs)
+            dp_ag.append(ag)
+            optim.append(self._optim_time(stage_params[s]))
+        if cost_margin is None:
+            need_cost = [True] * ncand
+        else:
+            cap_fit = cap - cost_margin * GiB
+            need_cost = [bool(max_peak[i] <= cap_fit)
+                         for i in range(ncand)]
+        totals = np.empty(ncand)
+        ends = np.empty((pp, ncand))
+        for i in range(ncand):
+            if not need_cost[i]:
+                totals[i] = math.inf
+                ends[:, i] = math.inf
+                continue
+            if pp == 1:
+                tot = mbc_a[i] * (stage_fwd[0][i] + stage_bwd[0][i])
+                totals[i] = tot
+                ends[0, i] = tot
+            else:
+                fwds = [stage_fwd[s][i] for s in range(pp)]
+                bwds = [stage_bwd[s][i] for s in range(pp)]
+                tot, ends_i = fold_1f1b(pp, int(mbc_a[i]), fwds, bwds,
+                                        p2p_t[i], st.pp_comm_async)
+                totals[i] = tot
+                for s in range(pp):
+                    ends[s, i] = ends_i[s]
+        barrier = np.max(
+            np.stack([ends[s] + dp_rs[s] for s in range(pp)]), axis=0)
+        tail = np.max(
+            np.stack([optim[s] + dp_ag[s] for s in range(pp)]), axis=0)
+        iter_time = (barrier + tail) * self.straggle
+
+        tokens = b * mbc_a * st.dp_size * st.seq_len
+        model_flops = self._flops_per_token * tokens
+        per_chip = model_flops / st.world_size / iter_time
+        peak_flops = self.system.accelerator.op["default"].tflops * 1e12
+        return {
+            "iter_time": iter_time,
+            "mfu": per_chip / peak_flops,
+            "tgs": tokens / iter_time / st.world_size,
+            "max_peak_bytes": max_peak,
+            "fits_margin_bytes": cap - max_peak,
+            "usable_bytes": cap,
+        }
+
+    def _n_buckets(self, numel: float, group: int) -> int:
+        """Megatron DDP bucket count from the SAME sizing helper the
+        scalar path (and the simulator) use — one source, so a cap or
+        partial-bucket tweak can never desynchronize the engines.
+        Memoized: numel/group are layout constants re-queried per
+        score call."""
+        cache = getattr(self, "_bucket_counts", None)
+        if cache is None:
+            cache = self._bucket_counts = {}
+        key = (numel, group)
+        got = cache.get(key)
+        if got is None:
+            from simumax_tpu.core.utils import dp_comm_buckets
+
+            got = len(dp_comm_buckets(numel, group))
+            cache[key] = got
+        return got
+
+    def _dp_terms(self, stage: int, params: dict, mbc_a, ncand):
+        """Exposed (reduce-scatter, all-gather) DP comm per stage —
+        mirror of ``PerfLLM._compute_dp_time`` without the (unsupported)
+        overlap flags."""
+        st, m = self.st, self.model
+        zeros = np.zeros(ncand)
+        g_el = 2.0 if st.grad_reduce_in_bf16 else 4.0
+        p_el = st.element_size
+        rs = zeros
+        ag = zeros
+        dense_numel = params["dense_numel"]
+        moe_numel = params["moe_numel"]
+        for numel, dim, group in (
+            (dense_numel, "dp_cp", st.dp_size * st.cp_size),
+            (moe_numel, "edp", st.edp_size),
+        ):
+            if group <= 1 or not numel or st.zero_state >= 3:
+                continue
+            op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
+            nbuckets = self._n_buckets(numel, group)
+            k_rs, l_rs = self._coeffs(dim, op)
+            r = k_rs * (numel * g_el) + nbuckets * l_rs
+            if st.zero_state == 2:
+                r = r * mbc_a
+            rs = rs + r
+            if st.zero_state >= 1:
+                k_ag, l_ag = self._coeffs(dim, "all_gather")
+                ag = ag + k_ag * (numel * p_el) + nbuckets * l_ag
+        if (st.pp_size > 1 and not m.untie_embeddings
+                and stage in (0, st.pp_size - 1)):
+            emb_grad = (m.padded_vocab_size * m.hidden_size
+                        / st.tp_size * st.grad_element_size)
+            rs = rs + 2 * self._net_time("pp", "p2p", emb_grad)
+        return rs, ag
+
+    def _optim_time(self, params: dict) -> float:
+        """Mirror of ``PerfLLM._compute_optim_time`` (scalar: params are
+        layout-only)."""
+        st = self.st
+        sysc = self.system
+        numel = params["dense_numel"] + params["moe_numel"]
+        shard = numel / max(1, st.dp_size * st.cp_size) \
+            if st.zero_state else numel
+        if st.optimizer_style == "functional":
+            e = st.element_size
+            traffic = shard * (st.grad_element_size + 2 * e + 16)
+            return sysc.compute_mem_access_time(traffic,
+                                                bw_key="fused_adam")
+        t = 0.0
+        t += sysc.compute_mem_access_time(numel * st.grad_element_size)
+        t += sysc.compute_mem_access_time(shard * 4)
+        t += sysc.compute_mem_access_time(shard * 28)
+        t += sysc.compute_mem_access_time(shard * (4 + st.element_size))
+        return t
+
+
+# --------------------------------------------------------------------------
+# Cell-level engine: mirrors _evaluate_sweep_cell's selection walk
+# --------------------------------------------------------------------------
+
+
+class BatchedScorer:
+    """Per-sweep cache of family kernels + the cell-selection walk that
+    mirrors ``searcher._evaluate_sweep_cell`` decision-for-decision,
+    consulting batched scores instead of scalar estimates. The winning
+    candidate of each cell is returned as a (row, strategy, margin)
+    triple so the orchestrator can re-verify top-k rows with the scalar
+    oracle."""
+
+    #: strategy fields erased from the kernel-cache key (the candidate
+    #: axes the kernel vectorizes over)
+    BATCH_FIELDS = ("micro_batch_size", "micro_batch_num",
+                    "recompute_layer_num")
+
+    def __init__(self, model: ModelConfig, system: SystemConfig):
+        self.model = model
+        self.system = system
+        self._kernels: Dict[tuple, _Kernel] = {}
+        #: block-kind profile cache shared across family kernels (see
+        #: ``_Kernel._kind_key`` — profiles are pp/mbc-independent)
+        self._kind_cache: dict = {}
+        #: scoring telemetry (surfaced by bench_sweep --engine batched)
+        self.stats = {"score_calls": 0, "max_batch": 0,
+                      "candidates_scored": 0}
+
+    _KEY_GETTER = None  # operator.attrgetter over the non-batch fields
+
+    def kernel_for(self, st: StrategyConfig) -> _Kernel:
+        cls = type(self)
+        if cls._KEY_GETTER is None:
+            import dataclasses
+            import operator
+
+            names = [f.name for f in dataclasses.fields(StrategyConfig)
+                     if f.name not in self.BATCH_FIELDS]
+            cls._KEY_GETTER = operator.attrgetter(*names)
+        key = tuple(
+            (tuple(v) if isinstance(v, list) else v)
+            for v in cls._KEY_GETTER(st)
+        )
+        got = self._kernels.get(key)
+        if got is None:
+            got = _Kernel(st, self.model, self.system,
+                          shared_cache=self._kind_cache)
+            self._kernels[key] = got
+        return got
+
+    # -- rows --------------------------------------------------------------
+    def _row(self, st: StrategyConfig, kern: _Kernel, scores: dict,
+             i: int, gib_margin: float) -> dict:
+        fits = bool(
+            scores["max_peak_bytes"][i] + gib_margin * GiB
+            <= scores["usable_bytes"]
+        )
+        row = {
+            "tp": st.tp_size, "cp": st.cp_size,
+            "pp": st.pp_size, "dp": st.dp_size,
+            "ep": st.ep_size, "etp": st.etp_size,
+            "vp": st.vp_size,
+            "mbs": st.micro_batch_size,
+            "mbc": st.micro_batch_num,
+            "zero": st.zero_state,
+            "recompute": (
+                st.recompute.granularity
+                if st.recompute.enabled else "none"
+            ),
+            "recompute_layers": st.recompute_layer_num,
+            "mfu": float(scores["mfu"][i]),
+            "iter_ms": float(scores["iter_time"][i] * 1e3),
+            "tgs": float(scores["tgs"][i]),
+            "peak_gib": float(scores["max_peak_bytes"][i] / GiB),
+            "fits": fits,
+            "mem_margin_gib": float(
+                (scores["fits_margin_bytes"][i] - gib_margin * GiB) / GiB
+            ),
+            "net": {k: p.describe() for k, p in kern.paths.items()},
+            "dcn_dims": ",".join(
+                d for d, p in kern.paths.items() if p.on_dcn
+            ),
+            # one-line attributions need a built estimate; batched rows
+            # carry placeholders — the scalar re-verification of the
+            # top-k fills in the real lines (docs/search.md)
+            "attribution": "",
+            "mem_attribution": "",
+        }
+        if not fits:
+            row = {**row, "mfu": 0.0}
+        return row
+
+    def _score_batch(self, st: StrategyConfig, splits, nrc=None,
+                     cost_margin=None):
+        kern = self.kernel_for(st)
+        stats = self.stats
+        stats["score_calls"] += 1
+        stats["candidates_scored"] += len(splits)
+        if len(splits) > stats["max_batch"]:
+            stats["max_batch"] = len(splits)
+        scores = kern.score([s[0] for s in splits],
+                            [s[1] for s in splits], nrc=nrc,
+                            cost_margin=cost_margin)
+        return kern, scores
+
+    # -- the three family walks -------------------------------------------
+    def search_micro_batch_config(self, st: StrategyConfig,
+                                  global_batch_size: int,
+                                  gib_margin: float = 1.0):
+        dp = st.dp_size
+        if dp < 1 or global_batch_size % dp:
+            raise FeasibilityError(
+                f"global_batch_size {global_batch_size} does not divide "
+                f"over dp {dp}",
+                phase="search", global_batch_size=global_batch_size, dp=dp,
+            )
+        per_dp = global_batch_size // dp
+        splits = []
+        for mbs in range(1, per_dp + 1):
+            if per_dp % mbs:
+                continue
+            mbc = per_dp // mbs
+            if st.vp_size > 1 and mbc % st.vpp_group_size:
+                continue
+            splits.append((mbs, mbc))
+        if not splits:
+            return None
+        kern, scores = self._score_batch(st, splits,
+                                         cost_margin=gib_margin)
+        if scores is None:
+            return None
+        best = None
+        for i, (mbs, mbc) in enumerate(splits):
+            fits = bool(
+                scores["max_peak_bytes"][i] + gib_margin * GiB
+                <= scores["usable_bytes"]
+            )
+            if not fits:
+                continue
+            if best is None or scores["mfu"][i] > best[0]:
+                cand = clone_strategy(st)
+                cand.micro_batch_size = mbs
+                cand.micro_batch_num = mbc
+                best = (float(scores["mfu"][i]),
+                        self._row(cand, kern, scores, i, gib_margin),
+                        cand)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def search_selective(self, st: StrategyConfig):
+        from simumax_tpu.search.searcher import _SELECTIVE_COMBOS
+
+        best = None
+        for combo in _SELECTIVE_COMBOS:
+            cand = clone_strategy(st)
+            cand.enable_recompute = True
+            cand.recompute_granularity = "selective"
+            cand.recompute_layer_num = -1
+            for k, v in combo.items():
+                setattr(cand, k, v)
+            cand.__post_init__()
+            kern, scores = self._score_batch(
+                cand, [(cand.micro_batch_size, cand.micro_batch_num)],
+                cost_margin=0.0)
+            if scores is None:
+                continue
+            fits = bool(scores["max_peak_bytes"][0]
+                        <= scores["usable_bytes"])
+            if not fits:
+                continue
+            if best is None or scores["mfu"][0] > best[0]:
+                best = (float(scores["mfu"][0]),
+                        self._row(cand, kern, scores, 0, 0.0), cand)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def search_recompute_layers(self, st: StrategyConfig,
+                                model: ModelConfig):
+        layers_per_stage = -(-model.layer_num
+                             // (st.pp_size * st.vp_size))
+        probe = clone_strategy(st)
+        probe.enable_recompute = True
+        probe.recompute_granularity = "full_block"
+        probe.recompute_layer_num = -1
+        probe.__post_init__()
+        kern = self.kernel_for(probe)
+        # the n=0 probe is a no-recompute estimate in the scalar walk
+        # (enable_recompute = mid > 0); its numbers coincide with the
+        # full_block kernel at zero recomputed layers, but the winning
+        # row must carry recompute='none'.
+        # pp=1 folds are closed-form: score the whole layer range in one
+        # call; deeper pipelines probe lazily along the bisection (a
+        # replay per probed count, not per possible count)
+        stats = self.stats
+
+        def _scored(n):
+            stats["score_calls"] += 1
+            stats["candidates_scored"] += n
+            if n > stats["max_batch"]:
+                stats["max_batch"] = n
+
+        if st.pp_size == 1:
+            all_n = list(range(0, layers_per_stage + 1))
+            _scored(len(all_n))
+            scores = kern.score(
+                [st.micro_batch_size] * len(all_n),
+                [st.micro_batch_num] * len(all_n),
+                nrc=all_n, cost_margin=0.0,
+            )
+            if scores is None:
+                return None
+
+            def probe_at(mid):
+                return scores, mid
+        else:
+            first = kern.score([st.micro_batch_size],
+                               [st.micro_batch_num], nrc=[0],
+                               cost_margin=0.0)
+            _scored(1)
+            if first is None:
+                return None
+            cache = {0: first}
+
+            def probe_at(mid):
+                got = cache.get(mid)
+                if got is None:
+                    _scored(1)
+                    got = kern.score([st.micro_batch_size],
+                                     [st.micro_batch_num], nrc=[mid],
+                                     cost_margin=0.0)
+                    cache[mid] = got
+                return got, 0
+        lo, hi = 0, layers_per_stage
+        best = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            sc, i = probe_at(mid)
+            fits = bool(sc["max_peak_bytes"][i] <= sc["usable_bytes"])
+            if fits:
+                cand = clone_strategy(st)
+                cand.enable_recompute = mid > 0
+                cand.recompute_granularity = "full_block"
+                cand.recompute_layer_num = mid
+                cand.__post_init__()
+                best = (self._row(cand, kern, sc, i, 0.0), cand)
+                hi = mid - 1
+            else:
+                lo = mid + 1
+        return best
+
+    def evaluate_cell(self, st: StrategyConfig, rc_family: str,
+                      model: ModelConfig, global_batch_size: int):
+        """Mirror of ``searcher._evaluate_sweep_cell``. Returns
+        ``(row, strategy, gib_margin)`` or ``None`` (empty cell);
+        raises :class:`UnsupportedBatched` for configurations outside
+        the lowering surface (caller falls back to the scalar path) and
+        the same ``FeasibilityError`` the scalar walk raises."""
+        if st.dp_size < 1 or global_batch_size % st.dp_size:
+            raise FeasibilityError(
+                f"global_batch_size {global_batch_size} does not divide "
+                f"over dp {st.dp_size}: no (mbs, mbc) split reproduces it",
+                phase="search", global_batch_size=global_batch_size,
+                dp=st.dp_size,
+            )
+        st_rc = clone_strategy(st)
+        if rc_family == "none":
+            st_rc.enable_recompute = False
+            st_rc.__post_init__()
+            got = self.search_micro_batch_config(
+                st_rc, global_batch_size, gib_margin=1.0)
+            if got is None:
+                return None
+            return got[0], got[1], 1.0
+        if rc_family == "selective":
+            st_rc.enable_recompute = True
+            st_rc.recompute_granularity = "selective"
+            st_rc.recompute_layer_num = -1
+            st_rc.sdp_recompute = True
+            st_rc.__post_init__()
+            base = self.search_micro_batch_config(
+                st_rc, global_batch_size, gib_margin=1.0)
+            if base is not None:
+                st_rc.micro_batch_size = base[1].micro_batch_size
+                st_rc.micro_batch_num = base[1].micro_batch_num
+            else:
+                st_rc.micro_batch_size = 1
+                st_rc.micro_batch_num = \
+                    global_batch_size // st.dp_size
+            got = self.search_selective(st_rc)
+            if got is None:
+                return None
+            return got[0], got[1], 0.0
+        if rc_family == "full_block":
+            st_rc.micro_batch_size = 1
+            st_rc.micro_batch_num = global_batch_size // st.dp_size
+            got = self.search_recompute_layers(st_rc, model)
+            if got is None:
+                return None
+            return got[0], got[1], 0.0
+        from simumax_tpu.core.config import ConfigError
+
+        raise ConfigError(f"unknown recompute family {rc_family!r}",
+                          phase="search")

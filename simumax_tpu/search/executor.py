@@ -88,6 +88,45 @@ class _Env:
     #: discrete-event ``sim_ms`` cross-check; a SimulationError
     #: quarantines the cell like any other candidate failure
     simulate: bool = False
+    #: "scalar" walks the PerfLLM object graph per candidate; "batched"
+    #: scores the cell's candidate batch with the vectorized kernel
+    #: (``search/batched.py``) and falls back to the scalar path per
+    #: cell when the kernel does not model the configuration
+    engine: str = "scalar"
+
+
+#: per-process cache of BatchedScorer instances (the kernels hold
+#: unpicklable closures, so each pool worker builds its own lazily)
+_SCORERS: dict = {}
+
+
+def _batched_scorer(model, system):
+    from simumax_tpu.search.batched import BatchedScorer
+    from simumax_tpu.search.searcher import _model_system_key
+
+    key = _model_system_key(model, system)
+    got = _SCORERS.get(key)
+    if got is None:
+        if len(_SCORERS) > 2:
+            _SCORERS.clear()
+        got = BatchedScorer(model, system)
+        _SCORERS[key] = got
+    return got
+
+
+def _strategy_spec(base, strategy, gib_margin: float) -> dict:
+    """JSON-safe reconstruction recipe of a batched row's exact winning
+    candidate: the strategy fields differing from the sweep's base plus
+    the feasibility margin its family used — enough for the scalar
+    oracle to re-verify the row (``searcher`` top-k verification)."""
+    import dataclasses
+
+    fields = {}
+    for f in dataclasses.fields(type(strategy)):
+        a, b = getattr(strategy, f.name), getattr(base, f.name)
+        if a != b:
+            fields[f.name] = a
+    return {"fields": fields, "gib_margin": gib_margin}
 
 
 def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
@@ -104,11 +143,45 @@ def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
         with _searcher._candidate_deadline(
             env.candidate_timeout, cell.key, diagnostics=diagnostics
         ):
-            row = _searcher._evaluate_sweep_cell(
-                st, cell.rc, env.model, env.system,
-                env.global_batch_size, cache, env.project_dualpp,
-                simulate=env.simulate,
-            )
+            row = None
+            batched_done = False
+            if env.engine == "batched":
+                from simumax_tpu.search.batched import UnsupportedBatched
+
+                scorer = _batched_scorer(env.model, env.system)
+                stats_before = dict(scorer.stats)
+                try:
+                    got = scorer.evaluate_cell(
+                        st, cell.rc, env.model, env.global_batch_size
+                    )
+                    batched_done = True
+                except UnsupportedBatched:
+                    batched_done = False  # scalar fallback below
+                if batched_done:
+                    diagnostics.count("sweep_cells_batched")
+                    # per-cell scoring-telemetry deltas: additive so the
+                    # pool merge (and the serial path) can sum them;
+                    # max_batch keeps max semantics via _merge_counters
+                    for k, v in scorer.stats.items():
+                        key = f"sweep_batched_{k}"
+                        if k == "max_batch":
+                            diagnostics.counters[key] = max(
+                                diagnostics.counters.get(key, 0), v)
+                        else:
+                            delta = v - stats_before.get(k, 0)
+                            if delta:
+                                diagnostics.count(key, delta)
+                if batched_done and got is not None:
+                    row, strategy, margin = got
+                    row["strategy_spec"] = _strategy_spec(
+                        env.base_strategy, strategy, margin
+                    )
+            if not batched_done:
+                row = _searcher._evaluate_sweep_cell(
+                    st, cell.rc, env.model, env.system,
+                    env.global_batch_size, cache, env.project_dualpp,
+                    simulate=env.simulate,
+                )
     except Exception as exc:  # quarantine upstream, keep sweeping
         err = {
             "error_type": type(exc).__name__,
@@ -135,16 +208,20 @@ def run_cells(
     jobs: int = 1,
     on_done: Optional[Callable[[CellOutcome], None]] = None,
     simulate: bool = False,
+    engine: str = "scalar",
 ) -> Dict[int, CellOutcome]:
     """Evaluate every cell; returns {cell.idx: CellOutcome}.
 
     ``on_done`` fires as each cell finishes (journal checkpoint hook) —
     completion order in pool mode, grid order serially. ``jobs <= 1``
-    (or a single cell) runs serially on the calling thread."""
+    (or a single cell) runs serially on the calling thread.
+    ``engine="batched"`` scores cells with the vectorized kernel,
+    falling back to the scalar path per cell for configurations the
+    kernel does not lower."""
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     env = _Env(base_strategy, model, system, global_batch_size,
-               project_dualpp, candidate_timeout, simulate)
+               project_dualpp, candidate_timeout, simulate, engine)
     jobs = max(1, int(jobs or 1))
     if jobs > 1 and len(cells) > 1:
         return _run_cells_pool(cells, env, cache, diagnostics, jobs, on_done)
@@ -225,7 +302,8 @@ def _pool_worker_eval(cell: SweepCell):
         {k: set(v) for k, v in diag._eff_misses.items()},
     )
     events = [e.to_dict() for e in diag.events]
-    return cell.idx, status, row, err, diag_err, fresh, coverage, events
+    return (cell.idx, status, row, err, diag_err, fresh, coverage,
+            events, dict(diag.counters))
 
 
 def _mp_context():
@@ -269,10 +347,19 @@ def _run_cells_pool(cells, env, cache, diagnostics, jobs, on_done):
             on_done(out)
 
     def collect(cell, result):
-        _, status, row, err, diag_err, fresh, coverage, events = result
+        (_, status, row, err, diag_err, fresh, coverage, events,
+         counters) = result
         cache.update(fresh)
         diagnostics.merge_coverage(*coverage)
         diagnostics.merge_events(events)
+        # worker counters are per-cell deltas (additive), except the
+        # *_max_batch high-water mark
+        for k, v in counters.items():
+            if k.endswith("max_batch"):
+                diagnostics.counters[k] = max(
+                    diagnostics.counters.get(k, 0), v)
+            else:
+                diagnostics.count(k, v)
         finish(cell, status, row, err, diag_err)
 
     while pending:

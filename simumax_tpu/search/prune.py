@@ -62,12 +62,25 @@ class SweepCell:
     rc: str
 
 
+def clone_strategy(st: StrategyConfig) -> StrategyConfig:
+    """Cheap strategy clone for sweep plumbing: shallow copy +
+    ``__post_init__`` (rebuilds the derived ``recompute`` config from
+    the unchanged flags). Equivalent to ``copy.deepcopy`` for the sweep
+    walks — they only reassign scalar fields — at a fraction of the
+    cost (a deepcopy per grid cell was a measured sweep hotspot)."""
+    new = copy.copy(st)
+    if st.megatron_recompute_modules is not None:
+        new.megatron_recompute_modules = list(st.megatron_recompute_modules)
+    new.__post_init__()
+    return new
+
+
 def make_cell_strategy(
     base: StrategyConfig, tp: int, cp: int, ep: int, pp: int, zero: int
 ) -> StrategyConfig:
     """The candidate strategy for one grid layout — the single source
     for both the serial loop and pool workers, so they cannot diverge."""
-    st = copy.deepcopy(base)
+    st = clone_strategy(base)
     st.tp_size, st.cp_size = tp, cp
     st.ep_size, st.pp_size = ep, pp
     st.zero_state = zero
@@ -182,6 +195,26 @@ def pruned_row(st: StrategyConfig, rc: str, reason: str,
     return row
 
 
+def deduped_row(st: StrategyConfig, rc: str, kept_key: str) -> dict:
+    """A CSV-compatible ``status=deduped`` row for a grid cell whose
+    *effective* layout (after normalization) coincides with an earlier
+    cell's — the earlier cell is the one evaluated; ``dedup_of`` names
+    it. In practice this fires for duplicate/overlapping sweep-list
+    entries (programmatically composed lists, re-run unions): the
+    itertools product of unique per-dim values cannot collide."""
+    row = base_cell_row(st, rc, "deduped")
+    row["dedup_of"] = kept_key
+    return row
+
+
+def effective_layout_key(st: StrategyConfig, rc: str) -> tuple:
+    """The normalized layout identity two grid cells are considered
+    duplicates under: every field ``make_cell_strategy`` may have
+    normalized differently than requested, plus the recompute family."""
+    return (st.tp_size, st.cp_size, st.ep_size, st.pp_size,
+            st.zero_state, st.etp_size, rc)
+
+
 def enumerate_cells(
     base_strategy: StrategyConfig,
     model: ModelConfig,
@@ -194,17 +227,28 @@ def enumerate_cells(
     zero_list: Sequence[int],
     recompute_types: Sequence[str],
     prune: bool = True,
-) -> Tuple[List[SweepCell], List[dict]]:
-    """Expand the sweep grid into (cells to evaluate, pruned rows).
+) -> Tuple[List[SweepCell], List[dict], List[dict]]:
+    """Expand the sweep grid into (cells to evaluate, pruned rows,
+    deduped rows).
+
+    Cells whose *effective* layout after normalization duplicates an
+    earlier cell's are recorded as ``status=deduped`` CSV rows instead
+    of being scheduled — they could only ever reproduce the earlier
+    cell's row, and skipping them up front keeps journaled resume and
+    ``--jobs N`` merges bit-identical (the duplicate never races the
+    original for a journal slot).
 
     With ``prune=False`` the divisibility guards still skip impossible
     layouts (exactly the historical sweep behavior — they could never
-    produce a row) but nothing is recorded and the memory bound is not
-    applied, so the evaluated cell set matches the legacy sweep
+    produce a row) but nothing is recorded, the memory bound is not
+    applied, and duplicates are evaluated as the legacy sweep always
+    evaluated them, so the cell set matches the legacy sweep
     bit-for-bit."""
     world = base_strategy.world_size
     cells: List[SweepCell] = []
     pruned: List[dict] = []
+    deduped: List[dict] = []
+    seen_layouts: dict = {}
     idx = 0
     for tp, cp, ep, pp, zero in itertools.product(
         tp_list, cp_list, ep_list, pp_list, zero_list
@@ -234,9 +278,15 @@ def enumerate_cells(
         for rc in recompute_types:
             key = f"tp{tp}_cp{cp}_ep{ep}_pp{pp}_z{zero}_{rc}"
             if reason is None:
+                norm = effective_layout_key(st, rc)
+                kept = seen_layouts.get(norm)
+                if prune and kept is not None:
+                    deduped.append(deduped_row(st, rc, kept))
+                    continue
+                seen_layouts.setdefault(norm, key)
                 cells.append(SweepCell(idx, key, tp, cp, ep, pp, zero, rc))
                 idx += 1
             elif prune:
                 pruned.append(pruned_row(st, rc, reason, bound_bytes=bound,
                                          usable_bytes=usable))
-    return cells, pruned
+    return cells, pruned, deduped

@@ -38,6 +38,7 @@ from simumax_tpu.perf import PerfLLM
 from simumax_tpu.search.executor import BoundedCache, run_cells
 from simumax_tpu.search.prune import (
     base_cell_row,
+    clone_strategy,
     enumerate_cells,
     make_cell_strategy,
 )
@@ -640,6 +641,8 @@ def search_best_parallel_strategy(
     jobs: int = 1,
     prune: bool = True,
     simulate: bool = False,
+    engine: str = "scalar",
+    verify_topk: Optional[int] = None,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
@@ -669,9 +672,29 @@ def search_best_parallel_strategy(
     (``sim_ms`` cross-check column on fitting rows); a cell whose
     schedule replay raises ``SimulationError`` is quarantined as a
     ``status=error`` CSV row exactly like a candidate timeout — never a
-    sweep abort."""
+    sweep abort.
+
+    ``engine="batched"`` scores every cell's candidate batch with the
+    vectorized cost kernel (``search/batched.py``) instead of walking a
+    ``PerfLLM`` object graph per candidate, then re-verifies the top
+    ``verify_topk`` ranked rows (default: ``topk``) with the scalar
+    oracle — the returned top-k rows are exact scalar rows. Cells the
+    kernel does not model silently fall back to the scalar path
+    (documented in ``docs/search.md``); ``project_dualpp`` / ``simulate``
+    sweeps fall back entirely (both need the built estimate)."""
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
+    if engine not in ("scalar", "batched"):
+        raise ConfigError(f"unknown search engine {engine!r}",
+                          phase="search")
+    if engine == "batched" and (project_dualpp or simulate):
+        diagnostics.warn(
+            "search",
+            "engine='batched' does not support project_dualpp/simulate "
+            "(both need the built scalar estimate); using the scalar "
+            "engine",
+        )
+        engine = "scalar"
     # run identity for the journal: everything a cell row depends on
     # besides the swept dims themselves — model, hardware fingerprint,
     # batch size, and every estimate-relevant base-strategy field the
@@ -679,6 +702,10 @@ def search_best_parallel_strategy(
     # json round-trip so the comparison against a loaded header is
     # apples-to-apples (tuples become lists, etc.)
     identity_extra = {"simulate": True} if simulate else {}
+    if engine != "scalar":
+        # batched rows differ from scalar rows in last-ulp floats and
+        # placeholder attribution columns: refuse cross-engine resume
+        identity_extra["engine"] = engine
     identity = json.loads(json.dumps({
         **identity_extra,
         "model": model.model_name,
@@ -726,7 +753,7 @@ def search_best_parallel_strategy(
     # grid expansion + dominance / memory-lower-bound pruning: cells
     # carry a deterministic grid index so results merge back in the
     # same order serial evaluation would have produced them
-    cells, pruned_rows = enumerate_cells(
+    cells, pruned_rows, deduped_rows = enumerate_cells(
         base_strategy, model, system, global_batch_size,
         tp_list, cp_list, ep_list, pp_list, zero_list, recompute_types,
         prune=prune,
@@ -746,8 +773,10 @@ def search_best_parallel_strategy(
             replayed[cell.idx] = prior
         else:
             to_run.append(cell)
-    diagnostics.count("sweep_cells_total", len(cells) + len(pruned_rows))
+    diagnostics.count("sweep_cells_total",
+                      len(cells) + len(pruned_rows) + len(deduped_rows))
     diagnostics.count("sweep_cells_pruned", len(pruned_rows))
+    diagnostics.count("sweep_cells_deduped", len(deduped_rows))
     diagnostics.count("sweep_cells_replayed", len(replayed))
     diagnostics.count("sweep_cells_evaluated", len(to_run))
     diagnostics.counters["sweep_jobs"] = max(1, int(jobs or 1))
@@ -809,7 +838,7 @@ def search_best_parallel_strategy(
                 project_dualpp=project_dualpp,
                 candidate_timeout=candidate_timeout,
                 cache=cache, diagnostics=diagnostics, jobs=jobs,
-                on_done=_checkpoint, simulate=simulate,
+                on_done=_checkpoint, simulate=simulate, engine=engine,
             )
     finally:
         if journal:
@@ -847,8 +876,16 @@ def search_best_parallel_strategy(
         uniq.append(r)
     rows = uniq
     rows.sort(key=lambda r: r["mfu"], reverse=True)
+    if engine == "batched":
+        _verify_topk_rows(
+            rows, base_strategy, model, system,
+            topk if verify_topk is None else verify_topk,
+            cache, diagnostics,
+        )
+        for r in rows:
+            r.pop("strategy_spec", None)
     if csv_path:
-        csv_rows = rows + quarantine + pruned_rows
+        csv_rows = rows + quarantine + pruned_rows + deduped_rows
         fields: List[str] = []
         for r in csv_rows:
             for k in r:
@@ -859,6 +896,49 @@ def search_best_parallel_strategy(
             w.writeheader()
             w.writerows(csv_rows)
     return rows[:topk]
+
+
+def _verify_topk_rows(rows, base_strategy, model, system, k,
+                      cache, diagnostics):
+    """Re-evaluate the top ``k`` ranked batched rows with the scalar
+    oracle (``evaluate_strategy``) and replace them in place, so the
+    rows a batched sweep returns are exact scalar rows (attribution
+    lines included). Each batched row carries a ``strategy_spec``
+    reconstruction recipe (``executor._strategy_spec``); rows without
+    one came from a scalar-fallback cell and are already exact. A
+    disagreement (the oracle says the candidate does not fit or is
+    invalid) is recorded as a diagnostics error and the batched row is
+    kept — with the 1e-9 score parity contract this is a should-never
+    guard, not an expected path."""
+    build_cache = BoundedCache(maxsize=BUILD_CACHE_MAX)
+    verified = 0
+    for i in range(min(k, len(rows))):
+        spec = rows[i].get("strategy_spec")
+        if not spec:
+            continue
+        st = clone_strategy(base_strategy)
+        for name, value in spec["fields"].items():
+            setattr(st, name, value)
+        st.__post_init__()
+        vrow = evaluate_strategy(
+            st, model, system, cache=cache,
+            gib_margin=spec.get("gib_margin", 0.0),
+            build_cache=build_cache,
+        )
+        if vrow is not None and vrow.get("fits"):
+            vrow["status"] = "ok"
+            rows[i] = vrow
+            verified += 1
+        else:
+            diagnostics.error(
+                "batched_verify",
+                "scalar oracle disagrees with a batched top-k row "
+                "(keeping the batched row)",
+                candidate=f"tp{st.tp_size}_cp{st.cp_size}_ep{st.ep_size}"
+                          f"_pp{st.pp_size}_z{st.zero_state}",
+                mbs=st.micro_batch_size, mbc=st.micro_batch_num,
+            )
+    diagnostics.count("sweep_rows_verified", verified)
 
 
 def _quarantine_row(st, rc: str, err: dict) -> dict:

@@ -1,0 +1,460 @@
+"""Batched vectorized cost kernel (search/batched.py) parity tests.
+
+The scalar ``PerfLLM`` path is the oracle: every number the batched
+engine ranks on must match the scalar estimate within 1e-9 relative,
+and the engine's selection walk must reproduce the scalar sweep's
+decisions bit-for-bit (top-k ordering, pruned/quarantined/deduped CSV
+row sets). See docs/search.md "Batched cost kernel".
+"""
+
+import copy
+import csv
+import random
+
+import pytest
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.core.records import Diagnostics
+from simumax_tpu.perf import PerfLLM
+from simumax_tpu.search import search_best_parallel_strategy
+from simumax_tpu.search.batched import (
+    BatchedScorer,
+    UnsupportedBatched,
+    fold_1f1b,
+)
+
+
+def _rel_close(a, b, tol=1e-9):
+    return abs(a - b) <= tol * max(1.0, abs(a), abs(b))
+
+
+def _base(world=8, **overrides):
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = world
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    st.__post_init__()
+    return st
+
+
+def _scalar_scores(st, model, system):
+    perf = PerfLLM().configure(copy.deepcopy(st), model, system)
+    perf.run_estimate()
+    mem = perf.analysis_mem()
+    cost = perf.analysis_cost()
+    return {
+        "iter_time": cost["iter_time"],
+        "mfu": cost["mfu"],
+        "tgs": cost["tgs"],
+        "max_peak_bytes": mem["max_peak_bytes"],
+        "fits_margin_bytes": mem["fits_margin_bytes"],
+    }
+
+
+def _assert_candidate_parity(model_name, system_name, world, cases):
+    model = get_model_config(model_name)
+    system = get_system_config(system_name)
+    scorer = BatchedScorer(model, system)
+    checked = 0
+    for spec in cases:
+        st = _base(world, **spec)
+        kern = scorer.kernel_for(st)
+        scores = kern.score([st.micro_batch_size], [st.micro_batch_num])
+        if scores is None:
+            # family invalid: the scalar path must reject it too
+            with pytest.raises(Exception):
+                _scalar_scores(st, model, system)
+            continue
+        ref = _scalar_scores(st, model, system)
+        for key, want in ref.items():
+            got = float(scores[key][0])
+            assert _rel_close(got, want), (
+                f"{model_name} {spec}: {key} batched={got!r} "
+                f"scalar={want!r}"
+            )
+        checked += 1
+    assert checked >= len(cases) // 2
+
+
+# --------------------------------------------------------------------------
+# Per-candidate score parity: batched == scalar estimate() within 1e-9
+# --------------------------------------------------------------------------
+
+
+class TestScoreParity:
+    def test_dense_grid(self):
+        cases = []
+        for tp in (1, 2, 4):
+            for pp in (1, 2):
+                for zero in (0, 1, 2, 3):
+                    cases.append(dict(tp_size=tp, pp_size=pp,
+                                      zero_state=zero))
+        cases += [
+            dict(tp_size=2, pp_size=2, micro_batch_size=2,
+                 micro_batch_num=4),
+            dict(tp_size=1, pp_size=2, enable_recompute=True,
+                 recompute_granularity="full_block",
+                 recompute_layer_num=1),
+            dict(tp_size=2, pp_size=1, enable_recompute=True,
+                 recompute_granularity="selective", sdp_recompute=True),
+            dict(tp_size=2, pp_size=2, zero_state=3,
+                 enable_recompute=True,
+                 recompute_granularity="selective", sdp_recompute=True,
+                 attn_recompute=True, attn_norm_recompute=True,
+                 mlp_recompute=True, mlp_rms_recompute=True),
+            dict(tp_size=2, pp_size=1, enable_sequence_parallel=False),
+            dict(tp_size=2, pp_size=2, optimizer_style="functional",
+                 enable_straggler_model=True),
+        ]
+        _assert_candidate_parity("llama2-tiny", "tpu_v5e_256", 8, cases)
+
+    def test_moe_grid(self):
+        cases = []
+        for tp in (1, 2):
+            for pp in (1, 2):
+                for ep in (1, 2, 4):
+                    cases.append(dict(tp_size=tp, pp_size=pp,
+                                      ep_size=ep))
+        cases += [
+            dict(tp_size=1, pp_size=2, ep_size=4, enable_recompute=True,
+                 recompute_granularity="full_block",
+                 recompute_layer_num=2),
+            dict(tp_size=2, pp_size=1, ep_size=2, zero_state=2),
+            dict(tp_size=2, pp_size=1, ep_size=2, enable_recompute=True,
+                 recompute_granularity="selective", sdp_recompute=True,
+                 mlp_recompute=True),
+            dict(tp_size=1, pp_size=1, ep_size=2,
+                 group_linear_mode="sequential"),
+        ]
+        _assert_candidate_parity("mixtral-8x1b", "tpu_v5e_256", 8, cases)
+
+    def test_mla_grid(self):
+        # deepseekv2-lite: MLA (no q_lora) + MoE + shared expert;
+        # 27 layers => pp in (1, 3)
+        cases = [
+            dict(tp_size=1, pp_size=1, ep_size=4),
+            dict(tp_size=2, pp_size=1, ep_size=2),
+            dict(tp_size=2, pp_size=3, ep_size=4),
+            dict(tp_size=1, pp_size=3, ep_size=1, zero_state=3),
+            dict(tp_size=2, pp_size=3, ep_size=2, enable_recompute=True,
+                 recompute_granularity="selective", attn_recompute=True,
+                 attn_norm_recompute=True),
+            dict(tp_size=1, pp_size=3, ep_size=4, enable_recompute=True,
+                 recompute_granularity="full_block",
+                 recompute_layer_num=3),
+        ]
+        _assert_candidate_parity("deepseekv2-lite", "tpu_v5e_256", 12,
+                                 cases)
+
+    def test_mla_q_lora_and_tied_embeddings(self):
+        model = get_model_config("deepseekv2")
+        system = get_system_config("tpu_v5p_256")
+        scorer = BatchedScorer(model, system)
+        st = _base(16, tp_size=2, pp_size=2, ep_size=2)
+        kern = scorer.kernel_for(st)
+        ref = _scalar_scores(st, model, system)
+        scores = kern.score([1], [8])
+        for key, want in ref.items():
+            assert _rel_close(float(scores[key][0]), want), key
+
+        tied = get_model_config("llama2-tiny")
+        tied.untie_embeddings = False
+        system_e = get_system_config("tpu_v5e_256")
+        scorer2 = BatchedScorer(tied, system_e)
+        st2 = _base(8, tp_size=2, pp_size=2, zero_state=2)
+        scores2 = scorer2.kernel_for(st2).score([1], [8])
+        ref2 = _scalar_scores(st2, tied, system_e)
+        for key, want in ref2.items():
+            assert _rel_close(float(scores2[key][0]), want), key
+
+    def test_mbs_batch_axis_matches_per_candidate_calls(self):
+        """One score() call over a candidate batch must equal scoring
+        each candidate alone (the batch axis changes nothing)."""
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        scorer = BatchedScorer(model, system)
+        st = _base(8, tp_size=2, pp_size=2)
+        kern = scorer.kernel_for(st)
+        batch = kern.score([1, 2, 4], [8, 4, 2])
+        for i, (mbs, mbc) in enumerate([(1, 8), (2, 4), (4, 2)]):
+            single = kern.score([mbs], [mbc])
+            for key in ("iter_time", "mfu", "max_peak_bytes"):
+                assert float(batch[key][i]) == float(single[key][0])
+
+
+# --------------------------------------------------------------------------
+# 1F1B fold == the scalar event-matched replay
+# --------------------------------------------------------------------------
+
+
+class TestFold1F1B:
+    def _replay(self, pp, mbc, phases, p2p_async):
+        import types
+
+        perf = PerfLLM.__new__(PerfLLM)
+        perf.strategy = types.SimpleNamespace(
+            pp_size=pp, micro_batch_num=mbc, pp_comm_async=p2p_async)
+        res = perf.calculate_1f1b_bubble(phases)
+        return res["total"], res["per_stage_end"]
+
+    def test_fold_matches_replay_fuzz(self):
+        rng = random.Random(1234)
+        for _ in range(200):
+            pp = rng.choice([2, 3, 4, 8])
+            mbc = rng.randint(1, 24)
+            asy = rng.random() < 0.5
+            phases = [
+                dict(fwd=rng.uniform(0.01, 10.0),
+                     bwd=rng.uniform(0.01, 10.0),
+                     p2p=rng.uniform(0.0, 3.0))
+                for _ in range(pp)
+            ]
+            p2p = phases[0]["p2p"]
+            for ph in phases:
+                ph["p2p"] = p2p  # replay uses per-stage, fold one value
+            want_total, want_ends = self._replay(pp, mbc, phases, asy)
+            got_total, got_ends = fold_1f1b(
+                pp, mbc, [p["fwd"] for p in phases],
+                [p["bwd"] for p in phases], p2p, asy)
+            assert got_total == want_total
+            assert got_ends == want_ends
+
+
+# --------------------------------------------------------------------------
+# Engine-level parity: whole sweeps, both engines
+# --------------------------------------------------------------------------
+
+
+def _run_engine(engine, model, system, base, gbs, csv_path, **lists):
+    diag = Diagnostics()
+    rows = search_best_parallel_strategy(
+        copy.deepcopy(base), model, system, gbs,
+        topk=5, csv_path=str(csv_path), diagnostics=diag,
+        engine=engine, **lists,
+    )
+    return rows, diag
+
+
+def _csv_rows(path):
+    with open(path) as f:
+        return list(csv.DictReader(f))
+
+
+def _row_key(r):
+    return (r["tp"], r["cp"], r["ep"], r["pp"], r["zero"], r["mbs"],
+            r["mbc"], r["recompute"], r["recompute_layers"])
+
+
+class TestEngineParity:
+    GRID = dict(tp_list=(1, 2, 4), pp_list=(1, 2), zero_list=(1, 3))
+
+    def _compare(self, tmp_path, model_name, system_name, world, gbs,
+                 **lists):
+        model = get_model_config(model_name)
+        system = get_system_config(system_name)
+        base = _base(world)
+        rows_s, _ = _run_engine("scalar", model, system, base, gbs,
+                                tmp_path / "s.csv", **lists)
+        rows_b, diag_b = _run_engine("batched", model, system, base, gbs,
+                                     tmp_path / "b.csv", **lists)
+        # identical top-k ordering
+        key = lambda r: (r["tp"], r["cp"], r["ep"], r["pp"], r["zero"],
+                         r["mbs"], r["mbc"], r["recompute"],
+                         r["recompute_layers"])
+        assert [key(r) for r in rows_s] == [key(r) for r in rows_b]
+        # the verified top-k rows are exact scalar rows
+        for a, b in zip(rows_s, rows_b):
+            for metric in ("mfu", "iter_ms", "tgs", "peak_gib",
+                           "mem_margin_gib"):
+                assert a[metric] == b[metric], metric
+            assert a["attribution"] == b["attribution"]
+        cs, cb = _csv_rows(tmp_path / "s.csv"), _csv_rows(tmp_path / "b.csv")
+        for status in ("pruned", "deduped", "error"):
+            sel_s = sorted(
+                (_row_key(r), r.get("prune_reason", ""),
+                 r.get("error_type", ""))
+                for r in cs if r.get("status") == status
+            )
+            sel_b = sorted(
+                (_row_key(r), r.get("prune_reason", ""),
+                 r.get("error_type", ""))
+                for r in cb if r.get("status") == status
+            )
+            assert sel_s == sel_b, f"{status} row sets differ"
+        # every non-pruned cell's winning row matches within 1e-9
+        ok_s = {_row_key(r): r for r in cs
+                if r.get("status", "ok") in ("", "ok")}
+        ok_b = {_row_key(r): r for r in cb
+                if r.get("status", "ok") in ("", "ok")}
+        assert set(ok_s) == set(ok_b)
+        for k in ok_s:
+            for metric in ("mfu", "iter_ms", "tgs", "peak_gib",
+                           "mem_margin_gib"):
+                a, b = float(ok_s[k][metric]), float(ok_b[k][metric])
+                assert _rel_close(a, b), (k, metric, a, b)
+        assert not diag_b.errors
+        return rows_b, diag_b
+
+    def test_dense(self, tmp_path):
+        rows, diag = self._compare(
+            tmp_path, "llama2-tiny", "tpu_v5e_256", 8, 16, **self.GRID)
+        assert rows
+        assert diag.counters.get("sweep_rows_verified") == min(5, len(rows))
+
+    def test_moe(self, tmp_path):
+        self._compare(
+            tmp_path, "mixtral-8x1b", "tpu_v5e_256", 8, 8,
+            tp_list=(1, 2), pp_list=(1, 2), ep_list=(1, 2, 4),
+            zero_list=(1,),
+        )
+
+    def test_mla(self, tmp_path):
+        self._compare(
+            tmp_path, "deepseekv2-lite", "tpu_v5e_256", 12, 12,
+            tp_list=(1, 2), pp_list=(1, 3), ep_list=(1, 4),
+            zero_list=(1,),
+        )
+
+    def test_dense_pp4(self, tmp_path):
+        # pp=4 exercises the deeper 1F1B fold in-engine
+        self._compare(
+            tmp_path, "llama2-tiny", "tpu_v5e_256", 16, 16,
+            tp_list=(1, 4), pp_list=(1, 2), zero_list=(1,),
+        )
+
+
+class TestFallbacks:
+    def test_vpp_cells_fall_back_to_scalar(self, tmp_path):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        base = _base(8, interleaving_size=2)
+        lists = dict(tp_list=(1, 2), pp_list=(2,), zero_list=(1,))
+        rows_s, _ = _run_engine("scalar", model, system, base, 16,
+                                tmp_path / "s.csv", **lists)
+        rows_b, diag_b = _run_engine("batched", model, system, base, 16,
+                                     tmp_path / "b.csv", **lists)
+        assert [_row_key_live(r) for r in rows_s] == \
+            [_row_key_live(r) for r in rows_b]
+        # whole-cell fallback: nothing was batched
+        assert not diag_b.counters.get("sweep_cells_batched")
+        # fallback rows are scalar rows — identical floats
+        for a, b in zip(rows_s, rows_b):
+            assert a["mfu"] == b["mfu"]
+
+    def test_dualpp_falls_back_with_warning(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        diag = Diagnostics()
+        rows = search_best_parallel_strategy(
+            _base(8), model, system, 8,
+            tp_list=(1,), pp_list=(2,), zero_list=(1,),
+            topk=2, diagnostics=diag, engine="batched",
+            project_dualpp=True,
+        )
+        assert rows and "dualpp_mfu" in rows[0]
+        assert any("batched" in w.message for w in diag.warnings)
+
+    def test_unknown_engine_rejected(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        from simumax_tpu.core.config import ConfigError
+
+        with pytest.raises(ConfigError):
+            search_best_parallel_strategy(
+                _base(8), model, system, 8,
+                tp_list=(1,), pp_list=(1,), zero_list=(1,),
+                engine="warp-drive",
+            )
+
+    def test_unsupported_feature_raises_for_kernel(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        scorer = BatchedScorer(model, system)
+        st = _base(8, cp_size=2, tp_size=1)
+        with pytest.raises(UnsupportedBatched):
+            scorer.kernel_for(st)
+
+
+def _row_key_live(r):
+    return (r["tp"], r["cp"], r["ep"], r["pp"], r["zero"], r["mbs"],
+            r["mbc"], r["recompute"], r["recompute_layers"])
+
+
+class TestDedup:
+    def test_duplicate_grid_entries_become_deduped_rows(self, tmp_path):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        base = _base(8)
+        diag = Diagnostics()
+        rows = search_best_parallel_strategy(
+            copy.deepcopy(base), model, system, 16,
+            tp_list=(1, 1, 2), pp_list=(1,), zero_list=(1,),
+            recompute_types=("none",),
+            topk=5, csv_path=str(tmp_path / "d.csv"), diagnostics=diag,
+        )
+        deduped = [r for r in _csv_rows(tmp_path / "d.csv")
+                   if r.get("status") == "deduped"]
+        assert len(deduped) == 1
+        assert deduped[0]["tp"] == "1"
+        assert deduped[0]["dedup_of"]
+        assert diag.counters.get("sweep_cells_deduped") == 1
+        # the kept cells still produce their rows
+        assert {r["tp"] for r in rows} == {1, 2}
+
+    def test_no_prune_keeps_legacy_duplicate_evaluation(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        diag = Diagnostics()
+        search_best_parallel_strategy(
+            _base(8), model, system, 16,
+            tp_list=(1, 1), pp_list=(1,), zero_list=(1,),
+            recompute_types=("none",),
+            topk=5, diagnostics=diag, prune=False,
+        )
+        assert not diag.counters.get("sweep_cells_deduped")
+        assert diag.counters.get("sweep_cells_evaluated") == 2
+
+
+class TestPoolCounters:
+    def test_batched_telemetry_survives_pool_merge(self):
+        """Worker-side batched counters are per-cell deltas shipped back
+        with each result — a --jobs N sweep must report the same
+        telemetry a serial one does."""
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+
+        def run(jobs):
+            diag = Diagnostics()
+            search_best_parallel_strategy(
+                _base(8), model, system, 16,
+                tp_list=(1, 2), pp_list=(1, 2), zero_list=(1,),
+                topk=3, engine="batched", jobs=jobs, diagnostics=diag,
+            )
+            return diag.counters
+
+        c1, c2 = run(1), run(2)
+        for k in ("sweep_cells_batched", "sweep_batched_score_calls",
+                  "sweep_batched_candidates_scored",
+                  "sweep_batched_max_batch"):
+            assert c2.get(k) == c1.get(k), (k, c1, c2)
+
+
+class TestBenchSmoke:
+    def test_bench_sweep_batched_runs(self, capsys):
+        import bench_sweep
+
+        rc = bench_sweep.main(["--engine", "batched"])
+        assert rc == 0
+        import json
+
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        data = json.loads(out)
+        assert data["engine"] == "batched"
+        assert data["verify_topk"] == 5
+        assert data["verified_rows"] == 5
+        assert data["max_score_batch"] >= 2
+        assert data["value"] > 0

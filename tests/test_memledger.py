@@ -461,7 +461,7 @@ class TestSweepMemoryColumns:
         base = get_strategy_config("tp1_pp1_dp8_mbs1")
         model = get_model_config("llama3-70b")  # cannot fit at dp8
         system = get_system_config("tpu_v5e_256")
-        _, pruned = enumerate_cells(
+        _, pruned, _ = enumerate_cells(
             base, model, system, 8,
             (1,), (1,), (1,), (1,), (1,), ("none",), prune=True,
         )

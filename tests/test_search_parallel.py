@@ -291,7 +291,7 @@ class TestPruning:
 
     def test_gbs_indivisible_pruned(self):
         m, sysc, st = setup()
-        cells, pruned = enumerate_cells(
+        cells, pruned, _ = enumerate_cells(
             st, m, sysc, 9, (1, 2), (1,), (1,), (1,), (1,), ("none",),
         )
         # neither dp=8 nor dp=4 divides gbs=9

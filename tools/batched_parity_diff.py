@@ -1,0 +1,121 @@
+"""Scalar-vs-batched engine parity diff (CI forensics).
+
+Runs both sweep engines on the bench's standard grid and emits a JSON
+report: each engine's ranked rows, the per-row score deltas for every
+`status=ok` cell, and the pruned/deduped/quarantined row-set
+comparison — the artifact the batched bench gate uploads on failure so
+a regression can be triaged without a local repro.
+
+Usage::
+
+    python tools/batched_parity_diff.py [--grid standard] [--out X.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+warnings.filterwarnings("ignore")
+
+from simumax_tpu.core.config import (  # noqa: E402
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.core.records import Diagnostics  # noqa: E402
+from simumax_tpu.search import search_best_parallel_strategy  # noqa: E402
+
+from bench_sweep import GRIDS  # noqa: E402
+
+_KEY = ("tp", "cp", "ep", "pp", "zero", "mbs", "mbc", "recompute",
+        "recompute_layers")
+_METRICS = ("mfu", "iter_ms", "tgs", "peak_gib", "mem_margin_gib")
+
+
+def _run(engine, spec, csv_path):
+    model = get_model_config(spec["model"])
+    system = get_system_config(spec["system"])
+    base = get_strategy_config("tp1_pp1_dp8_mbs1")
+    base.world_size = spec["world"]
+    diag = Diagnostics()
+    rows = search_best_parallel_strategy(
+        base, model, system, spec["gbs"],
+        tp_list=spec["tp_list"], pp_list=spec["pp_list"],
+        zero_list=spec["zero_list"], topk=5, csv_path=csv_path,
+        diagnostics=diag, engine=engine,
+    )
+    import csv as _csv
+
+    with open(csv_path) as f:
+        csv_rows = list(_csv.DictReader(f))
+    return rows, csv_rows, diag
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--grid", choices=sorted(GRIDS), default="standard")
+    ap.add_argument("--out", default="batched_parity_diff.json")
+    args = ap.parse_args(argv)
+    spec = GRIDS[args.grid]
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        rows_s, csv_s, _ = _run("scalar", spec, os.path.join(td, "s.csv"))
+        rows_b, csv_b, diag_b = _run("batched", spec,
+                                     os.path.join(td, "b.csv"))
+
+    def key(r):
+        return tuple(str(r[k]) for k in _KEY)
+
+    ok_s = {key(r): r for r in csv_s if r.get("status", "ok") in ("", "ok")}
+    ok_b = {key(r): r for r in csv_b if r.get("status", "ok") in ("", "ok")}
+    deltas = []
+    for k in sorted(set(ok_s) | set(ok_b)):
+        if k not in ok_s or k not in ok_b:
+            deltas.append({"cell": k, "missing_in":
+                           "batched" if k not in ok_b else "scalar"})
+            continue
+        d = {}
+        for m in _METRICS:
+            a, b = float(ok_s[k][m] or 0), float(ok_b[k][m] or 0)
+            rel = abs(a - b) / max(1.0, abs(a), abs(b))
+            if rel > 1e-9:
+                d[m] = {"scalar": a, "batched": b, "rel": rel}
+        if d:
+            deltas.append({"cell": k, "deltas": d})
+
+    def status_set(rows, status):
+        return sorted(key(r) for r in rows if r.get("status") == status)
+
+    report = {
+        "grid": args.grid,
+        "topk_scalar": [{k: r[k] for k in _KEY} for r in rows_s],
+        "topk_batched": [{k: r[k] for k in _KEY} for r in rows_b],
+        "topk_ordering_identical": (
+            [tuple(r[k] for k in _KEY) for r in rows_s]
+            == [tuple(r[k] for k in _KEY) for r in rows_b]
+        ),
+        "ok_row_deltas_beyond_1e9": deltas,
+        "row_set_matches": {
+            s: status_set(csv_s, s) == status_set(csv_b, s)
+            for s in ("pruned", "deduped", "error")
+        },
+        "batched_diagnostic_errors": [
+            e.to_dict() for e in diag_b.errors
+        ],
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    print(json.dumps({
+        "out": args.out,
+        "topk_ordering_identical": report["topk_ordering_identical"],
+        "deltas_beyond_1e9": len(deltas),
+    }))
+    return 0 if report["topk_ordering_identical"] and not deltas else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
