@@ -8,6 +8,14 @@ streamlit`` then ``streamlit run app/streamlit_app.py``. The same
 workflows are available without extra deps through
 ``python -m simumax_tpu`` (see ``simumax_tpu/cli.py``); the full render
 path is exercised headlessly by ``tests/test_app.py``.
+
+Every evaluation routes through the :class:`Planner` facade
+(``simumax_tpu/service/planner.py``) instead of building ``PerfLLM``
+objects inline: streamlit re-runs this whole script on *every* widget
+interaction, and the planner's persistent content-addressed cache
+(shared with the CLI and the ``serve`` server — ``docs/service.md``)
+turns those re-runs into ~ms cache hits instead of full model
+rebuilds. Results are bit-identical to direct evaluation.
 """
 
 import io
@@ -24,7 +32,6 @@ except ImportError:  # pragma: no cover
     print(__doc__)
     sys.exit("streamlit is not installed; use `python -m simumax_tpu` instead")
 
-from simumax_tpu import PerfLLM
 from simumax_tpu.core.config import (
     ConfigError,
     ModelConfig,
@@ -34,6 +41,15 @@ from simumax_tpu.core.config import (
     get_system_config,
     list_configs,
 )
+from simumax_tpu.core.errors import FeasibilityError
+from simumax_tpu.service.planner import Planner
+
+# one planner per process; streamlit's per-interaction script re-runs
+# all hit the same persistent store, so only the first evaluation of a
+# configuration pays for a model build
+_planner = st.cache_resource(Planner) if hasattr(st, "cache_resource") \
+    else Planner
+planner = _planner()
 
 st.set_page_config(page_title="simumax-tpu", layout="wide")
 st.title("simumax-tpu — analytical LLM training simulator for TPU")
@@ -174,12 +190,13 @@ tab_est, tab_mem, tab_sim, tab_search = st.tabs(
 
 if st.button("estimate"):
     try:
-        perf = PerfLLM().configure(strategy, model, system)
+        # planner facade: persistent content-addressed cache shared
+        # with the CLI and the serve server; bit-identical to a direct
+        # PerfLLM evaluation
+        result = planner.estimate(model, strategy, system)
     except ConfigError as e:
         st.error(f"infeasible config: {e}")
         st.stop()
-    perf.run_estimate()
-    result = perf.analysis(verbose=False)
     cost, mem = result["compute_result"], result["mem_result"]
 
     with tab_est:
@@ -228,10 +245,12 @@ if st.button("estimate"):
             )
         # pp across DCN is the recommended multi-slice layout (tiny p2p
         # volume) — only warn when a bandwidth-heavy dim spills; dp_cp
-        # is the same physical group as dp, so don't list it twice
+        # is the same physical group as dp, so don't list it twice.
+        # net_info carries the CommPath descriptions ("dcn[...]" marks
+        # a span beyond the slice)
         dcn_dims = [
-            d for d, p in perf.ctx.paths.items()
-            if p.on_dcn and d not in ("pp", "dp_cp")
+            d for d, desc in result["net_info"].items()
+            if "dcn[" in desc and d not in ("pp", "dp_cp")
         ]
         if dcn_dims:
             hint = (
@@ -258,10 +277,9 @@ if st.button("estimate"):
         else:
             st.write("none — configuration looks healthy")
         with st.expander("realized collective bandwidths (GB/s)"):
-            st.json(perf.ctx.system.real_comm_bw)
-        if (strategy.pp_size >= 2 and strategy.pp_size % 2 == 0
-                and strategy.vp_size == 1):
-            dual = perf.analysis_dualpp()
+            st.json(result["real_comm_bw"])
+        dual = result.get("dualpp")
+        if dual:
             st.subheader("DualPipe projection")
             st.write(
                 f"bidirectional schedule: "
@@ -298,7 +316,10 @@ if st.button("estimate"):
         "net_info.json": result["net_info"],
     }
     if run_sim:
-        sim = perf.simulate("tmp/app_sim")
+        # artifact-producing simulate rides the facade too (uncached —
+        # the trace/snapshot files live outside the store)
+        sim = planner.simulate(model, strategy, system,
+                               save_path="tmp/app_sim")
         with tab_sim:
             st.subheader("event simulator")
             st.write(
@@ -346,8 +367,6 @@ with tab_search:
         ), min_value=1,
     ))
     if st.button("search batch split"):
-        from simumax_tpu.search import search_micro_batch_config
-
         dp = strategy.dp_size
         if dp < 1:
             st.error(
@@ -360,9 +379,13 @@ with tab_search:
             gbs = max(gbs // dp, 1) * dp
             st.info(f"global batch size rounded to {gbs} "
                     f"(must divide by dp={dp})")
-        best = search_micro_batch_config(
-            strategy, model, system, global_batch_size=gbs
-        )
+        try:
+            best = planner.batch_split(
+                model, strategy, system, global_batch_size=gbs
+            )["row"]
+        except FeasibilityError as e:
+            st.error(f"infeasible split: {e}")
+            st.stop()
         if best is None:
             st.error("no feasible (mbs, mbc) split at this layout")
         else:

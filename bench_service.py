@@ -1,0 +1,406 @@
+"""Planning-service micro-benchmark: replay a burst of mixed
+estimate / explain / search queries against the ``serve`` HTTP server
+and measure it like a service — queries/s, cache hit rate, p50/p99
+latency — cold (fresh content-addressed store) and warm (same burst
+replayed against the populated store).
+
+The burst is seeded and deterministic: ``--queries N`` requests with a
+controlled ``--overlap`` fraction of intra-burst repeats, drawn from a
+pool of unique (model, strategy, system, seq_len, mbc) combos at a
+~75/20/5 estimate/explain/search mix. A sample of responses is checked
+bit-identical against direct cache-off evaluation (the PR-8 parity
+discipline applied to the cache layer).
+
+Prints exactly ONE JSON line::
+
+    {"metric": "service_qps_warm", "value": ..., "unit": "q/s",
+     "qps_cold": ..., "speedup": ..., "hit_rate_warm": ...,
+     "p50_warm_ms": ..., "p99_warm_ms": ..., "parity_ok": true, ...}
+
+Usage::
+
+    python bench_service.py                      # full burst
+    python bench_service.py --queries 120 --threads 4   # quick look
+    python bench_service.py \
+        --baseline results/bench_service_baseline.json \
+        --max-regression 0.7                     # regression gate
+
+Gates (exit 1 on breach): the warm replay must reach
+``--min-hit-rate`` (default 0.9) and ``--min-speedup`` x the cold qps
+(default 3 — machine-relative but deliberately wide: a contended
+2-vCPU runner can halve the warm phase; the recorded baseline
+documents >=10x on a quiet machine); ``--baseline`` additionally gates
+absolute warm qps like the other two benches.
+"""
+
+import argparse
+import json
+import os
+import queue
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+import http.client
+
+#: unique-query pool axes. Dense models only — every strategy below is
+#: valid for all of them, so the pool is a clean product
+#: (6 x 6 x 3 x 3 x 3 = 972 distinct estimate/explain bodies).
+MODELS = ("llama3-8b", "llama2-7b", "llama2-13b", "qwen3-32b",
+          "llama3-70b", "aquila2-7b")
+STRATEGIES = ("tp1_pp2_dp4_mbs1", "tp2_pp1_dp4_mbs1", "tp4_pp1_dp2_mbs1",
+              "tp8_pp1_dp1_mbs1", "tp1_pp1_dp8_mbs1", "tp4_pp4_dp16_mbs1")
+SYSTEMS = ("tpu_v5e_256", "tpu_v5p_256", "tpu_v6e_256")
+SEQ_LENS = (2048, 4096, 8192)
+MBCS = (4, 8, 16)
+
+#: endpoint mix of the unique pool (estimate-heavy, like interactive
+#: planning traffic; search is per-query ~30x an estimate)
+MIX = (("/v1/estimate", 0.75), ("/v1/explain", 0.20),
+       ("/v1/search", 0.05))
+
+
+def build_burst(n_queries: int, overlap: float, seed: int = 0):
+    """Deterministic (endpoint, body) burst: ``n_unique`` *genuinely
+    distinct* queries (deduplicated on canonical body + endpoint, so
+    the cold phase really is 0% warm) plus ``overlap * n`` seeded
+    repeats, shuffled."""
+    rng = random.Random(seed)
+    n_unique = max(1, int(round(n_queries * (1.0 - overlap))))
+    combos = [
+        (m, s, sysn, seq, mbc)
+        for m in MODELS for s in STRATEGIES for sysn in SYSTEMS
+        for seq in SEQ_LENS for mbc in MBCS
+    ]
+    rng.shuffle(combos)
+    unique = []
+    seen = set()
+    searches = 0
+    i = 0
+    while len(unique) < n_unique:
+        if i >= 4 * len(combos):
+            raise SystemExit(
+                f"query pool exhausted at {len(unique)} unique queries "
+                f"(< requested {n_unique}); lower --queries or raise "
+                f"--overlap"
+            )
+        m, s, sysn, seq, mbc = combos[i % len(combos)]
+        r = len(unique) / max(1, n_unique)
+        i += 1
+        if r < MIX[0][1]:
+            ep = "/v1/estimate"
+        elif r < MIX[0][1] + MIX[1][1]:
+            ep = "/v1/explain"
+        else:
+            ep = "/v1/search"
+        if ep == "/v1/search":
+            # small grids; cycle gbs so searches stay distinct even
+            # though they ignore the strategy/seq axes
+            searches += 1
+            body = {
+                "model": m, "system": sysn,
+                "gbs": 32 * (1 + searches % 8), "world": 32,
+                "tp": "1,2", "pp": "1", "zero": "1", "topk": 3,
+            }
+        else:
+            body = {
+                "model": m,
+                "strategy": {"name": s, "seq_len": seq,
+                             "micro_batch_num": mbc},
+                "system": sysn,
+            }
+        dedup = (ep, json.dumps(body, sort_keys=True))
+        if dedup in seen:
+            continue
+        seen.add(dedup)
+        unique.append((ep, body))
+    burst = list(unique)
+    while len(burst) < n_queries:
+        burst.append(unique[rng.randrange(len(unique))])
+    rng.shuffle(burst)
+    return burst, unique
+
+
+def resolve_strategy_body(body: dict) -> dict:
+    """Expand the compact ``{"name": ..., "seq_len": ...}`` strategy
+    spelling into an inline config dict (exercises the server's inline-
+    config path and keeps seq_len variants content-addressed apart)."""
+    from simumax_tpu.core.config import get_strategy_config
+
+    out = dict(body)
+    strat = out.get("strategy")
+    if isinstance(strat, dict) and "name" in strat:
+        cfg = get_strategy_config(strat["name"])
+        if strat.get("seq_len"):
+            cfg.seq_len = int(strat["seq_len"])
+        if strat.get("micro_batch_num"):
+            cfg.micro_batch_num = int(strat["micro_batch_num"])
+        out["strategy"] = cfg.to_dict()
+    return out
+
+
+def replay(port: int, burst, threads: int):
+    """Replay the burst with ``threads`` concurrent clients; returns
+    (elapsed_s, sorted per-request latencies, error count)."""
+    work = queue.Queue()
+    for i, item in enumerate(burst):
+        work.put((i, item))
+    lat = [0.0] * len(burst)
+    errors = [0]
+    lock = threading.Lock()
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        while True:
+            try:
+                i, (ep, body) = work.get_nowait()
+            except queue.Empty:
+                conn.close()
+                return
+            payload = json.dumps(resolve_strategy_body(body))
+            t0 = time.perf_counter()
+            try:
+                conn.request("POST", ep, payload,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+            except (OSError, http.client.HTTPException):
+                ok = False
+                conn.close()
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=300
+                )
+            lat[i] = time.perf_counter() - t0
+            if not ok:
+                with lock:
+                    errors[0] += 1
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=client) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return time.perf_counter() - t0, sorted(lat), errors[0]
+
+
+def get_json(port: int, path: str) -> dict:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    data = json.loads(conn.getresponse().read())
+    conn.close()
+    return data
+
+
+def pct(sorted_vals, q: float) -> float:
+    # the server's own percentile implementation, so the benched
+    # p50/p99 are computed exactly like the /stats ones
+    from simumax_tpu.service.server import percentile
+
+    return percentile(sorted_vals, q)
+
+
+def check_parity(port: int, unique, seed: int = 0, samples: int = 4):
+    """A seeded sample of responses must be byte-identical to direct
+    cache-off evaluation. The search probe is pinned to a grid known to
+    *evaluate* cells (llama3-8b fits on v5p, nothing prunes), so the
+    warm per-cell-served path is genuinely exercised — a fully-pruned
+    sample would compare two trivially identical payloads."""
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.server import response_bytes
+
+    rng = random.Random(seed + 1)
+    picks = [unique[rng.randrange(len(unique))] for _ in range(samples)]
+    search = next((u for u in unique if u[0] == "/v1/search"), None)
+    if search is not None:
+        picks.append(search)
+    probe = ("/v1/search", {
+        "model": "llama3-8b", "system": "tpu_v5p_256", "gbs": 32,
+        "world": 32, "tp": "1,2", "pp": "1", "zero": "1", "topk": 3,
+    })
+    picks.append(probe)
+    off = Planner(enabled=False)
+    for ep, body in picks:
+        body = resolve_strategy_body(body)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        conn.request("POST", ep, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        served = conn.getresponse().read()
+        conn.close()
+        if ep == "/v1/estimate":
+            direct = off.estimate(body["model"], body["strategy"],
+                                  body["system"])
+        elif ep == "/v1/explain":
+            direct = off.explain(body["model"], body["strategy"],
+                                 body["system"])
+        else:
+            direct = off.search(
+                body["model"], body["system"], body["gbs"],
+                world=body["world"],
+                tp_list=tuple(int(x) for x in body["tp"].split(",")),
+                pp_list=tuple(int(x) for x in body["pp"].split(",")),
+                zero_list=tuple(
+                    int(x) for x in body["zero"].split(",")),
+                topk=body.get("topk", 5),
+            )
+            c = direct["cells"]
+            scored = (c["total"] - c["pruned"] - c["deduped"]
+                      - c["quarantined"])
+            if body == resolve_strategy_body(probe[1]) and scored <= 0:
+                return False, f"{ep} (probe grid scored no cells)"
+        if response_bytes(direct) != served:
+            return False, ep
+    return True, None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--queries", type=int, default=1000,
+                    help="burst size (default 1000)")
+    ap.add_argument("--overlap", type=float, default=0.1,
+                    help="intra-burst repeat fraction (default 0.1)")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="concurrent client connections (default 4)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cache-dir", metavar="DIR",
+                    help="store root for the run (default: a fresh "
+                         "temp dir, deleted afterwards — the bench "
+                         "must start cold)")
+    ap.add_argument("--min-hit-rate", type=float, default=0.9,
+                    help="warm-replay store hit-rate floor (default "
+                         "0.9; exit 1 below it)")
+    ap.add_argument("--min-speedup", type=float, default=3.0,
+                    help="warm/cold qps ratio floor (default 3 — wide "
+                         "because a contended 2-vCPU runner can halve "
+                         "the warm phase; the recorded baseline "
+                         "documents the >=10x quiet-machine number)")
+    ap.add_argument("--baseline", metavar="JSON",
+                    help="previously saved bench JSON line to gate "
+                         "absolute warm qps against")
+    ap.add_argument("--max-regression", type=float, default=0.05,
+                    metavar="FRAC",
+                    help="fail when warm qps drops more than this "
+                         "fraction below the baseline (default 0.05)")
+    ap.add_argument("--skip-parity", action="store_true",
+                    help="skip the bit-identity sample check (it "
+                         "re-evaluates a few queries cache-off)")
+    args = ap.parse_args(argv)
+
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.server import make_server
+
+    tmp = None
+    cache_dir = args.cache_dir
+    if not cache_dir:
+        tmp = tempfile.mkdtemp(prefix="simumax-bench-service-")
+        cache_dir = tmp
+    planner = Planner(cache_dir=cache_dir)
+    srv = make_server(planner, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        burst, unique = build_burst(args.queries, args.overlap,
+                                    args.seed)
+        cold_s, cold_lat, cold_err = replay(port, burst, args.threads)
+        stats_mid = get_json(port, "/stats")
+        warm_s, warm_lat, warm_err = replay(port, burst, args.threads)
+        stats_end = get_json(port, "/stats")
+        parity_ok, parity_ep = (True, None) if args.skip_parity \
+            else check_parity(port, unique, args.seed)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def counters(s):
+        return s["store"]["counters"]
+
+    warm_hits = (counters(stats_end)["hits"]
+                 - counters(stats_mid)["hits"])
+    warm_misses = (counters(stats_end)["misses"]
+                   - counters(stats_mid)["misses"])
+    lookups = warm_hits + warm_misses
+    hit_rate = warm_hits / lookups if lookups else 0.0
+    qps_cold = len(burst) / cold_s if cold_s else 0.0
+    qps_warm = len(burst) / warm_s if warm_s else 0.0
+    speedup = qps_warm / qps_cold if qps_cold else 0.0
+    result = {
+        "metric": "service_qps_warm",
+        "value": round(qps_warm, 2),
+        "unit": "q/s",
+        "queries": len(burst),
+        "unique_queries": len(unique),
+        "overlap": args.overlap,
+        "threads": args.threads,
+        "qps_cold": round(qps_cold, 2),
+        "speedup": round(speedup, 2),
+        "hit_rate_warm": round(hit_rate, 4),
+        "warm_hits": warm_hits,
+        "warm_lookups": lookups,
+        "p50_cold_ms": round(pct(cold_lat, 0.50) * 1e3, 2),
+        "p99_cold_ms": round(pct(cold_lat, 0.99) * 1e3, 2),
+        "p50_warm_ms": round(pct(warm_lat, 0.50) * 1e3, 2),
+        "p99_warm_ms": round(pct(warm_lat, 0.99) * 1e3, 2),
+        "cold_elapsed_s": round(cold_s, 3),
+        "warm_elapsed_s": round(warm_s, 3),
+        "errors": cold_err + warm_err,
+        "parity_ok": parity_ok,
+    }
+    ok = True
+    if cold_err or warm_err:
+        result["errors_ok"] = ok = False
+    if not parity_ok:
+        result["parity_endpoint"] = parity_ep
+        ok = False
+    if hit_rate < args.min_hit_rate:
+        result["hit_rate_ok"] = ok = False
+    if speedup < args.min_speedup:
+        result["speedup_ok"] = ok = False
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if not isinstance(base.get("value"), (int, float)):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field; re-record it with a plain "
+                         f"bench run",
+            }))
+            return 2
+        for key, ours in (("queries", len(burst)),
+                          ("overlap", args.overlap),
+                          ("threads", args.threads)):
+            theirs = base.get(key, ours)
+            if theirs != ours:
+                print(json.dumps({
+                    "error": f"baseline {key} {theirs!r} != this "
+                             f"run's {ours!r}; not comparable — "
+                             f"re-record the baseline with matching "
+                             f"flags",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression"] = (
+            round(1.0 - qps_warm / base["value"], 4)
+            if base["value"] else 0.0
+        )
+        result["regression_ok"] = qps_warm >= floor
+        ok = ok and result["regression_ok"]
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

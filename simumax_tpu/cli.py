@@ -53,6 +53,14 @@ compares two saved ledgers (``--memory`` for memory ledgers,
 ``--critical-path`` for critical-path reports). Every subcommand
 accepts ``--log-level`` and ``--log-json`` (structured JSONL lines
 with a run_id instead of the human format).
+
+Service surface (see ``docs/service.md``): ``perf`` / ``explain`` /
+``search`` route through the ``Planner`` facade and its persistent
+content-addressed result cache by default (``--cache-dir`` /
+``--no-cache``; output is bit-identical either way, sweeps re-evaluate
+only cells missing from the store); ``serve`` runs the long-lived
+JSON-over-HTTP planning server sharing the same cache; ``cache``
+inspects/maintains it (``stats`` / ``ls`` / ``verify`` / ``clear``).
 """
 
 from __future__ import annotations
@@ -169,7 +177,30 @@ def _load_scenario(args, world_ranks):
     return scenario, world_ranks
 
 
+def _cache_enabled(args) -> bool:
+    """Whether this invocation uses the persistent planner cache:
+    default on, killed by ``--no-cache`` or ``SIMUMAX_TPU_NO_CACHE``."""
+    return not (
+        getattr(args, "no_cache", False)
+        or os.environ.get("SIMUMAX_TPU_NO_CACHE")
+    )
+
+
+def _make_planner(args):
+    from simumax_tpu.service.planner import Planner
+
+    return Planner(cache_dir=getattr(args, "cache_dir", None))
+
+
 def cmd_perf(args):
+    # artifact-producing runs (--save/--simulate/--graph) need the
+    # built PerfLLM; everything else is a pure function of the configs
+    # and routes through the planner so one-shot CLI calls populate
+    # (and hit) the same persistent cache the server reads
+    if _cache_enabled(args) and not (
+        args.save or args.simulate or args.graph
+    ):
+        return _cmd_perf_planner(args)
     from simumax_tpu import PerfLLM
 
     perf = PerfLLM()
@@ -233,6 +264,33 @@ def cmd_perf(args):
                     )
 
 
+def _cmd_perf_planner(args):
+    """`perf` through the Planner facade: content-addressed persistent
+    caching with byte-identical output (``docs/service.md``)."""
+    from simumax_tpu.core.records import Diagnostics
+    from simumax_tpu.perf import print_summary
+    from simumax_tpu.service.planner import replay_coverage
+
+    diag = Diagnostics(strict=args.strict)
+    with _diagnosed(diag, args):
+        planner = _make_planner(args)
+        with diag.activate():
+            payload, meta = planner.estimate(
+                args.model, args.strategy, args.system, with_meta=True
+            )
+        # cached payloads carry the estimate's efficiency coverage, so
+        # --strict and the diagnostics report behave identically
+        # whether the answer was computed or served
+        replay_coverage(diag, payload.get("efficiency_hits") or {},
+                        payload.get("efficiency_misses") or {})
+        _log().debug(
+            f"[cache] estimate {meta['cache']} "
+            f"(key {meta['key'][:16]}…)",
+            event="cache_lookup", cache=meta["cache"], key=meta["key"],
+        )
+        print_summary(payload)
+
+
 def cmd_search(args):
     from simumax_tpu.core.records import Diagnostics
 
@@ -273,6 +331,29 @@ def _run_search(args, diag):
             f"count (1 = serial; omit for os.cpu_count())"
         )
     jobs = args.jobs if args.jobs else (os.cpu_count() or 1)
+    # persistent per-cell cache: overlapping grids (across runs,
+    # processes, and the serve server) only evaluate the delta cells
+    store = None
+    profiles_key = None
+    if _cache_enabled(args):
+        from simumax_tpu.service.store import ContentStore
+
+        store = ContentStore(getattr(args, "cache_dir", None))
+        if args.engine == "batched":
+            from simumax_tpu.service.planner import (
+                batched_profiles_key,
+                load_batched_profiles,
+            )
+
+            # key pinned pre-sweep: evaluations mutate the model
+            profiles_key = batched_profiles_key(model, system)
+            n = load_batched_profiles(store, model, system,
+                                      key=profiles_key)
+            if n:
+                _log().debug(
+                    f"[cache] seeded {n} block-kind profiles",
+                    event="cache_profiles", profiles=n,
+                )
     with diag.capture(category="search"):
         rows = search_best_parallel_strategy(
             base, model, system, args.gbs,
@@ -290,8 +371,23 @@ def _run_search(args, diag):
             simulate=args.simulate_check,
             engine=args.engine,
             verify_topk=args.verify_topk,
+            store=store,
         )
+    if store is not None and args.engine == "batched":
+        from simumax_tpu.service.planner import save_batched_profiles
+
+        save_batched_profiles(store, model, system, key=profiles_key)
     counters = diag.counters
+    if counters.get("sweep_cells_cached"):
+        _log().info(
+            f"[sweep] served {int(counters['sweep_cells_cached'])}/"
+            f"{int(counters['sweep_cells_total'])} cells from the "
+            f"planner cache (status=cached rows in the CSV; --no-cache "
+            f"to re-evaluate)",
+            event="sweep_cached",
+            cached=int(counters["sweep_cells_cached"]),
+            total=int(counters["sweep_cells_total"]),
+        )
     if counters.get("sweep_cells_pruned"):
         _log().info(
             f"[sweep] pruned {int(counters['sweep_cells_pruned'])}/"
@@ -375,12 +471,84 @@ def _run_calibrate(args, perf):
 
 
 def cmd_explain(args):
+    # the memory/trace/crosscheck surfaces need the built PerfLLM; the
+    # step-time ledger is a pure function of the configs and rides the
+    # persistent planner cache
+    if _cache_enabled(args) and not (
+        args.memory or args.trace or args.crosscheck
+        or args.mem_artifacts
+    ):
+        return _cmd_explain_planner(args)
     from simumax_tpu import PerfLLM
 
     perf = PerfLLM()
     perf.diagnostics.strict = args.strict
     with _diagnosed(perf.diagnostics, args):
         _run_explain(args, perf)
+
+
+def _cmd_explain_planner(args):
+    """`explain` through the Planner facade: the cached payload carries
+    the full ledger dict plus the aggregated op rows, rendered by the
+    same functions the live Ledger uses."""
+    import csv as _csv
+
+    from simumax_tpu.core.records import Diagnostics
+    from simumax_tpu.observe.ledger import (
+        top_op_lines_from_rows,
+        waterfall_lines_from_dict,
+    )
+    from simumax_tpu.service.planner import replay_coverage
+
+    diag = Diagnostics(strict=args.strict)
+    with _diagnosed(diag, args):
+        planner = _make_planner(args)
+        with diag.activate():
+            payload, meta = planner.explain(
+                args.model, args.strategy, args.system, with_meta=True
+            )
+        led = payload["ledger"]
+        replay_coverage(diag, led["efficiency"].get("hits") or {},
+                        led["efficiency"].get("misses") or {})
+        log = _log()
+        log.debug(
+            f"[cache] explain {meta['cache']} "
+            f"(key {meta['key'][:16]}…)",
+            event="cache_lookup", cache=meta["cache"], key=meta["key"],
+        )
+        for line in waterfall_lines_from_dict(led):
+            log.info(line, event="waterfall")
+        for line in top_op_lines_from_rows(payload["op_rows"], args.top):
+            log.info(line, event="top_ops")
+        miss = led["efficiency"]["miss_count"]
+        if miss:
+            log.info(
+                f"[calibration] {miss} efficiency-table misses "
+                f"contribute to these rows (MISS); `simumax_tpu "
+                f"calibrate` refines them",
+                event="explain_misses", misses=miss,
+            )
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as f:
+                json.dump(led, f, indent=1)
+            log.info(f"ledger -> {args.json}", event="explain_ledger",
+                     path=args.json, run_id=led["meta"]["run_id"])
+        if args.csv:
+            rows = payload["op_rows"]
+            fields = [
+                "path", "category", "module_type", "stage", "chunk",
+                "fwd_time", "bwd_time", "net_exposed_time",
+                "net_hidden_time", "time", "share", "flops",
+                "bytes_accessed", "efficiency", "calibrated", "regime",
+                "recompute",
+            ]
+            with open(args.csv, "w", newline="") as f:
+                w = _csv.DictWriter(f, fieldnames=fields,
+                                    extrasaction="ignore")
+                w.writeheader()
+                w.writerows(rows)
+            log.info(f"op table -> {args.csv}", event="explain_csv",
+                     path=args.csv, rows=len(rows))
 
 
 def _run_explain(args, perf):
@@ -794,6 +962,104 @@ def cmd_straggler(args):
     )
 
 
+def cmd_serve(args):
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.server import make_server, serve_forever
+
+    max_bytes = (
+        args.cache_max_mb * 1024 * 1024 if args.cache_max_mb else None
+    )
+    planner = Planner(
+        cache_dir=args.cache_dir,
+        enabled=_cache_enabled(args),
+        max_bytes=max_bytes,
+    )
+    srv = make_server(planner, args.host, args.port)
+    host, port = srv.server_address[:2]
+    cache_desc = (
+        planner.store.root if planner.enabled else "disabled"
+    )
+    _log().info(
+        f"[serve] planning service on http://{host}:{port} "
+        f"(cache: {cache_desc}) — GET /healthz /stats, "
+        f"POST /v1/estimate /v1/explain /v1/search /v1/faults "
+        f"/v1/simulate",
+        event="serve_start", host=host, port=port, cache=cache_desc,
+    )
+    serve_forever(srv)
+
+
+def cmd_cache(args):
+    from simumax_tpu.service.store import ContentStore
+
+    store = ContentStore(args.cache_dir)
+    log = _log()
+    report = None
+    if args.action == "stats":
+        report = store.stats()
+        log.info(f"cache root: {report['root']}", event="cache_root",
+                 root=report["root"])
+        for ns in sorted(report["namespaces"]):
+            d = report["namespaces"][ns]
+            log.info(
+                f"  {ns:<10} {d['entries']:6d} entries  "
+                f"{d['bytes'] / 2**20:8.2f} MiB",
+                event="cache_ns", namespace=ns, **d,
+            )
+        log.info(
+            f"  total: {report['total_bytes'] / 2**20:.2f} MiB of "
+            f"{report['max_bytes'] / 2**20:.0f} MiB budget",
+            event="cache_total", total_bytes=report["total_bytes"],
+        )
+        c = report["counters"]
+        log.info(
+            f"  session counters: {c['hits']} hits, {c['misses']} "
+            f"misses, {c['puts']} puts, {c['evictions']} evictions, "
+            f"{c['corrupt_dropped']} corrupt dropped",
+            event="cache_counters", **c,
+        )
+    elif args.action == "ls":
+        entries = store.entries(args.namespace)
+        report = {"entries": entries}
+        for e in entries:
+            log.info(
+                f"  {e['namespace']:<10} {e['key'][:16]}…  "
+                f"{e['bytes']:10d} B  {e['fmt']:<6} "
+                f"v{e['code_version']}",
+                event="cache_entry", **e,
+            )
+        log.info(f"{len(entries)} entries", event="cache_ls_total",
+                 count=len(entries))
+    elif args.action == "verify":
+        report = store.verify(args.namespace, drop=args.drop)
+        for c in report["corrupt"]:
+            log.error(f"  corrupt: {c['path']} ({c['error']})",
+                      event="cache_corrupt", **c)
+        log.info(
+            f"verified {report['checked']} entries: {report['ok']} ok, "
+            f"{len(report['corrupt'])} corrupt"
+            + (" (dropped)" if args.drop and report["corrupt"] else ""),
+            event="cache_verify", checked=report["checked"],
+            ok=report["ok"], corrupt=len(report["corrupt"]),
+        )
+    elif args.action == "clear":
+        removed = store.clear(args.namespace)
+        report = {"removed": removed, "namespace": args.namespace}
+        log.info(
+            f"cleared {removed} entries"
+            + (f" from namespace {args.namespace!r}"
+               if args.namespace else ""),
+            event="cache_clear", removed=removed,
+        )
+    if args.json and report is not None:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+        log.info(f"report -> {args.json}", event="cache_report",
+                 path=args.json)
+    if args.action == "verify" and report["corrupt"]:
+        sys.exit(1)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="simumax_tpu",
@@ -815,6 +1081,20 @@ def main(argv=None):
             "--log-json", action="store_true",
             help="emit structured JSONL report lines (ts/level/run_id/"
                  "msg + fields) instead of the human format",
+        )
+
+    def _add_cache_args(parser):
+        parser.add_argument(
+            "--cache-dir", metavar="DIR",
+            help="persistent content-addressed result cache root "
+                 "(default: SIMUMAX_TPU_CACHE_DIR or "
+                 "~/.cache/simumax-tpu; see docs/service.md)",
+        )
+        parser.add_argument(
+            "--no-cache", action="store_true",
+            help="evaluate directly, without reading or writing the "
+                 "persistent cache (results are bit-identical either "
+                 "way; SIMUMAX_TPU_NO_CACHE=1 is the env equivalent)",
         )
 
     pl = sub.add_parser("list", help="list available configs")
@@ -870,6 +1150,7 @@ def main(argv=None):
     pp.add_argument("--graph", action="store_true", help="capture op graph")
     _add_diag_args(pp)
     _add_log_args(pp)
+    _add_cache_args(pp)
     pp.set_defaults(fn=cmd_perf)
 
     pe = sub.add_parser(
@@ -914,6 +1195,7 @@ def main(argv=None):
                          "schedule (same UI as simulate() traces)")
     _add_diag_args(pe)
     _add_log_args(pe)
+    _add_cache_args(pe)
     pe.set_defaults(fn=cmd_explain)
 
     pdf = sub.add_parser(
@@ -1048,6 +1330,7 @@ def main(argv=None):
     )
     _add_diag_args(ps)
     _add_log_args(ps)
+    _add_cache_args(ps)
     ps.set_defaults(fn=cmd_search)
 
     pc = sub.add_parser(
@@ -1128,6 +1411,54 @@ def main(argv=None):
     )
     _add_log_args(pst)
     pst.set_defaults(fn=cmd_straggler)
+
+    psv = sub.add_parser(
+        "serve",
+        help="long-running JSON-over-HTTP planning server backed by "
+             "the persistent content-addressed cache "
+             "(docs/service.md): concurrent estimate/explain/search/"
+             "faults/simulate queries, single-flight dedup, NDJSON "
+             "sweep streaming, /healthz + /stats",
+    )
+    psv.add_argument("--host", default="127.0.0.1",
+                     help="bind address (default 127.0.0.1)")
+    psv.add_argument("--port", type=int, default=8642,
+                     help="bind port (default 8642; 0 = ephemeral)")
+    psv.add_argument(
+        "--cache-max-mb", type=int, default=0, metavar="MB",
+        help="store size budget in MiB (default: the store's 512 MiB "
+             "default; LRU-evicted beyond it)",
+    )
+    _add_cache_args(psv)
+    _add_log_args(psv)
+    psv.set_defaults(fn=cmd_serve)
+
+    pca = sub.add_parser(
+        "cache",
+        help="inspect/maintain the persistent planner cache: stats / "
+             "ls / verify (re-hash payloads, exit 1 on corruption) / "
+             "clear [--namespace]",
+    )
+    pca.add_argument("action",
+                     choices=("stats", "ls", "verify", "clear"))
+    pca.add_argument(
+        "--namespace", metavar="NS",
+        help="restrict ls/verify/clear to one namespace "
+             "(estimate, explain, sweep, profiles, des)",
+    )
+    pca.add_argument(
+        "--drop", action="store_true",
+        help="with verify: also remove the corrupt entries",
+    )
+    pca.add_argument("--json", metavar="PATH",
+                     help="also save the structured report")
+    pca.add_argument(
+        "--cache-dir", metavar="DIR",
+        help="cache root (default: SIMUMAX_TPU_CACHE_DIR or "
+             "~/.cache/simumax-tpu)",
+    )
+    _add_log_args(pca)
+    pca.set_defaults(fn=cmd_cache)
 
     args = p.parse_args(argv)
     # the process-wide reporter carries the CLI's log surface; default

@@ -463,44 +463,62 @@ class Ledger:
     # -- presentation ------------------------------------------------------
     def waterfall_lines(self) -> List[str]:
         """Human waterfall rendering (the `explain` default output)."""
-        wf = self.waterfall
-        total = wf["total"] or 1.0
-        width = max(len(k) for k in wf["order"])
-        lines = [
-            f"== MFU-loss waterfall: {self.meta['model']} on "
-            f"{self.meta['system']} — iter "
-            f"{self.headline['iter_time_ms']:.2f} ms, "
-            f"MFU {100.0 * self.headline['mfu']:.2f}% =="
-        ]
-        for key in wf["order"]:
-            v = wf["buckets"][key]
-            # round-then-add-0.0 folds epsilon-negative buckets' float
-            # -0.0 into plain 0.0 for display
-            ms = round(v * 1e3, 3) + 0.0
-            pct = round(100.0 * v / total, 2) + 0.0
-            lines.append(f"  {key:<{width}}  {ms:10.3f} ms  {pct:6.2f}%")
-        lines.append(
-            f"  {'= step time':<{width}}  {total * 1e3:10.3f} ms  "
-            f"100.00%"
-        )
-        return lines
+        return waterfall_lines_from_dict({
+            "meta": self.meta, "headline": self.headline,
+            "waterfall": self.waterfall,
+        })
 
     def top_op_lines(self, n: int = 10) -> List[str]:
-        rows = self.op_rows()[:n]
-        if not rows:
-            return []
-        lines = [
-            "-- top ops by charged time (per microbatch; share scales "
-            "by mbc vs step) --"
-        ]
-        for r in rows:
-            cal = "cal" if r["calibrated"] else "MISS"
-            lines.append(
-                f"  {r['time'] * 1e3:9.3f} ms  {r['share'] * 100:5.1f}%  "
-                f"[{r['regime']:>7}|{cal:>4}|eff {r['efficiency']:.2f}]  "
-                f"{r['path']} ({r['category']})"
-            )
-        return lines
+        return top_op_lines_from_rows(self.op_rows(), n)
+
+
+def waterfall_lines_from_dict(data: Dict[str, Any]) -> List[str]:
+    """The waterfall rendering, from a ledger *dict* (``to_dict`` /
+    ``load`` / a cached planner payload) — one renderer shared with the
+    live :class:`Ledger`, so cached and fresh `explain` output cannot
+    diverge."""
+    wf = data["waterfall"]
+    meta, headline = data["meta"], data["headline"]
+    total = wf["total"] or 1.0
+    width = max(len(k) for k in wf["order"])
+    lines = [
+        f"== MFU-loss waterfall: {meta['model']} on "
+        f"{meta['system']} — iter "
+        f"{headline['iter_time_ms']:.2f} ms, "
+        f"MFU {100.0 * headline['mfu']:.2f}% =="
+    ]
+    for key in wf["order"]:
+        v = wf["buckets"][key]
+        # round-then-add-0.0 folds epsilon-negative buckets' float
+        # -0.0 into plain 0.0 for display
+        ms = round(v * 1e3, 3) + 0.0
+        pct = round(100.0 * v / total, 2) + 0.0
+        lines.append(f"  {key:<{width}}  {ms:10.3f} ms  {pct:6.2f}%")
+    lines.append(
+        f"  {'= step time':<{width}}  {total * 1e3:10.3f} ms  "
+        f"100.00%"
+    )
+    return lines
+
+
+def top_op_lines_from_rows(rows: List[Dict[str, Any]],
+                           n: int = 10) -> List[str]:
+    """The top-op table rendering, from aggregated ``op_rows``."""
+    rows = rows[:n]
+    if not rows:
+        return []
+    lines = [
+        "-- top ops by charged time (per microbatch; share scales "
+        "by mbc vs step) --"
+    ]
+    for r in rows:
+        cal = "cal" if r["calibrated"] else "MISS"
+        lines.append(
+            f"  {r['time'] * 1e3:9.3f} ms  {r['share'] * 100:5.1f}%  "
+            f"[{r['regime']:>7}|{cal:>4}|eff {r['efficiency']:.2f}]  "
+            f"{r['path']} ({r['category']})"
+        )
+    return lines
 
 
 # --------------------------------------------------------------------------

@@ -197,6 +197,47 @@ def _resolve(cfg, cls, getter):
     raise TypeError(f"cannot resolve {cls.__name__} from {type(cfg)}")
 
 
+def print_summary(result: dict) -> None:
+    """Render the estimate headline (the ``perf`` CLI output) from an
+    ``analysis`` result dict. Module-level so the planning service can
+    render a cached payload without a built ``PerfLLM`` — one renderer,
+    so cached and fresh output cannot diverge."""
+    from simumax_tpu.observe.report import get_reporter
+
+    log = get_reporter()
+    cost, mem = result["compute_result"], result["mem_result"]
+    info = result["base_info"]
+    p = info["parallelism"]
+    log.info(
+        f"== {info['model']} on {info['system']} "
+        f"(world={info['world_size']} tp={p['tp']} cp={p['cp']} "
+        f"pp={p['pp']} dp={p['dp']} ep={p['ep']}) ==",
+        event="perf_header", model=info["model"], system=info["system"],
+    )
+    log.info(
+        f"iter time {human_time(cost['iter_time'])}  "
+        f"MFU {cost['mfu']*100:.2f}%  "
+        f"TFLOPS/chip {cost['tflops_per_chip']:.1f}  "
+        f"TGS {cost['tgs']:.1f}",
+        event="perf_cost", iter_time_ms=cost["iter_time_ms"],
+        mfu=cost["mfu"], tgs=cost["tgs"],
+    )
+    log.info(
+        f"peak HBM {mem['max_peak_gib']:.2f} GiB / "
+        f"{mem['hbm_capacity_gib']:.0f} GiB  fits={mem['fits']}",
+        event="perf_mem", peak_gib=mem["max_peak_gib"],
+        fits=mem["fits"],
+    )
+    misses = result["efficiency_misses"]
+    if misses:
+        nmiss = sum(len(v) for v in misses.values())
+        log.info(
+            f"[calibration] {nmiss} efficiency-table misses "
+            f"(run simumax_tpu.calibration to refine)",
+            event="perf_misses", misses=nmiss,
+        )
+
+
 class PerfBase:
     """Config plumbing shared by perf frontends."""
 
@@ -1167,40 +1208,7 @@ class PerfLLM(PerfBase):
         return result
 
     def _print_summary(self, result: dict):
-        from simumax_tpu.observe.report import get_reporter
-
-        log = get_reporter()
-        cost, mem = result["compute_result"], result["mem_result"]
-        info = result["base_info"]
-        p = info["parallelism"]
-        log.info(
-            f"== {info['model']} on {info['system']} "
-            f"(world={info['world_size']} tp={p['tp']} cp={p['cp']} "
-            f"pp={p['pp']} dp={p['dp']} ep={p['ep']}) ==",
-            event="perf_header", model=info["model"], system=info["system"],
-        )
-        log.info(
-            f"iter time {human_time(cost['iter_time'])}  "
-            f"MFU {cost['mfu']*100:.2f}%  "
-            f"TFLOPS/chip {cost['tflops_per_chip']:.1f}  "
-            f"TGS {cost['tgs']:.1f}",
-            event="perf_cost", iter_time_ms=cost["iter_time_ms"],
-            mfu=cost["mfu"], tgs=cost["tgs"],
-        )
-        log.info(
-            f"peak HBM {mem['max_peak_gib']:.2f} GiB / "
-            f"{mem['hbm_capacity_gib']:.0f} GiB  fits={mem['fits']}",
-            event="perf_mem", peak_gib=mem["max_peak_gib"],
-            fits=mem["fits"],
-        )
-        misses = result["efficiency_misses"]
-        if misses:
-            nmiss = sum(len(v) for v in misses.values())
-            log.info(
-                f"[calibration] {nmiss} efficiency-table misses "
-                f"(run simumax_tpu.calibration to refine)",
-                event="perf_misses", misses=nmiss,
-            )
+        print_summary(result)
 
     def ledger(self):
         """Collect the cost-attribution ledger of the current estimate

@@ -99,6 +99,13 @@ class _Env:
 #: unpicklable closures, so each pool worker builds its own lazily)
 _SCORERS: dict = {}
 
+#: block-kind profile seed per (model, system) key, set by the planner
+#: from the persistent store (``service/planner.py::
+#: load_batched_profiles``) before a sweep: a warm process skips
+#: profile construction entirely. Under the fork start method pool
+#: workers inherit the seed copy-on-write.
+_PROFILE_SEED: dict = {}
+
 
 def _batched_scorer(model, system):
     from simumax_tpu.search.batched import BatchedScorer
@@ -110,6 +117,12 @@ def _batched_scorer(model, system):
         if len(_SCORERS) > 2:
             _SCORERS.clear()
         got = BatchedScorer(model, system)
+        seed = _PROFILE_SEED.get(key)
+        if seed:
+            # profile values are pure functions of their content key
+            # (deterministic rebuilds), so seeding can never change a
+            # score — it only skips the construction
+            got._kind_cache.update(seed)
         _SCORERS[key] = got
     return got
 

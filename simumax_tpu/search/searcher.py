@@ -643,6 +643,8 @@ def search_best_parallel_strategy(
     simulate: bool = False,
     engine: str = "scalar",
     verify_topk: Optional[int] = None,
+    store=None,
+    on_cell=None,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
@@ -681,7 +683,23 @@ def search_best_parallel_strategy(
     oracle — the returned top-k rows are exact scalar rows. Cells the
     kernel does not model silently fall back to the scalar path
     (documented in ``docs/search.md``); ``project_dualpp`` / ``simulate``
-    sweeps fall back entirely (both need the built estimate)."""
+    sweeps fall back entirely (both need the built estimate).
+
+    ``store`` (a ``service.store.ContentStore``) adds the persistent
+    per-cell layer (``docs/service.md``): every finished cell is written
+    under a content-addressed key — the canonical hash of the resolved
+    (model, system, non-swept base-strategy fields, gbs, engine,
+    code-version) tuple plus the cell coordinates — and cells already in
+    the store (from any previous grid, process, or server) are served
+    instead of evaluated: an overlapping grid only evaluates the delta.
+    Served cells are counted (``sweep_cells_cached``), marked
+    ``status=cached`` in the audit CSV, and NOT journaled (the journal
+    checkpoints only this run's delta; the store already holds the
+    rest). The returned rows are bit-identical either way.
+
+    ``on_cell(key, status, row)`` fires for every settled cell —
+    replayed and store-served cells first, then evaluated cells in
+    completion order (the server's NDJSON row stream)."""
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     if engine not in ("scalar", "batched"):
@@ -758,9 +776,43 @@ def search_best_parallel_strategy(
         tp_list, cp_list, ep_list, pp_list, zero_list, recompute_types,
         prune=prune,
     )
+    # persistent per-cell layer: the content-addressed key prefix of
+    # this sweep — full resolved model/system content (calibration
+    # tables + provenance included) and every base-strategy field the
+    # grid does not override, so any relevant change misses while an
+    # overlapping grid hits cell-for-cell
+    cell_key_fn = None
+    if store is not None:
+        import dataclasses as _dc
+
+        from simumax_tpu.service.store import code_version, content_key
+
+        overridden = {"tp_size", "cp_size", "ep_size", "pp_size",
+                      "zero_state", "micro_batch_size",
+                      "micro_batch_num"}
+        sweep_prefix = content_key({
+            "kind": "sweep_cell",
+            "code_version": code_version(),
+            "engine": engine,
+            "simulate": simulate,
+            "project_dualpp": project_dualpp,
+            "gbs": global_batch_size,
+            "model": model.to_dict(),
+            "system": system.to_dict(),
+            "base_strategy": {
+                f.name: getattr(base_strategy, f.name)
+                for f in _dc.fields(type(base_strategy))
+                if f.name not in overridden
+            },
+        })
+
+        def cell_key_fn(cell, _prefix=sweep_prefix):
+            return content_key({"sweep": _prefix, "cell": cell.key})
+
     rows: List[dict] = []
     quarantine: List[dict] = []
     replayed: Dict[int, dict] = {}
+    cached: Dict[int, dict] = {}
     to_run = []
     for cell in cells:
         prior = resumed.get(cell.key)
@@ -771,13 +823,24 @@ def search_best_parallel_strategy(
             prior = None
         if prior is not None:
             replayed[cell.idx] = prior
-        else:
-            to_run.append(cell)
+            continue
+        if cell_key_fn is not None:
+            entry = store.get("sweep", cell_key_fn(cell))
+            # only settled verdicts are served; "error" outcomes are
+            # transient (timeouts, crashed workers) and never persisted
+            # — serving one forever would quarantine an evaluable cell
+            # for every future grid
+            if isinstance(entry, dict) \
+                    and entry.get("status") in ("ok", "empty"):
+                cached[cell.idx] = entry
+                continue
+        to_run.append(cell)
     diagnostics.count("sweep_cells_total",
                       len(cells) + len(pruned_rows) + len(deduped_rows))
     diagnostics.count("sweep_cells_pruned", len(pruned_rows))
     diagnostics.count("sweep_cells_deduped", len(deduped_rows))
     diagnostics.count("sweep_cells_replayed", len(replayed))
+    diagnostics.count("sweep_cells_cached", len(cached))
     diagnostics.count("sweep_cells_evaluated", len(to_run))
     diagnostics.counters["sweep_jobs"] = max(1, int(jobs or 1))
     # every PerfLLM built under a candidate reports into this run's
@@ -792,6 +855,30 @@ def search_best_parallel_strategy(
                 if journal:
                     journal.append(outcome.cell.key, outcome.status,
                                    row=outcome.row, error=outcome.error)
+                # persist the finished cell for every future
+                # overlapping grid (same moment as the journal write,
+                # so a killed sweep's store is as fresh as its
+                # journal). Transient failures are journal-only; the
+                # store write itself is best-effort — a full disk must
+                # not kill a sweep that evaluated fine.
+                if cell_key_fn is not None \
+                        and outcome.status in ("ok", "empty"):
+                    try:
+                        store.put("sweep", cell_key_fn(outcome.cell), {
+                            "status": outcome.status,
+                            "row": outcome.row,
+                            "error": outcome.error,
+                        })
+                    except OSError as exc:
+                        diagnostics.warn(
+                            "search",
+                            f"could not persist sweep cell "
+                            f"{outcome.cell.key} to the planner cache: "
+                            f"{exc}",
+                        )
+                if on_cell is not None:
+                    on_cell(outcome.cell.key, outcome.status,
+                            outcome.row)
                 row = outcome.row
                 if verbose and row and row.get("fits"):
                     from simumax_tpu.observe.report import get_reporter
@@ -807,12 +894,17 @@ def search_best_parallel_strategy(
                         attribution=row.get("attribution"),
                     )
 
-            # replayed cells ride the journal, not the executor —
-            # processed (and re-journaled) BEFORE the long evaluation
-            # phase, so a sweep killed mid-run keeps its resumed prefix
-            # in the new journal
+            # replayed / store-served cells ride the journal or the
+            # store, not the executor — processed (and re-journaled)
+            # BEFORE the long evaluation phase, so a sweep killed
+            # mid-run keeps its resumed prefix in the new journal.
+            # Store-served cells are never journaled: the journal
+            # checkpoints this run's delta, the store holds the rest.
             for cell in cells:
                 prior = replayed.get(cell.idx)
+                from_store = prior is None
+                if from_store:
+                    prior = cached.get(cell.idx)
                 if prior is None:
                     continue
                 status = prior["status"]
@@ -825,12 +917,14 @@ def search_best_parallel_strategy(
                         err.get("error_msg") or "journaled failure",
                         candidate=cell.key, phase="search",
                         exception=err.get("error_type", ""),
-                        replayed=True,
+                        replayed=not from_store, cached=from_store,
                     )
-                if rejournal:
+                if rejournal and not from_store:
                     journal.append(cell.key, status,
                                    row=prior.get("row"),
                                    error=prior.get("error"))
+                if on_cell is not None:
+                    on_cell(cell.key, status, prior.get("row"))
             outcomes = run_cells(
                 to_run,
                 base_strategy=base_strategy, model=model, system=system,
@@ -845,8 +939,13 @@ def search_best_parallel_strategy(
             journal.close()
     # merge outcomes back in deterministic grid order so ranking and
     # dedup are identical however the cells were scheduled
+    cached_row_ids = set()
     for cell in cells:
+        from_store = False
         prior = replayed.get(cell.idx)
+        if prior is None and cell.idx in cached:
+            prior = cached[cell.idx]
+            from_store = True
         if prior is not None:
             status, row = prior["status"], prior.get("row")
             err = prior.get("error")
@@ -861,6 +960,8 @@ def search_best_parallel_strategy(
             quarantine.append(_quarantine_row(st, cell.rc, err or {}))
         elif status == "ok" and row and row.get("fits"):
             rows.append(row)
+            if from_store:
+                cached_row_ids.add(id(row))
     diagnostics.count("sweep_cells_quarantined", len(quarantine))
     # dedup: the recompute-layer search bottoming out at 0 layers is the
     # same candidate as the no-recompute row
@@ -885,7 +986,15 @@ def search_best_parallel_strategy(
         for r in rows:
             r.pop("strategy_spec", None)
     if csv_path:
-        csv_rows = rows + quarantine + pruned_rows + deduped_rows
+        # store-served cells are auditable in the CSV (status=cached,
+        # like status=deduped rows) without perturbing the returned
+        # rows — responses stay bit-identical cache-on vs cache-off
+        csv_result_rows = [
+            {**r, "status": "cached"} if id(r) in cached_row_ids else r
+            for r in rows
+        ]
+        csv_rows = csv_result_rows + quarantine + pruned_rows \
+            + deduped_rows
         fields: List[str] = []
         for r in csv_rows:
             for k in r:

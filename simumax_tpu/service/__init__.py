@@ -1,0 +1,36 @@
+"""Planning-service layer (L9): persistent content-addressed result
+store, the ``Planner`` facade every entry point routes through, and the
+JSON-over-HTTP query server.
+
+See ``docs/service.md`` for the cache-key contract, invalidation rules,
+server API and eviction policy.
+"""
+
+from simumax_tpu.service.store import (  # noqa: F401
+    ContentStore,
+    canonical,
+    canonical_bytes,
+    code_version,
+    content_key,
+    default_cache_dir,
+)
+
+__all__ = [
+    "ContentStore",
+    "Planner",
+    "canonical",
+    "canonical_bytes",
+    "code_version",
+    "content_key",
+    "default_cache_dir",
+]
+
+
+def __getattr__(name):
+    # Planner pulls in perf/search; keep `import simumax_tpu.service`
+    # light for store-only consumers (the cache CLI subcommand)
+    if name == "Planner":
+        from simumax_tpu.service.planner import Planner
+
+        return Planner
+    raise AttributeError(name)

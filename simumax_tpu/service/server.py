@@ -1,0 +1,360 @@
+"""Stdlib-only JSON-over-HTTP planning server (L9).
+
+``python -m simumax_tpu serve`` runs a long-lived
+``ThreadingHTTPServer`` whose query endpoints all route through one
+shared :class:`~simumax_tpu.service.planner.Planner` — so concurrent
+requests share the persistent content-addressed store, identical
+in-flight queries are single-flighted down to one evaluation, and every
+response is bit-identical to a direct (cache-off) evaluation.
+
+API (all request bodies are JSON; ``model`` / ``strategy`` / ``system``
+accept registry names, config-file paths, or fully inline config
+dicts):
+
+====================  =====================================================
+``GET /healthz``      liveness: ``{"status": "ok", "uptime_s": ...}``
+``GET /stats``        service counters: requests / errors / latency
+                      percentiles per endpoint, planner hit/miss/
+                      single-flight counters, store size + eviction
+                      counters
+``POST /v1/estimate`` full analytical estimate (``Planner.estimate``)
+``POST /v1/explain``  cost-attribution ledger + per-op rows
+``POST /v1/search``   strategy sweep; ``"stream": true`` switches the
+                      response to chunked NDJSON — one
+                      ``{"cell": ...}`` line per settled grid cell
+                      (store-served cells first, evaluated cells in
+                      completion order) then a final ``{"result": ...}``
+``POST /v1/faults``   seeded Monte-Carlo goodput analysis
+``POST /v1/simulate`` discrete-event replay summary
+====================  =====================================================
+
+Every response carries ``X-SimuMax-Cache: hit|miss`` (+ the
+content-addressed key in ``X-SimuMax-Key``); the *body* is the
+canonical payload either way. Config-family errors return 400 with
+``{"error": ...}``; unexpected failures 500. Request logging goes
+through the shared Reporter at debug level (``serve --log-level
+debug``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from simumax_tpu.service.planner import Planner
+
+
+def response_bytes(payload: Any) -> bytes:
+    """The one serialization every JSON response body goes through —
+    shared with the bench/tests so bit-identity checks compare the
+    exact wire bytes."""
+    return json.dumps(
+        payload, sort_keys=True, separators=(",", ":"), default=str,
+    ).encode("utf-8")
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over pre-sorted values — the one
+    implementation behind both /stats and bench_service.py, so the
+    benched p50/p99 stay comparable with the served ones."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+class _ServiceStats:
+    """Thread-safe request/latency accounting behind ``/stats``."""
+
+    def __init__(self, window: int = 8192):
+        self._lock = threading.Lock()
+        self.started = time.time()
+        self.requests: Dict[str, int] = {}
+        self.errors = 0
+        self._lat: Dict[str, deque] = {}
+        self._window = window
+
+    def record(self, endpoint: str, elapsed_s: float, error: bool):
+        with self._lock:
+            self.requests[endpoint] = self.requests.get(endpoint, 0) + 1
+            if error:
+                self.errors += 1
+            lat = self._lat.setdefault(
+                endpoint, deque(maxlen=self._window)
+            )
+            lat.append(elapsed_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            requests = dict(self.requests)
+            errors = self.errors
+            lat = {k: sorted(v) for k, v in self._lat.items()}
+        uptime = time.time() - self.started
+        total = sum(requests.values())
+        latency = {
+            k: {
+                "count": len(v),
+                "p50_ms": round(percentile(v, 0.50) * 1e3, 3),
+                "p99_ms": round(percentile(v, 0.99) * 1e3, 3),
+            }
+            for k, v in lat.items()
+        }
+        return {
+            "uptime_s": round(uptime, 3),
+            "requests": requests,
+            "requests_total": total,
+            "qps": round(total / uptime, 3) if uptime > 0 else 0.0,
+            "errors": errors,
+            "latency": latency,
+        }
+
+
+class PlannerHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the shared planner + stats."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, planner: Planner):
+        super().__init__(addr, _Handler)
+        self.planner = planner
+        self.stats = _ServiceStats()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "simumax-tpu-planner/1"
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through the Reporter
+        from simumax_tpu.observe.report import get_reporter
+
+        get_reporter().debug(
+            f"[serve] {self.address_string()} {fmt % args}",
+            event="serve_request",
+        )
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        data = json.loads(raw.decode("utf-8") or "{}")
+        if not isinstance(data, dict):
+            raise ValueError("request body must be a JSON object")
+        return data
+
+    def _send_json(self, code: int, payload: Any,
+                   meta: Optional[dict] = None):
+        body = payload if isinstance(payload, bytes) \
+            else response_bytes(payload)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if meta:
+            self.send_header("X-SimuMax-Cache", meta.get("cache", ""))
+            if meta.get("key"):
+                self.send_header("X-SimuMax-Key", meta["key"])
+            if "cells_cached" in meta:
+                # serving-dependent sweep accounting rides headers so
+                # the body stays bit-identical warm vs cold
+                self.send_header(
+                    "X-SimuMax-Cells",
+                    f"cached={meta['cells_cached']} "
+                    f"evaluated={meta['cells_evaluated']}",
+                )
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code: int, message: str):
+        self._send_json(code, {"error": message})
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (http.server API)
+        t0 = time.perf_counter()
+        err = False
+        try:
+            if self.path == "/healthz":
+                self._send_json(200, {
+                    "status": "ok",
+                    "uptime_s": round(
+                        time.time() - self.server.stats.started, 3),
+                })
+            elif self.path == "/stats":
+                snap = self.server.stats.snapshot()
+                snap.update(self.server.planner.stats())
+                self._send_json(200, snap)
+            else:
+                err = True
+                self._send_error_json(404, f"unknown path {self.path}")
+        except BrokenPipeError:
+            err = True
+        finally:
+            self.server.stats.record(
+                self.path.split("?")[0], time.perf_counter() - t0, err
+            )
+
+    # -- POST --------------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        t0 = time.perf_counter()
+        endpoint = self.path.split("?")[0]
+        err = False
+        try:
+            try:
+                q = self._body()
+            except (ValueError, json.JSONDecodeError) as exc:
+                err = True
+                self._send_error_json(400, f"bad request body: {exc}")
+                return
+            try:
+                self._dispatch(endpoint, q)
+                # a streamed search that failed mid-body could only
+                # report the error as an NDJSON line; count it here
+                err = err or getattr(self, "_stream_error", False)
+            except BrokenPipeError:
+                err = True
+            except Exception as exc:
+                err = True
+                code = 400 if self._is_config_error(exc) else 500
+                self._send_error_json(
+                    code, f"{type(exc).__name__}: {exc}"
+                )
+        finally:
+            self.server.stats.record(
+                endpoint, time.perf_counter() - t0, err
+            )
+
+    @staticmethod
+    def _is_config_error(exc: Exception) -> bool:
+        from simumax_tpu.core.errors import (
+            ConfigError,
+            FeasibilityError,
+            UnknownConfigError,
+        )
+
+        return isinstance(
+            exc, (ConfigError, FeasibilityError, UnknownConfigError,
+                  TypeError, KeyError, ValueError)
+        )
+
+    def _dispatch(self, endpoint: str, q: dict):
+        planner = self.server.planner
+        if endpoint == "/v1/estimate":
+            # raw=True: a hit streams the stored canonical bytes
+            # without a parse + re-dump (same bytes either way)
+            payload, meta = planner.estimate(
+                q["model"], q["strategy"], q["system"], with_meta=True,
+                raw=True,
+            )
+            self._send_json(200, payload, meta)
+        elif endpoint == "/v1/explain":
+            payload, meta = planner.explain(
+                q["model"], q["strategy"], q["system"], with_meta=True,
+                raw=True,
+            )
+            self._send_json(200, payload, meta)
+        elif endpoint == "/v1/faults":
+            payload, meta = planner.faults(
+                q["model"], q["strategy"], q["system"],
+                monte_carlo=int(q.get("monte_carlo") or 8),
+                seed=int(q.get("seed") or 0),
+                horizon_steps=int(q.get("horizon") or 50),
+                granularity=q.get("granularity", "chunk"),
+                with_meta=True, raw=True,
+            )
+            self._send_json(200, payload, meta)
+        elif endpoint == "/v1/simulate":
+            payload, meta = planner.simulate(
+                q["model"], q["strategy"], q["system"],
+                granularity=q.get("granularity", "chunk"),
+                track_memory=bool(q.get("track_memory", False)),
+                with_meta=True, raw=True,
+            )
+            self._send_json(200, payload, meta)
+        elif endpoint == "/v1/search":
+            self._search(planner, q)
+        else:
+            self._send_error_json(404, f"unknown path {endpoint}")
+
+    def _search_kwargs(self, q: dict) -> dict:
+        def ints(v, default):
+            if v is None:
+                return default
+            if isinstance(v, str):
+                return tuple(int(x) for x in v.split(","))
+            return tuple(int(x) for x in v)
+
+        return dict(
+            model=q["model"], system=q["system"],
+            global_batch_size=int(q["gbs"]),
+            base_strategy=q.get("base_strategy", "tp1_pp1_dp8_mbs1"),
+            world=int(q.get("world") or 0),
+            seq_len=int(q.get("seq_len") or 0),
+            tp_list=ints(q.get("tp"), (1, 2, 4, 8)),
+            pp_list=ints(q.get("pp"), (1, 2, 4)),
+            ep_list=ints(q.get("ep"), (1,)),
+            cp_list=ints(q.get("cp"), (1,)),
+            zero_list=ints(q.get("zero"), (1,)),
+            topk=int(q.get("topk") or 5),
+            engine=q.get("engine", "scalar"),
+            verify_topk=q.get("verify_topk"),
+        )
+
+    def _search(self, planner: Planner, q: dict):
+        kwargs = self._search_kwargs(q)
+        if not q.get("stream"):
+            payload, meta = planner.search(**kwargs, with_meta=True)
+            self._send_json(200, payload, meta)
+            return
+        # chunked NDJSON: one line per settled cell, then the result
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj):
+            line = response_bytes(obj) + b"\n"
+            self.wfile.write(
+                f"{len(line):x}\r\n".encode("ascii") + line + b"\r\n"
+            )
+            self.wfile.flush()
+
+        def on_cell(key, status, row):
+            chunk({"cell": key, "status": status, "row": row})
+
+        try:
+            payload, meta = planner.search(**kwargs, on_cell=on_cell,
+                                           with_meta=True)
+            chunk({"result": payload})
+            # serving accounting on its own line: the result line stays
+            # bit-identical however the cells were served
+            chunk({"serving": {
+                "cache": meta["cache"],
+                "cells_cached": meta["cells_cached"],
+                "cells_evaluated": meta["cells_evaluated"],
+            }})
+        except Exception as exc:
+            self._stream_error = True
+            chunk({"error": f"{type(exc).__name__}: {exc}"})
+        self.wfile.write(b"0\r\n\r\n")
+
+
+def make_server(planner: Optional[Planner] = None,
+                host: str = "127.0.0.1",
+                port: int = 8642) -> PlannerHTTPServer:
+    """Build (but do not start) the server; ``port=0`` binds an
+    ephemeral port (``server.server_address[1]`` has the real one)."""
+    return PlannerHTTPServer((host, port), planner or Planner())
+
+
+def serve_forever(server: PlannerHTTPServer):
+    """Run until interrupted, closing the socket on the way out."""
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()

@@ -25,3 +25,16 @@ except ImportError:  # pragma: no cover
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_service_cache(tmp_path, monkeypatch):
+    """Point the persistent planner cache (service layer,
+    docs/service.md) at a per-test temp dir: tests never read or write
+    the developer's ~/.cache/simumax-tpu, and no cached result can leak
+    between tests (results are bit-identical either way — this is
+    hygiene, not correctness)."""
+    monkeypatch.setenv("SIMUMAX_TPU_CACHE_DIR",
+                       str(tmp_path / "service-cache"))

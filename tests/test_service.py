@@ -1,0 +1,487 @@
+"""Planning-service layer tests (``simumax_tpu/service/``,
+``docs/service.md``): the content-addressed store's integrity / LRU /
+atomicity contract, the cache-key invalidation rules, planner parity
+(cache-on == cache-off, bit-identical), single-flight concurrency, and
+the per-cell persistent sweep layer (overlapping grids evaluate only
+the delta; journals carry only the delta)."""
+
+import copy
+import json
+import os
+import threading
+
+import pytest
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.service.planner import Planner, query_identity
+from simumax_tpu.service.store import (
+    ContentStore,
+    canonical_bytes,
+    content_key,
+)
+
+MODEL, STRAT, SYS = "llama3-8b", "tp1_pp2_dp4_mbs1", "tpu_v5e_256"
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return ContentStore(str(tmp_path / "store"))
+
+
+@pytest.fixture()
+def planner(tmp_path):
+    return Planner(cache_dir=str(tmp_path / "planner-store"))
+
+
+# --------------------------------------------------------------------------
+# ContentStore
+# --------------------------------------------------------------------------
+
+
+def test_store_roundtrip_json_and_pickle(store):
+    key = content_key({"q": 1})
+    store.put("estimate", key, {"a": [1, 2], "b": "x"})
+    assert store.get("estimate", key) == {"a": [1, 2], "b": "x"}
+    import numpy as np
+
+    blob = {"arr": np.arange(4.0), "k": (1, "x")}
+    store.put("profiles", key, blob, fmt="pickle")
+    back = store.get("profiles", key)
+    assert list(back["arr"]) == [0.0, 1.0, 2.0, 3.0]
+    assert back["k"] == (1, "x")
+    # namespaces are distinct: same key, different entries
+    assert store.get("estimate", key) == {"a": [1, 2], "b": "x"}
+
+
+def test_store_miss_and_counters(store):
+    assert store.get("estimate", "0" * 64) is None
+    store.put("estimate", "1" * 64, {"v": 1})
+    store.get("estimate", "1" * 64)
+    c = store.stats()["counters"]
+    assert c["misses"] == 1 and c["hits"] == 1 and c["puts"] == 1
+
+
+def test_store_atomic_write_leaves_no_temp_files(store):
+    for i in range(8):
+        store.put("estimate", content_key(i), {"i": i})
+    leftovers = [
+        fn for _dir, _s, files in os.walk(store.root) for fn in files
+        if not fn.endswith(".entry")
+    ]
+    assert leftovers == []
+
+
+def test_store_corrupt_entry_dropped_not_served(store):
+    key = content_key({"q": "corrupt"})
+    path = store.put("estimate", key, {"v": 42})
+    blob = open(path, "rb").read()
+    # flip a payload byte after the header line
+    cut = blob.find(b"\n") + 3
+    with open(path, "wb") as f:
+        f.write(blob[:cut] + bytes([blob[cut] ^ 0xFF]) + blob[cut + 1:])
+    assert store.get("estimate", key) is None  # dropped, not served
+    assert not os.path.exists(path)
+    assert store.stats()["counters"]["corrupt_dropped"] == 1
+
+
+def test_store_verify_reports_corrupt(store):
+    k1, k2 = content_key(1), content_key(2)
+    store.put("estimate", k1, {"v": 1})
+    p2 = store.put("estimate", k2, {"v": 2})
+    with open(p2, "ab") as f:
+        f.write(b"garbage")
+    rep = store.verify()
+    assert rep["checked"] == 2 and rep["ok"] == 1
+    assert [c["path"] for c in rep["corrupt"]] == [p2]
+    # drop=True removes them; a re-verify is clean
+    store.verify(drop=True)
+    rep = store.verify()
+    assert rep["checked"] == 1 and not rep["corrupt"]
+
+
+def test_store_lru_eviction_is_size_bounded(tmp_path):
+    small = ContentStore(str(tmp_path / "small"), max_bytes=6000)
+    payload = {"blob": "x" * 900}  # ~1KB per entry
+    keys = [content_key(i) for i in range(10)]
+    for i, k in enumerate(keys):
+        small.put("estimate", k, payload)
+        # establish LRU order deterministically
+        os.utime(small._path("estimate", k), (1000 + i, 1000 + i))
+    small.put("estimate", content_key("last"), payload)
+    stats = small.stats()
+    assert stats["total_bytes"] <= 6000
+    assert stats["counters"]["evictions"] > 0
+    # the oldest entries were the ones evicted
+    assert small.get("estimate", keys[0]) is None
+    assert small.get("estimate", content_key("last")) is not None
+
+
+def test_store_clear_by_namespace(store):
+    store.put("estimate", content_key(1), {"v": 1})
+    store.put("sweep", content_key(2), {"v": 2})
+    assert store.clear("estimate") == 1
+    assert store.get("sweep", content_key(2)) == {"v": 2}
+    assert store.clear() == 1
+
+
+# --------------------------------------------------------------------------
+# Cache keys: canonicalization + invalidation
+# --------------------------------------------------------------------------
+
+
+def _configs():
+    return (get_model_config(MODEL), get_strategy_config(STRAT),
+            get_system_config(SYS))
+
+
+def _key(model, strategy, system):
+    return content_key(query_identity(
+        "estimate", model=model, strategy=strategy, system=system))
+
+
+def test_key_ordering_and_path_independent(tmp_path):
+    from simumax_tpu.core.config import ModelConfig
+
+    model, strategy, system = _configs()
+    base = _key(model, strategy, system)
+    # same content, reversed dict order -> same key
+    d = model.to_dict()
+    reordered = ModelConfig.init_from_dict(dict(reversed(list(d.items()))))
+    assert _key(reordered, strategy, system) == base
+    # same content loaded from a different path -> same key
+    alt = tmp_path / "same-model-elsewhere.json"
+    alt.write_text(json.dumps(d))
+    from_path = ModelConfig.init_from_config_file(str(alt))
+    assert _key(from_path, strategy, system) == base
+
+
+def test_key_invalidation_per_config_family(monkeypatch):
+    model, strategy, system = _configs()
+    base = _key(model, strategy, system)
+    mutations = 0
+    # model family
+    m2 = copy.deepcopy(model)
+    m2.layer_num += 1
+    assert _key(m2, strategy, system) != base
+    mutations += 1
+    # strategy family
+    s2 = copy.deepcopy(strategy)
+    s2.micro_batch_num *= 2
+    assert _key(model, s2, system) != base
+    mutations += 1
+    # system family: a hardware field
+    y2 = copy.deepcopy(system)
+    y2.accelerator.mem_gbs += 1
+    assert _key(model, strategy, y2) != base
+    mutations += 1
+    # system family: a calibration-table entry (no hardware change)
+    y3 = copy.deepcopy(system)
+    y3.accelerator.op["default"].accurate_efficient_factor["x"] = 0.5
+    assert _key(model, strategy, y3) != base
+    mutations += 1
+    # calibration provenance stamp swap
+    y4 = copy.deepcopy(system)
+    y4.provenance = {"system_hash": "feedface", "created": "2026-01-01",
+                     "version": "0.0.9"}
+    assert _key(model, strategy, y4) != base
+    mutations += 1
+    # package code-version bump
+    import simumax_tpu.version
+
+    monkeypatch.setattr(simumax_tpu.version, "__version__", "99.0.0")
+    assert _key(model, strategy, system) != base
+    mutations += 1
+    assert mutations == 6
+
+
+def test_canonical_bytes_sorts_and_normalizes():
+    a = canonical_bytes({"b": (1, 2), "a": {2, 1}})
+    b = canonical_bytes({"a": [1, 2], "b": [1, 2]})
+    assert a == b
+
+
+# --------------------------------------------------------------------------
+# Planner parity + caching
+# --------------------------------------------------------------------------
+
+
+def test_estimate_cache_on_off_bit_identical(planner):
+    off = Planner(enabled=False)
+    cold = planner.estimate(MODEL, STRAT, SYS)     # populates
+    warm = planner.estimate(MODEL, STRAT, SYS)     # served
+    direct = off.estimate(MODEL, STRAT, SYS)
+    assert canonical_bytes(cold) == canonical_bytes(warm) \
+        == canonical_bytes(direct)
+    assert planner.counters["evaluations"] == 1
+    assert planner.counters["hits"] == 1
+    # raw bytes path (the server's) is the same serialization
+    raw, meta = planner.estimate(MODEL, STRAT, SYS, with_meta=True,
+                                 raw=True)
+    assert meta["cache"] == "hit"
+    from simumax_tpu.service.server import response_bytes
+
+    assert raw == response_bytes(direct)
+
+
+def test_explain_cache_on_off_bit_identical(planner):
+    off = Planner(enabled=False)
+    cold = planner.explain(MODEL, STRAT, SYS)
+    warm, meta = planner.explain(MODEL, STRAT, SYS, with_meta=True)
+    assert meta["cache"] == "hit"
+    direct = off.explain(MODEL, STRAT, SYS)
+    assert canonical_bytes(cold) == canonical_bytes(warm) \
+        == canonical_bytes(direct)
+    # the payload is a full ledger (diff-able) + renderable op rows
+    from simumax_tpu.observe.ledger import (
+        top_op_lines_from_rows,
+        waterfall_lines_from_dict,
+    )
+
+    lines = waterfall_lines_from_dict(warm["ledger"])
+    assert any("MFU-loss waterfall" in ln for ln in lines)
+    assert top_op_lines_from_rows(warm["op_rows"], 5)
+
+
+def test_estimate_inline_dict_hits_name_key(planner):
+    model, strategy, system = _configs()
+    a = planner.estimate(MODEL, STRAT, SYS)
+    _, meta = planner.estimate(
+        model.to_dict(), strategy.to_dict(), system.to_dict(),
+        with_meta=True,
+    )
+    assert meta["cache"] == "hit"
+    b = planner.estimate(model.to_dict(), strategy.to_dict(),
+                         system.to_dict())
+    assert canonical_bytes(a) == canonical_bytes(b)
+
+
+def test_version_bump_misses(planner, monkeypatch):
+    planner.estimate(MODEL, STRAT, SYS)
+    import simumax_tpu.version
+
+    monkeypatch.setattr(simumax_tpu.version, "__version__", "99.0.0")
+    _, meta = planner.estimate(MODEL, STRAT, SYS, with_meta=True)
+    assert meta["cache"] == "miss"
+    assert planner.counters["evaluations"] == 2
+
+
+def test_singleflight_one_evaluation_for_n_threads(planner):
+    n = 8
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def worker(i):
+        barrier.wait()
+        results[i] = planner.estimate(MODEL, STRAT, SYS)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly one evaluation however the threads raced (leader
+    # computes; followers either waited on the flight or hit the store)
+    assert planner.counters["evaluations"] == 1
+    blobs = {canonical_bytes(r) for r in results}
+    assert len(blobs) == 1
+
+
+def test_singleflight_leader_error_propagates(planner):
+    # an unknown config raises in every thread, and nothing is cached
+    from simumax_tpu.core.errors import UnknownConfigError
+
+    with pytest.raises(UnknownConfigError):
+        planner.estimate("no-such-model", STRAT, SYS)
+    with pytest.raises(UnknownConfigError):
+        planner.estimate("no-such-model", STRAT, SYS)
+    assert planner.counters["hits"] == 0
+
+
+# --------------------------------------------------------------------------
+# Per-cell persistent sweep layer
+# --------------------------------------------------------------------------
+
+SWEEP = dict(global_batch_size=32, world=32, pp_list=(1,),
+             zero_list=(1,), topk=3)
+
+
+def test_search_overlapping_grid_evaluates_only_delta(tmp_path):
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    a, meta_a = planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2),
+                               with_meta=True, **SWEEP)
+    assert a["cells"] == {"total": 6, "pruned": 0, "deduped": 0,
+                         "quarantined": 0}
+    assert meta_a["cells_evaluated"] == 6 and meta_a["cells_cached"] == 0
+    b, meta_b = planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2, 4),
+                               with_meta=True, **SWEEP)
+    assert meta_b["cells_cached"] == 6 and meta_b["cells_evaluated"] == 3
+    # the WHOLE response is bit-identical to a cache-off evaluation:
+    # serving-dependent counters live in the meta, not the payload
+    off = Planner(enabled=False)
+    direct = off.search(MODEL, "tpu_v5p_256", tp_list=(1, 2, 4), **SWEEP)
+    assert canonical_bytes(b) == canonical_bytes(direct)
+
+
+def test_search_journal_carries_only_delta_cells(tmp_path):
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    j1 = str(tmp_path / "first.jsonl")
+    j2 = str(tmp_path / "second.jsonl")
+
+    def journaled_keys(path):
+        keys = []
+        with open(path) as f:
+            for line in f:
+                entry = json.loads(line)
+                if "key" in entry:
+                    keys.append(entry["key"])
+        return keys
+
+    planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2),
+                   journal_path=j1, **SWEEP)
+    assert len(journaled_keys(j1)) == 6
+    planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2, 4),
+                   journal_path=j2, **SWEEP)
+    # only the tp=4 delta cells were evaluated and journaled
+    keys = journaled_keys(j2)
+    assert len(keys) == 3
+    assert all(k.startswith("tp4_") for k in keys)
+
+
+def test_search_csv_marks_cached_cells(tmp_path):
+    import csv
+
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2), **SWEEP)
+    csv_path = str(tmp_path / "sweep.csv")
+    planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2, 4),
+                   csv_path=csv_path, **SWEEP)
+    with open(csv_path) as f:
+        rows = list(csv.DictReader(f))
+    statuses = {r["status"] for r in rows}
+    assert "cached" in statuses  # served cells are auditable
+    cached_tps = {r["tp"] for r in rows if r["status"] == "cached"}
+    assert cached_tps <= {"1", "2"}
+    ok_tps = {r["tp"] for r in rows if r["status"] == "ok"}
+    assert "4" in ok_tps
+
+
+def test_search_store_concurrent_same_grid_single_sweep(tmp_path):
+    # same cold sweep from 2 threads: the single-flight layer is
+    # per-query for estimates; sweeps share per-cell store entries, so
+    # total evaluations across both runs stay <= one grid's worth + the
+    # races (no exception, identical results)
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    out = [None, None]
+
+    def run(i):
+        out[i] = planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2),
+                                **SWEEP)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert canonical_bytes(out[0]["rows"]) == canonical_bytes(
+        out[1]["rows"])
+
+
+def test_batched_profiles_persist_and_seed(tmp_path):
+    from simumax_tpu.search import executor as _executor
+
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    _executor._SCORERS.clear()
+    _executor._PROFILE_SEED.clear()
+    a, meta_a = planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2),
+                               engine="batched", with_meta=True,
+                               **SWEEP)
+    stats = planner.store.stats()
+    assert stats["namespaces"].get("profiles", {}).get("entries") == 1
+    # a "fresh process": clear the in-memory scorers, re-search — the
+    # scorer must be seeded from the store before scoring anything
+    _executor._SCORERS.clear()
+    _executor._PROFILE_SEED.clear()
+    b, meta_b = planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 2),
+                               engine="batched", with_meta=True,
+                               **SWEEP)
+    assert _executor._PROFILE_SEED  # seed was loaded
+    assert meta_b["cells_cached"] == a["cells"]["total"]
+    assert meta_b["cache"] == "hit"
+    assert canonical_bytes(a["rows"]) == canonical_bytes(b["rows"])
+
+
+def test_transient_error_cells_are_not_persisted(tmp_path, monkeypatch):
+    # a timed-out / crashed cell must not poison the global store: the
+    # next sweep (any process) has to re-evaluate it
+    from simumax_tpu.search import searcher as _searcher
+
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    real = _searcher._evaluate_sweep_cell
+    calls = {"n": 0}
+
+    def flaky(st, rc, *a, **k):
+        calls["n"] += 1
+        if rc == "selective":
+            raise MemoryError("transient pressure")
+        return real(st, rc, *a, **k)
+
+    monkeypatch.setattr(_searcher, "_evaluate_sweep_cell", flaky)
+    a = planner.search(MODEL, "tpu_v5p_256", tp_list=(1,), **SWEEP)
+    assert a["cells"]["quarantined"] == 1
+    first = calls["n"]
+    monkeypatch.setattr(_searcher, "_evaluate_sweep_cell", real)
+    _b, meta = planner.search(MODEL, "tpu_v5p_256", tp_list=(1,),
+                              with_meta=True, **SWEEP)
+    # ok/empty cells were served; the errored cell re-evaluated clean
+    assert meta["cells_cached"] == 2 and meta["cells_evaluated"] == 1
+    assert first == 3
+
+
+def test_caller_config_objects_are_never_mutated(planner):
+    # evaluations pad the model's vocab in place; the planner must work
+    # on a copy so the same object keeps hashing to the same key
+    model = get_model_config(MODEL)
+    strategy = get_strategy_config("tp8_pp1_dp1_mbs1")  # tp=8 pads
+    system = get_system_config("tpu_v5p_256")
+    before = model.padded_vocab_size
+    planner.estimate(model, strategy, system)
+    assert model.padded_vocab_size == before
+    _p, meta = planner.estimate(model, strategy, system, with_meta=True)
+    assert meta["cache"] == "hit"
+
+
+def test_batched_profiles_key_stable_under_vocab_padding(tmp_path):
+    # tp=8 pads llama3-8b's vocab mid-sweep; the profiles entry must
+    # still land under the key a fresh process computes
+    from simumax_tpu.search import executor as _executor
+    from simumax_tpu.service.planner import batched_profiles_key
+
+    planner = Planner(cache_dir=str(tmp_path / "s"))
+    _executor._SCORERS.clear()
+    _executor._PROFILE_SEED.clear()
+    planner.search(MODEL, "tpu_v5p_256", tp_list=(1, 8),
+                   engine="batched", **SWEEP)
+    fresh_key = batched_profiles_key(get_model_config(MODEL),
+                                     get_system_config("tpu_v5p_256"))
+    assert planner.store.get("profiles", fresh_key) is not None
+
+
+def test_faults_and_simulate_cached_deterministically(planner):
+    a, meta_a = planner.faults(MODEL, STRAT, SYS, monte_carlo=3,
+                               seed=7, horizon_steps=10, with_meta=True)
+    b, meta_b = planner.faults(MODEL, STRAT, SYS, monte_carlo=3,
+                               seed=7, horizon_steps=10, with_meta=True)
+    assert meta_a["cache"] == "miss" and meta_b["cache"] == "hit"
+    assert canonical_bytes(a) == canonical_bytes(b)
+    s1, m1 = planner.simulate(MODEL, STRAT, SYS, with_meta=True,
+                              track_memory=False)
+    s2, m2 = planner.simulate(MODEL, STRAT, SYS, with_meta=True,
+                              track_memory=False)
+    assert m1["cache"] == "miss" and m2["cache"] == "hit"
+    assert canonical_bytes(s1) == canonical_bytes(s2)
+    assert s1["end_time_ms"] > 0

@@ -1,0 +1,288 @@
+"""HTTP server + service CLI tests: endpoint correctness and
+bit-identity over the wire, NDJSON streaming, concurrent single-flight,
+/healthz + /stats, the `cache` CLI subcommand family, the planner-routed
+`perf`/`explain`/`search` CLI paths, and a bench_service smoke run."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from simumax_tpu.service.planner import Planner
+from simumax_tpu.service.server import make_server, response_bytes
+
+MODEL, STRAT, SYS = "llama3-8b", "tp1_pp2_dp4_mbs1", "tpu_v5e_256"
+EST = {"model": MODEL, "strategy": STRAT, "system": SYS}
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = make_server(Planner(cache_dir=str(tmp_path / "srv-store")),
+                      "127.0.0.1", 0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _req(srv, method, path, body=None):
+    port = srv.server_address[1]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    conn.request(method, path,
+                 json.dumps(body) if body is not None else None,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, headers, data
+
+
+def test_healthz_and_404(server):
+    status, _h, data = _req(server, "GET", "/healthz")
+    assert status == 200 and json.loads(data)["status"] == "ok"
+    status, _h, data = _req(server, "GET", "/nope")
+    assert status == 404 and "error" in json.loads(data)
+
+
+def test_estimate_bit_identical_and_cache_headers(server):
+    status, h1, d1 = _req(server, "POST", "/v1/estimate", EST)
+    assert status == 200 and h1["X-SimuMax-Cache"] == "miss"
+    status, h2, d2 = _req(server, "POST", "/v1/estimate", EST)
+    assert status == 200 and h2["X-SimuMax-Cache"] == "hit"
+    assert d1 == d2
+    assert h1["X-SimuMax-Key"] == h2["X-SimuMax-Key"]
+    # wire bytes == direct cache-off evaluation, byte for byte
+    direct = Planner(enabled=False).estimate(MODEL, STRAT, SYS)
+    assert d1 == response_bytes(direct)
+
+
+def test_explain_and_simulate_endpoints(server):
+    status, h, data = _req(server, "POST", "/v1/explain", EST)
+    assert status == 200
+    payload = json.loads(data)
+    assert "ledger" in payload and "op_rows" in payload
+    status, _h, data = _req(server, "POST", "/v1/simulate",
+                            {**EST, "granularity": "chunk"})
+    assert status == 200
+    assert json.loads(data)["end_time_ms"] > 0
+
+
+def test_faults_endpoint_seeded(server):
+    q = {**EST, "monte_carlo": 3, "seed": 5, "horizon": 10}
+    status, h1, d1 = _req(server, "POST", "/v1/faults", q)
+    assert status == 200
+    status, h2, d2 = _req(server, "POST", "/v1/faults", q)
+    assert d1 == d2 and h2["X-SimuMax-Cache"] == "hit"
+
+
+def test_bad_requests_return_400_family(server):
+    status, _h, data = _req(server, "POST", "/v1/estimate",
+                            {"model": "no-such-model",
+                             "strategy": STRAT, "system": SYS})
+    assert status == 400 and "error" in json.loads(data)
+    # malformed body
+    port = server.server_address[1]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("POST", "/v1/estimate", "{not json",
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 400
+    resp.read()
+    conn.close()
+
+
+def test_search_stream_ndjson(server):
+    q = {"model": MODEL, "system": "tpu_v5p_256", "gbs": 32,
+         "world": 32, "tp": "1,2", "pp": "1", "zero": "1",
+         "stream": True}
+    status, headers, data = _req(server, "POST", "/v1/search", q)
+    assert status == 200
+    assert headers["Content-Type"] == "application/x-ndjson"
+    lines = [json.loads(x) for x in data.decode().strip().splitlines()]
+    cells = [ln for ln in lines if "cell" in ln]
+    assert len(cells) == 6
+    result = lines[-2]["result"]
+    assert lines[-1]["serving"]["cells_evaluated"] == 6
+    # replayed stream: all cells served from the store, and the result
+    # line is byte-identical (serving accounting on its own line)
+    status, _h, data2 = _req(server, "POST", "/v1/search", q)
+    lines2 = [ln for ln in data2.decode().strip().splitlines()]
+    parsed2 = [json.loads(ln) for ln in lines2]
+    assert parsed2[-1]["serving"]["cells_cached"] == 6
+    assert parsed2[-2]["result"] == result
+    # non-stream body is bit-identical warm vs a fresh direct eval
+    q2 = {k: v for k, v in q.items() if k != "stream"}
+    _s, h3, body_warm = _req(server, "POST", "/v1/search", q2)
+    assert h3["X-SimuMax-Cache"] == "hit"
+    assert "cached=6" in h3["X-SimuMax-Cells"]
+    direct = Planner(enabled=False).search(
+        MODEL, "tpu_v5p_256", 32, world=32, tp_list=(1, 2),
+        pp_list=(1,), zero_list=(1,), topk=5,
+    )
+    assert body_warm == response_bytes(direct)
+
+
+def test_concurrent_identical_queries_single_evaluation(server):
+    n = 6
+    out = [None] * n
+    barrier = threading.Barrier(n)
+
+    def hit(i):
+        barrier.wait()
+        out[i] = _req(server, "POST", "/v1/estimate", EST)
+
+    threads = [threading.Thread(target=hit, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(status == 200 for status, _h, _d in out)
+    bodies = {d for _s, _h, d in out}
+    assert len(bodies) == 1
+    _s, _h, data = _req(server, "GET", "/stats")
+    stats = json.loads(data)
+    assert stats["planner"]["evaluations"] == 1
+    assert stats["requests"]["/v1/estimate"] == n
+
+
+def test_stats_shape(server):
+    _req(server, "POST", "/v1/estimate", EST)
+    _s, _h, data = _req(server, "GET", "/stats")
+    stats = json.loads(data)
+    assert stats["requests_total"] >= 1 and stats["qps"] > 0
+    assert "/v1/estimate" in stats["latency"]
+    assert stats["latency"]["/v1/estimate"]["p99_ms"] >= \
+        stats["latency"]["/v1/estimate"]["p50_ms"] >= 0
+    assert stats["store"]["counters"]["puts"] >= 1
+    assert stats["enabled"] is True
+
+
+# --------------------------------------------------------------------------
+# CLI surface
+# --------------------------------------------------------------------------
+
+
+def test_cli_perf_planner_routed_output_identical(tmp_path, capsys):
+    from simumax_tpu.cli import main
+
+    cache = str(tmp_path / "cli-cache")
+    args = ["perf", "--model", MODEL, "--strategy", STRAT,
+            "--system", SYS, "--cache-dir", cache]
+    main(args)
+    cold = capsys.readouterr().out
+    main(args)
+    warm = capsys.readouterr().out
+    main(args + ["--no-cache"])
+    off = capsys.readouterr().out
+
+    def body(text):  # the [diagnostics] line carries run-scoped ids
+        return [ln for ln in text.splitlines()
+                if not ln.startswith("[diagnostics]")]
+
+    assert body(cold) == body(warm) == body(off)
+    assert any("MFU" in ln for ln in body(cold))
+    # the cache actually has the entry
+    store_stats = json.loads(
+        _cache_cli(tmp_path, cache, "stats")["report"])
+    assert store_stats["namespaces"]["estimate"]["entries"] == 1
+
+
+def _cache_cli(tmp_path, cache, action, *extra):
+    from simumax_tpu.cli import main
+
+    out = str(tmp_path / f"cache-{action}.json")
+    main(["cache", action, "--cache-dir", cache, "--json", out, *extra])
+    return {"report": open(out).read()}
+
+
+def test_cli_explain_planner_routed(tmp_path, capsys):
+    from simumax_tpu.cli import main
+
+    cache = str(tmp_path / "cli-cache")
+    ledger_a = str(tmp_path / "a.json")
+    ledger_b = str(tmp_path / "b.json")
+    args = ["explain", "--model", MODEL, "--strategy", STRAT,
+            "--system", SYS, "--cache-dir", cache]
+    main(args + ["--json", ledger_a])
+    cold = capsys.readouterr().out
+    main(args + ["--json", ledger_b])
+    warm = capsys.readouterr().out
+
+    def body(text):
+        return [ln for ln in text.splitlines()
+                if not ln.startswith("[diagnostics]")
+                and "ledger ->" not in ln]
+
+    assert body(cold) == body(warm)
+    assert any("MFU-loss waterfall" in ln for ln in body(cold))
+    # the saved ledger is a valid `diff` input
+    a = json.load(open(ledger_a))
+    b = json.load(open(ledger_b))
+    assert a == b and a["schema"].startswith("simumax")
+    main(["diff", ledger_a, ledger_b])
+    out = capsys.readouterr().out
+    assert "ledger diff" in out
+
+
+def test_cli_search_uses_store_and_marks_cached(tmp_path, capsys):
+    from simumax_tpu.cli import main
+
+    cache = str(tmp_path / "cli-cache")
+    base = ["search", "--model", MODEL, "--system", "tpu_v5p_256",
+            "--world", "32", "--gbs", "32", "--pp", "1", "--zero", "1",
+            "--jobs", "1", "--cache-dir", cache]
+    main(base + ["--tp", "1,2"])
+    capsys.readouterr()
+    main(base + ["--tp", "1,2,4"])
+    out = capsys.readouterr().out
+    assert "served 6/9 cells from the planner cache" in out
+
+
+def test_cli_cache_verify_and_clear(tmp_path, capsys):
+    from simumax_tpu.cli import main
+
+    cache = str(tmp_path / "cli-cache")
+    planner = Planner(cache_dir=cache)
+    planner.estimate(MODEL, STRAT, SYS)
+    rep = json.loads(_cache_cli(tmp_path, cache, "ls")["report"])
+    assert len(rep["entries"]) == 1
+    rep = json.loads(_cache_cli(tmp_path, cache, "verify")["report"])
+    assert rep["ok"] == 1 and not rep["corrupt"]
+    # corrupt it -> verify exits 1 and reports
+    path = rep_path = None
+    import os
+
+    for dirpath, _d, files in os.walk(cache):
+        for fn in files:
+            if fn.endswith(".entry"):
+                path = os.path.join(dirpath, fn)
+    with open(path, "ab") as f:
+        f.write(b"tail-garbage")
+    with pytest.raises(SystemExit) as exc:
+        main(["cache", "verify", "--cache-dir", cache])
+    assert exc.value.code == 1
+    capsys.readouterr()
+    main(["cache", "clear", "--cache-dir", cache, "--json",
+          str(tmp_path / "clear.json")])
+    rep = json.loads(open(str(tmp_path / "clear.json")).read())
+    assert rep["removed"] == 1
+
+
+def test_bench_service_smoke(tmp_path, capsys):
+    import bench_service
+
+    rc = bench_service.main([
+        "--queries", "24", "--threads", "2", "--overlap", "0.25",
+        "--min-speedup", "1.01",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert rc == 0, result
+    assert result["parity_ok"] is True
+    assert result["hit_rate_warm"] >= 0.9
+    assert result["errors"] == 0
+    assert result["queries"] == 24
