@@ -306,7 +306,7 @@ def measure_bandwidth_efficiency(
     the traffic. Returns achieved/peak bandwidth (of the modeled
     traffic — reads only where the reduction fuses away the write)."""
     if kind == "ce_fusion":
-        raise ValueError(
+        raise CalibrationError(
             "ce_fusion is not measurable with the unfused CE benchmark "
             "(a fused kernel avoids its fp32 materialization); keep the "
             "configured prior or calibrate against a real fused kernel"

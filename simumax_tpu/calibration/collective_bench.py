@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from simumax_tpu.calibration.timing import time_fn
+from simumax_tpu.core.errors import CalibrationError
 
 _OPS = ("all_reduce", "all_gather", "reduce_scatter", "all2all", "p2p")
 
@@ -45,7 +46,7 @@ def _collective_fn(op: str, axis: str):
             return jax.lax.ppermute(x, axis, perm)
 
         return permute
-    raise ValueError(op)
+    raise CalibrationError(f"no collective benchmark for op {op!r}", op=op)
 
 
 def measure_collective(

@@ -64,7 +64,7 @@ MiB = 1024**2
 
 def dtype_to_bytes(dtype: str) -> float:
     if dtype not in DTYPE_BYTES:
-        raise ValueError(f"unknown dtype {dtype!r}")
+        raise ConfigError(f"unknown dtype {dtype!r}")
     return DTYPE_BYTES[dtype]
 
 
@@ -1000,7 +1000,9 @@ class SystemConfig(ConfigBase):
 
         self.provenance = {
             "system_hash": self.fingerprint(),
-            "created": datetime.date.today().isoformat(),
+            # calibration-time stamp: provenance is MEANT to change
+            # when tables are re-measured (it invalidates cache keys)
+            "created": datetime.date.today().isoformat(),  # noqa: SIM003
             "version": __version__,
         }
         return self.provenance
@@ -1025,8 +1027,10 @@ class SystemConfig(ConfigBase):
             import datetime
 
             try:
+                # staleness warning only: the age never reaches a
+                # payload, a hash, or a sweep decision
                 age = (
-                    datetime.date.today()
+                    datetime.date.today()  # noqa: SIM003
                     - datetime.date.fromisoformat(str(created))
                 ).days
             except ValueError:

@@ -65,6 +65,7 @@ import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.core.records import CritSegment
 
 CRITPATH_SCHEMA = "simumax-critpath-v1"
@@ -946,7 +947,7 @@ def load_report(path: str) -> Dict[str, Any]:
         data = json.load(f)
     schema = data.get("schema")
     if schema != CRITPATH_SCHEMA:
-        raise ValueError(
+        raise ConfigError(
             f"{path}: not a simumax critical-path report "
             f"(schema={schema!r}; expected {CRITPATH_SCHEMA!r} — produce "
             f"one with `simumax_tpu critical-path ... --json PATH`)"

@@ -33,6 +33,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Tuple
 
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.core.records import (
     PHASES,
     CollectiveSpan,
@@ -453,7 +454,7 @@ class Ledger:
             data = json.load(f)
         schema = data.get("schema")
         if schema != LEDGER_SCHEMA:
-            raise ValueError(
+            raise ConfigError(
                 f"{path}: not a simumax ledger (schema={schema!r}; "
                 f"expected {LEDGER_SCHEMA!r} — produce one with "
                 f"`simumax_tpu explain ... --json PATH`)"

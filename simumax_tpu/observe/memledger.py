@@ -49,6 +49,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from simumax_tpu.core.config import GiB
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.core.records import Diagnostics, MemSpan
 
 MEM_LEDGER_SCHEMA = "simumax-memledger-v1"
@@ -860,7 +861,7 @@ class MemoryLedger:
             data = json.load(f)
         schema = data.get("schema")
         if schema != MEM_LEDGER_SCHEMA:
-            raise ValueError(
+            raise ConfigError(
                 f"{path}: not a simumax memory ledger (schema={schema!r}; "
                 f"expected {MEM_LEDGER_SCHEMA!r} — produce one with "
                 f"`simumax_tpu explain ... --memory --json PATH`)"

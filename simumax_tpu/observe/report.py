@@ -25,6 +25,8 @@ import time
 import uuid
 from typing import Any, Optional, TextIO
 
+from simumax_tpu.core.errors import ConfigError
+
 LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 
 
@@ -45,7 +47,7 @@ class Reporter:
                   stream: Optional[TextIO] = None) -> "Reporter":
         if level is not None:
             if level not in LEVELS:
-                raise ValueError(
+                raise ConfigError(
                     f"unknown log level {level!r}: expected one of "
                     f"{sorted(LEVELS)}"
                 )

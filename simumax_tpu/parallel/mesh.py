@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from simumax_tpu.core.config import StrategyConfig
+from simumax_tpu.core.errors import SimulationError
 
 #: innermost-first dim orders (rank = sum_i idx_i * stride_i)
 DENSE_ORDER = ("tp", "cp", "dp", "pp")
@@ -72,4 +73,6 @@ def group_of(rank: int, st: StrategyConfig, dim: str) -> List[int]:
     for g in rank_groups(st, dim):
         if rank in g:
             return g
-    raise ValueError(rank)
+    raise SimulationError(
+        f"rank {rank} is in no {dim!r} group", rank=rank, dim=dim
+    )

@@ -30,6 +30,7 @@ from simumax_tpu.core.config import (
     get_strategy_config,
     get_system_config,
 )
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.core.module import BuildContext
 from simumax_tpu.core.records import Diagnostics
 from simumax_tpu.core.utils import dp_comm_buckets, human_time
@@ -488,7 +489,7 @@ class PerfLLM(PerfBase):
             if f.name in self.BATCH_ONLY_FIELDS:
                 continue
             if getattr(strategy, f.name) != getattr(self.strategy, f.name):
-                raise ValueError(
+                raise ConfigError(
                     f"rebatch: field {f.name!r} differs from the built "
                     f"strategy — only {sorted(self.BATCH_ONLY_FIELDS)} may "
                     f"change without a rebuild; call configure() instead"

@@ -261,7 +261,7 @@ def _identity_mismatch(stamped: dict, identity: dict) -> List[str]:
     BOTH sides stamped: a newer release may key additional strategy
     fields, and a journal recorded before that must still resume (the
     run it describes has not changed)."""
-    diff = [
+    diff = [  # noqa: SIM003 — sorted() on return erases the set order
         k for k in set(stamped) | set(identity)
         if k != "base_strategy" and stamped.get(k) != identity.get(k)
     ]

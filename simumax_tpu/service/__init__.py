@@ -6,7 +6,7 @@ See ``docs/service.md`` for the cache-key contract, invalidation rules,
 server API and eviction policy.
 """
 
-from simumax_tpu.service.store import (  # noqa: F401
+from simumax_tpu.service.store import (
     ContentStore,
     canonical,
     canonical_bytes,

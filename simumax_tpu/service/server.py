@@ -45,6 +45,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.service.planner import Planner
 
 
@@ -144,7 +145,7 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         data = json.loads(raw.decode("utf-8") or "{}")
         if not isinstance(data, dict):
-            raise ValueError("request body must be a JSON object")
+            raise ConfigError("request body must be a JSON object")
         return data
 
     def _send_json(self, code: int, payload: Any,

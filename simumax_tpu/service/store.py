@@ -47,6 +47,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from simumax_tpu.core.errors import ConfigError
+
 #: known namespaces (directories under the root). Nothing enforces the
 #: set — it documents the layout and seeds `cache stats` rendering.
 NAMESPACES = ("estimate", "explain", "sweep", "profiles", "des")
@@ -164,7 +166,9 @@ class ContentStore:
         with open(path, "rb") as f:
             line = f.readline()
         if not line.endswith(b"\n"):
-            raise ValueError("missing header line")
+            # intra-module miss-path signal: get()/verify() catch
+            # ValueError and count the entry corrupt, never re-raise
+            raise ValueError("missing header line")  # noqa: SIM004
         return json.loads(line.decode("utf-8"))
 
     @staticmethod
@@ -175,12 +179,14 @@ class ContentStore:
             blob = f.read()
         nl = blob.find(b"\n")
         if nl < 0:
-            raise ValueError("missing header line")
+            # same intra-module miss-path signal as _read_header
+            raise ValueError("missing header line")  # noqa: SIM004
         header = json.loads(blob[:nl].decode("utf-8"))
         body = blob[nl + 1:]
         digest = hashlib.sha256(body).hexdigest()
         if digest != header.get("sha256"):
-            raise ValueError(
+            # corrupt-entry signal for get(): caught, counted, dropped
+            raise ValueError(  # noqa: SIM004
                 f"payload digest {digest[:12]} != header "
                 f"{str(header.get('sha256'))[:12]}"
             )
@@ -259,7 +265,7 @@ class ContentStore:
         elif fmt == "json":
             body = canonical_bytes(payload)
         else:
-            raise ValueError(f"unknown entry format {fmt!r}")
+            raise ConfigError(f"unknown entry format {fmt!r}", fmt=fmt)
         header = {
             "v": 1,
             "ns": namespace,
@@ -267,7 +273,9 @@ class ContentStore:
             "fmt": fmt,
             "sha256": hashlib.sha256(body).hexdigest(),
             "size": len(body),
-            "created": time.time(),
+            # wall-clock is header metadata only — never part of the
+            # key or the payload bytes a hit returns
+            "created": time.time(),  # noqa: SIM003
             "code_version": code_version(),
         }
         path = self._path(namespace, key)

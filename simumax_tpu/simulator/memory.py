@@ -17,6 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from simumax_tpu.core.errors import SimulationError
+
 
 @dataclass(slots=True)
 class MemSample:
@@ -104,12 +106,12 @@ class SimuMemoryTracker:
         if token is not None:
             fifo = self._tokens.get(token)
             if not fifo:
-                raise RuntimeError(
+                raise SimulationError(
                     f"rank {self.rank}: free of unknown token {token!r}"
                 )
             expect = fifo.pop(0)
             if nbytes and abs(expect - nbytes) > 1:
-                raise RuntimeError(
+                raise SimulationError(
                     f"rank {self.rank}: token {token!r} size mismatch: "
                     f"allocated {expect}, freeing {nbytes}"
                 )
@@ -126,7 +128,7 @@ class SimuMemoryTracker:
             self.events.append(("free", t, nbytes, key, addr))
         self.cur -= nbytes
         if self.cur < self.static_bytes - 1:
-            raise RuntimeError(
+            raise SimulationError(
                 f"rank {self.rank}: memory underflow at t={t}: "
                 f"{self.cur} < static {self.static_bytes}"
             )

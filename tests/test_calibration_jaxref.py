@@ -281,8 +281,9 @@ class TestBandwidthCalibration:
         from simumax_tpu.calibration.autocal import (
             measure_bandwidth_efficiency,
         )
+        from simumax_tpu.core.errors import CalibrationError
 
-        with pytest.raises(ValueError, match="ce_fusion"):
+        with pytest.raises(CalibrationError, match="ce_fusion"):
             measure_bandwidth_efficiency("ce_fusion", 819.0)
 
 

@@ -1,61 +1,34 @@
-"""Lint-style guard for the resilience layer's discipline: no bare
-``except:`` and no silently-swallowing ``except Exception: pass`` in
-``simumax_tpu/``. Every handler must either name the exception kinds it
-understands (the ``core/errors.py`` taxonomy) or actually do something
-with what it caught — record it, re-raise it, substitute a value."""
+"""Resilience discipline: no bare ``except:`` and no silently
+swallowing ``except Exception: pass`` in ``simumax_tpu/`` — every
+handler names the kinds it understands (the ``core/errors.py``
+taxonomy) or does something with what it caught.
+
+Thin wrapper over the ``SIM005`` checker of ``tools/staticcheck`` (the
+rule lives in ``tools/staticcheck/checkers/discipline.py``), so pytest
+and ``python -m tools.staticcheck`` can never disagree about what the
+discipline means.
+"""
 
 import ast
 import os
+import sys
 
-import simumax_tpu
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
-PKG_ROOT = os.path.dirname(os.path.abspath(simumax_tpu.__file__))
+from tools.staticcheck import run  # noqa: E402
+from tools.staticcheck.checkers import discipline  # noqa: E402
 
-
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    """True when the handler body swallows the exception without a
-    trace: only ``pass``, ``...``, or a bare docstring."""
-    for stmt in handler.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if (isinstance(stmt, ast.Expr)
-                and isinstance(stmt.value, ast.Constant)):
-            continue  # `...` or a string literal
-        return False
-    return True
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    """True for ``except:`` and ``except (Base)Exception``."""
-    t = handler.type
-    if t is None:
-        return True
-    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
-    return any(
-        isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
-        for n in names
-    )
-
-
-def _scan(path: str):
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            yield f"{path}:{node.lineno}: bare `except:`"
-        elif _is_broad(node) and _is_silent(node):
-            yield (f"{path}:{node.lineno}: "
-                   "`except Exception: pass` swallows failures silently")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_no_bare_or_silent_broad_except():
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                offenders.extend(_scan(os.path.join(dirpath, fn)))
+    report = run(paths=["simumax_tpu"], select=["SIM005"],
+                 root=REPO_ROOT)
+    offenders = [
+        f.render() for f in report.findings if f.rule == "except"
+    ]
     assert not offenders, (
         "broad exception handlers must record or re-raise, not swallow "
         "(see simumax_tpu/core/errors.py):\n" + "\n".join(offenders)
@@ -69,5 +42,7 @@ def test_the_linter_itself_catches_offenders(tmp_path):
         "try:\n    y = 2\nexcept Exception:\n    pass\n"
         "try:\n    z = 3\nexcept Exception as e:\n    print(e)\n"
     )
-    found = list(_scan(str(bad)))
+    tree = ast.parse(bad.read_text())
+    found = list(discipline.scan_except(tree, "bad.py"))
     assert len(found) == 2
+    assert all(f.id == "SIM005" for f in found)

@@ -1,44 +1,35 @@
-"""Lint-style guard for the observability layer's discipline (the
-``test_no_bare_except.py`` pattern): no bare ``print(...)`` calls in
-``simumax_tpu/`` library modules. User-facing report lines go through
-``observe/report.py`` (so ``--log-level`` / ``--log-json`` apply
-everywhere); the only modules allowed to call ``print`` are the
-reporter itself and the CLI boundary (which owns stderr error lines)."""
+"""Reporter discipline: no bare ``print(...)`` in ``simumax_tpu/``
+library modules — user-facing report lines go through
+``observe/report.py`` so ``--log-level`` / ``--log-json`` apply
+everywhere.
+
+Thin wrapper over the ``SIM005`` checker of ``tools/staticcheck`` (the
+rule lives in ``tools/staticcheck/checkers/discipline.py``), so pytest
+and ``python -m tools.staticcheck`` can never disagree about what the
+discipline means — including which modules are allowed to print and
+which lines carry a justified ``# noqa: SIM005``.
+"""
 
 import ast
 import os
+import sys
 
-import simumax_tpu
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
-PKG_ROOT = os.path.dirname(os.path.abspath(simumax_tpu.__file__))
+from tools.staticcheck import run  # noqa: E402
+from tools.staticcheck.checkers import discipline  # noqa: E402
 
-#: modules allowed to print, relative to the package root
-ALLOWED = {"cli.py", os.path.join("observe", "report.py")}
-
-
-def _scan(path: str):
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            yield f"{path}:{node.lineno}: bare print() call"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_no_bare_print_in_library_modules():
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, PKG_ROOT)
-            if rel in ALLOWED:
-                continue
-            offenders.extend(_scan(path))
+    report = run(paths=["simumax_tpu"], select=["SIM005"],
+                 root=REPO_ROOT)
+    offenders = [
+        f.render() for f in report.findings if f.rule == "print"
+    ]
     assert not offenders, (
         "library modules must report through observe/report.py "
         "(get_reporter().info/...), not print:\n" + "\n".join(offenders)
@@ -52,5 +43,7 @@ def test_the_linter_itself_catches_offenders(tmp_path):
         "fingerprint('not a print call')\n"
         "def f():\n    print('y')\n"
     )
-    found = list(_scan(str(bad)))
+    tree = ast.parse(bad.read_text())
+    found = list(discipline.scan_print(tree, "bad.py"))
     assert len(found) == 2
+    assert all(f.id == "SIM005" for f in found)

@@ -1,0 +1,901 @@
+"""Tests for the domain-aware static-analysis framework
+(``tools/staticcheck``, ``docs/static_analysis.md``).
+
+Four layers:
+
+* **framework**: registry catalogue, ``--select``/``--ignore``, JSON
+  schema, exit codes, parse-error reporting;
+* **noqa round-trip**: suppression honored, unused suppressions
+  reported, foreign codes left alone (shared parser with
+  ``tools/lint.py``);
+* **per-checker fixtures**: a minimal positive + negative snippet per
+  checker id;
+* **seeded-mutation drift tests**: copies of the *real* tree files
+  with the exact drift each checker exists to catch introduced by a
+  one-line patch — a new un-keyed config attribute (SIM001), an
+  un-mirrored strategy field (SIM002), an unsorted merge iteration
+  (SIM003), a bare ``ValueError`` (SIM004), a bare ``print`` (SIM005),
+  an un-costed collective (SIM006) — asserting that **exactly** the
+  targeted checker fires.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+from tools.staticcheck import UsageError, run  # noqa: E402
+from tools.staticcheck import noqa as noqa_mod  # noqa: E402
+from tools.staticcheck.checkers import REGISTRY  # noqa: E402
+from tools import lint as lint_mod  # noqa: E402
+
+ALL_IDS = {"SIM001", "SIM002", "SIM003", "SIM004", "SIM005", "SIM006"}
+
+
+# --------------------------------------------------------------------------
+# helpers
+# --------------------------------------------------------------------------
+
+
+def write_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(textwrap.dedent(content))
+    return str(root)
+
+
+def run_ids(root, paths=("simumax_tpu",), select=None):
+    report = run(paths=list(paths), select=select, root=str(root))
+    return report, sorted({f.id for f in report.findings})
+
+
+#: the real files the cross-file checkers encode invariants about —
+#: copied wholesale into mutation fixtures (with their noqa comments,
+#: which must keep suppressing on the copy)
+REAL_FILES = (
+    "simumax_tpu/core/config.py",
+    "simumax_tpu/core/module.py",
+    "simumax_tpu/perf.py",
+    "simumax_tpu/models/dense.py",
+    "simumax_tpu/models/llm.py",
+    "simumax_tpu/models/mla.py",
+    "simumax_tpu/models/moe.py",
+    "simumax_tpu/search/batched.py",
+    "simumax_tpu/search/searcher.py",
+    "simumax_tpu/service/planner.py",
+    "simumax_tpu/service/store.py",
+)
+
+
+@pytest.fixture
+def real_tree(tmp_path):
+    for rel in REAL_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(REPO_ROOT, rel), dst)
+    return tmp_path
+
+
+def patch_file(root, rel, old, new, count=1):
+    path = os.path.join(str(root), rel)
+    src = open(path, encoding="utf-8").read()
+    assert src.count(old) == count, (
+        f"mutation anchor drifted in {rel}: {old!r} found "
+        f"{src.count(old)} times (expected {count})"
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(src.replace(old, new))
+
+
+# --------------------------------------------------------------------------
+# framework
+# --------------------------------------------------------------------------
+
+
+class TestFramework:
+    def test_registry_catalogue(self):
+        assert set(REGISTRY) == ALL_IDS
+        for cid, checker in REGISTRY.items():
+            assert checker.id == cid
+            assert checker.name and checker.doc
+
+    def test_select_and_ignore(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "def f():\n"
+                "    print('x')\n"
+                "    raise ValueError('boom')\n",
+        })
+        _, ids = run_ids(tmp_path)
+        assert ids == ["SIM004", "SIM005"]
+        _, ids = run_ids(tmp_path, select=["SIM004"])
+        assert ids == ["SIM004"]
+        report = run(paths=["simumax_tpu"], ignore=["SIM004", "SIM005"],
+                     root=str(tmp_path))
+        assert not report.findings
+
+    def test_unknown_checker_id_is_usage_error(self, tmp_path):
+        write_tree(tmp_path, {"simumax_tpu/x.py": "x = 1\n"})
+        with pytest.raises(UsageError, match="SIM999"):
+            run(paths=["simumax_tpu"], select=["SIM999"],
+                root=str(tmp_path))
+
+    def test_missing_path_is_usage_error(self, tmp_path):
+        with pytest.raises(UsageError, match="no_such_dir"):
+            run(paths=["no_such_dir"], root=str(tmp_path))
+
+    def test_parse_error_reported(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/bad.py": "def f(:\n    pass\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == ["SIM000"]
+        assert report.exit_code == 1
+
+    def test_findings_deterministic_order(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/b.py": "raise ValueError('x')\n",
+            "simumax_tpu/a.py": "print('x')\nraise ValueError('y')\n",
+        })
+        report, _ = run_ids(tmp_path)
+        keys = [(f.path, f.line, f.id) for f in report.findings]
+        assert keys == sorted(keys)
+
+
+class TestCLI:
+    def _cli(self, args, cwd):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        return subprocess.run(
+            [sys.executable, "-m", "tools.staticcheck", *args],
+            cwd=cwd, env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+
+    def test_repo_tree_is_clean(self):
+        # the acceptance contract: default paths, exit 0 on this tree
+        proc = self._cli([], cwd=REPO_ROOT)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_json_schema_and_exit_code(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py": "def f():\n    print('x')\n",
+        })
+        out = tmp_path / "report.json"
+        proc = self._cli(
+            ["simumax_tpu", "--json", "--json-file", str(out)],
+            cwd=str(tmp_path),
+        )
+        assert proc.returncode == 1
+        payload = json.loads(proc.stdout)
+        assert payload == json.loads(out.read_text())
+        assert payload["schema"] == "simumax-staticcheck-v1"
+        assert payload["exit_code"] == 1
+        assert payload["counts"]["findings"] == 1
+        (finding,) = payload["findings"]
+        assert finding["id"] == "SIM005"
+        assert finding["path"] == "simumax_tpu/x.py"
+        assert finding["line"] == 2
+        assert finding["rule"] == "print"
+        assert "print" in finding["message"]
+        assert payload["selected"] == sorted(ALL_IDS)
+
+    def test_bad_path_exits_2(self, tmp_path):
+        proc = self._cli(["definitely_missing"], cwd=str(tmp_path))
+        assert proc.returncode == 2
+        assert "no such path" in proc.stderr
+
+    def test_unknown_id_exits_2(self, tmp_path):
+        (tmp_path / "x.py").write_text("x = 1\n")
+        proc = self._cli(["x.py", "--select", "NOPE1"],
+                         cwd=str(tmp_path))
+        assert proc.returncode == 2
+
+    def test_list_catalogue(self, tmp_path):
+        proc = self._cli(["--list"], cwd=str(tmp_path))
+        assert proc.returncode == 0
+        for cid in ALL_IDS:
+            assert cid in proc.stdout
+
+    def test_absolute_path_outside_cwd_keeps_scopes(self, tmp_path):
+        # running from an unrelated cwd with an absolute path argument
+        # must not disable the layout-scoped checkers or orphan the
+        # tree's noqa suppressions into NQA001 noise
+        tree = tmp_path / "proj"
+        write_tree(tree, {
+            "simumax_tpu/x.py":
+                "def f():\n"
+                "    print('x')\n"
+                "    raise ValueError('ok')  # noqa: SIM004\n",
+        })
+        elsewhere = tmp_path / "elsewhere"
+        elsewhere.mkdir()
+        proc = self._cli(
+            [str(tree / "simumax_tpu"), "--json"], cwd=str(elsewhere)
+        )
+        payload = json.loads(proc.stdout)
+        assert proc.returncode == 1
+        (finding,) = payload["findings"]
+        assert finding["id"] == "SIM005"
+        assert finding["path"] == "simumax_tpu/x.py"
+        assert payload["counts"]["suppressed"] == 1
+        assert not payload["unused_suppressions"]
+
+
+# --------------------------------------------------------------------------
+# suppression ("noqa") round-trip
+# --------------------------------------------------------------------------
+
+
+class TestNoqa:
+    def test_parse_comment(self):
+        assert noqa_mod.parse_comment("# noqa") == ()
+        assert noqa_mod.parse_comment("# NOQA") == ()
+        assert noqa_mod.parse_comment("# noqa: SIM004") == ("SIM004",)
+        assert noqa_mod.parse_comment("# noqa: a1, b2,c3") == (
+            "A1", "B2", "C3")
+        assert noqa_mod.parse_comment("# plain comment") is None
+
+    def test_parse_comment_justification_prose_is_not_codes(self):
+        # prose after the codes must not become extra suppressions —
+        # codes are comma-separated, so even a code-shaped token in
+        # the justification cannot widen the directive
+        assert noqa_mod.parse_comment(
+            "# noqa: SIM003 unlike SIM004 this is metadata"
+        ) == ("SIM003",)
+        assert noqa_mod.parse_comment(
+            "# noqa: SIM003 SIM004 is unrelated here"
+        ) == ("SIM003",)
+        assert noqa_mod.parse_comment(
+            "# noqa: SIM003 — sorted() on return erases the set order"
+        ) == ("SIM003",)
+        # a colon with no parseable code is NOT a bare blanket noqa
+        assert noqa_mod.parse_comment("# noqa: see below") is None
+
+    def test_word_prefix_prose_is_not_a_directive(self):
+        # "noqa" as a word prefix must not become a blanket suppressor
+        assert noqa_mod.parse_comment("# noqa's are banned here") is None
+        assert noqa_mod.parse_comment("# noqable") is None
+        assert noqa_mod.parse_comment("# noqa-style comments") is None
+        # ...but the real spellings still work
+        assert noqa_mod.parse_comment("# noqa") == ()
+        assert noqa_mod.parse_comment("# noqa:SIM004") == ("SIM004",)
+
+    def test_string_literal_is_not_a_directive(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                's = "# noqa: SIM004"\nraise ValueError(s)\n',
+        })
+        _, ids = run_ids(tmp_path)
+        assert ids == ["SIM004"]  # the string did not suppress line 2
+
+    def test_coded_suppression_roundtrip(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "def f():\n"
+                "    raise ValueError('x')  # noqa: SIM004\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == []
+        assert [f.id for f in report.suppressed] == ["SIM004"]
+        assert report.exit_code == 0
+
+    def test_bare_suppression(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "def f():\n"
+                "    raise ValueError('x')  # noqa\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == []
+        assert report.exit_code == 0
+
+    def test_unused_suppression_reported(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py": "x = 1  # noqa: SIM004\n",
+        })
+        report, _ = run_ids(tmp_path)
+        assert [f.id for f in report.unused] == ["NQA001"]
+        assert report.exit_code == 1
+        assert "unused suppression" in report.unused[0].message
+
+    def test_foreign_codes_left_alone(self, tmp_path):
+        # E402/F401 belong to flake8 / tools/lint.py: not honored for
+        # SIM findings, and never reported unused by staticcheck
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "import os  # noqa: F401,E402\n"
+                "def f():\n"
+                "    raise ValueError(os.name)  # noqa: E402\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == ["SIM004"]
+        assert not report.unused
+
+    def test_narrowed_select_does_not_flag_other_codes(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py": "x = 1  # noqa: SIM005\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM004"],
+                     root=str(tmp_path))
+        assert not report.unused  # SIM005 did not run: cannot be stale
+
+    def test_stale_bare_noqa_is_never_reported(self, tmp_path):
+        # a bare directive may be silencing the OTHER linter
+        # (tools/lint.py) on that line — neither tool can judge it
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py": "x = 1  # noqa\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == [] and not report.unused
+        assert report.exit_code == 0
+
+    def test_bare_noqa_for_the_other_tool_does_not_deadlock(self,
+                                                            tmp_path):
+        # a bare noqa suppressing a staticcheck finding must not fail
+        # lint.py's unused-suppression pass (and vice versa)
+        path = tmp_path / "x.py"
+        path.write_text("def f():\n    raise ValueError('x')  # noqa\n")
+        assert not lint_mod.lint_file(str(path))
+
+    def test_wrong_code_does_not_suppress(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "def f():\n"
+                "    raise ValueError('x')  # noqa: SIM005\n",
+        })
+        report, ids = run_ids(tmp_path)
+        assert ids == ["SIM004"]
+        # ...and the SIM005 suppression is reported as unused
+        assert [f.id for f in report.unused] == ["NQA001"]
+
+
+# --------------------------------------------------------------------------
+# per-checker fixtures
+# --------------------------------------------------------------------------
+
+
+SIM001_CONFIG = """\
+import dataclasses
+from dataclasses import dataclass
+
+@dataclass
+class StrategyConfig:
+    tp_size: int = 1
+
+    def __post_init__(self):
+        self.{attr} = self.tp_size * 2
+"""
+
+
+class TestSIM001Fixture:
+    def _findings(self, tmp_path, attr):
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                SIM001_CONFIG.format(attr=attr),
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM001"],
+                     root=str(tmp_path))
+        return [f for f in report.findings
+                if "is not a dataclass field" in f.message]
+
+    def test_unkeyed_instance_attribute_fires(self, tmp_path):
+        found = self._findings(tmp_path, "hidden_knob")
+        assert len(found) == 1
+        assert "StrategyConfig.hidden_knob" in found[0].message
+
+    def test_exempted_attribute_is_clean(self, tmp_path):
+        assert not self._findings(tmp_path, "extra_fields")
+
+    def test_tuple_unpacking_targets_fire(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class StrategyConfig:\n"
+                "    tp_size: int = 1\n"
+                "    def __post_init__(self):\n"
+                "        self.head_dim, (self.kv_dim, *self.rest) = "
+                "derive(self.tp_size)\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM001"],
+                     root=str(tmp_path))
+        names = {
+            f.message.split(" is ")[0] for f in report.findings
+            if "is not a dataclass field" in f.message
+        }
+        assert names == {
+            "StrategyConfig.head_dim", "StrategyConfig.kv_dim",
+            "StrategyConfig.rest",
+        }
+
+    def test_planner_must_route_via_to_dict(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class StrategyConfig:\n    tp_size: int = 1\n",
+            "simumax_tpu/service/planner.py":
+                "def query_identity(kind, model=None, strategy=None,\n"
+                "                   system=None, **extra):\n"
+                "    return {'kind': kind, 'model': model.to_dict(),\n"
+                "            'strategy': str(strategy),\n"
+                "            'system': system.to_dict()}\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM001"],
+                     root=str(tmp_path))
+        msgs = [f.message for f in report.findings]
+        assert any("strategy" in m and "to_dict" in m for m in msgs)
+        assert not any("'model'" in m for m in msgs)
+
+
+SIM002_CONFIG = """\
+from dataclasses import dataclass
+
+@dataclass
+class StrategyConfig:
+    tp_size: int = 1
+    new_knob: int = 0
+"""
+
+
+class TestSIM002Fixture:
+    def _run(self, tmp_path, kind_fields):
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py": SIM002_CONFIG,
+            "simumax_tpu/perf.py":
+                "def cost(st):\n"
+                "    return st.tp_size * st.new_knob\n",
+            "simumax_tpu/search/batched.py":
+                f"_KIND_FIELDS = {kind_fields!r}\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM002"],
+                     root=str(tmp_path))
+        return [f for f in report.findings
+                if "reaches neither" in f.message]
+
+    def test_unmirrored_field_fires(self, tmp_path):
+        found = self._run(tmp_path, ("tp_size",))
+        assert len(found) == 1
+        assert "'new_knob'" in found[0].message
+        assert found[0].path == "simumax_tpu/perf.py"
+
+    def test_mirrored_field_is_clean(self, tmp_path):
+        assert not self._run(tmp_path, ("tp_size", "new_knob"))
+
+
+class TestSIM003Fixture:
+    def _ids(self, tmp_path, body, rel="simumax_tpu/search/merge.py"):
+        write_tree(tmp_path, {rel: body})
+        report = run(paths=["simumax_tpu"], select=["SIM003"],
+                     root=str(tmp_path))
+        return report.findings
+
+    def test_set_iteration_fires(self, tmp_path):
+        found = self._ids(
+            tmp_path,
+            "def merge(cells):\n"
+            "    out = []\n"
+            "    for c in set(cells):\n"
+            "        out.append(c)\n"
+            "    return out\n",
+        )
+        assert len(found) == 1
+        assert "hash-order-dependent" in found[0].message
+
+    def test_sorted_set_iteration_is_clean(self, tmp_path):
+        assert not self._ids(
+            tmp_path,
+            "def merge(cells):\n"
+            "    return [c for c in sorted(set(cells))]\n",
+        )
+
+    def test_order_free_reducer_is_clean(self, tmp_path):
+        assert not self._ids(
+            tmp_path,
+            "def any_diff(a, b):\n"
+            "    return any(a[k] != b[k] for k in set(a) & set(b))\n",
+        )
+
+    def test_wall_clock_and_global_rng_fire(self, tmp_path):
+        found = self._ids(
+            tmp_path,
+            "import random\n"
+            "import time\n"
+            "def jitter():\n"
+            "    return time.time() + random.random()\n",
+        )
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 2
+        assert "time.time()" in msgs and "random.random()" in msgs
+
+    def test_seeded_rng_is_clean(self, tmp_path):
+        assert not self._ids(
+            tmp_path,
+            "import random\n"
+            "def draw(seed):\n"
+            "    return random.Random(seed).random()\n",
+        )
+
+    def test_out_of_scope_module_is_clean(self, tmp_path):
+        # wall-clock in e.g. the HTTP server's stats is fine: only the
+        # bit-identity paths are scoped
+        assert not self._ids(
+            tmp_path,
+            "import time\n"
+            "def uptime(start):\n"
+            "    return time.time() - start\n",
+            rel="simumax_tpu/service/server.py",
+        )
+
+    def test_unsorted_listdir_fires(self, tmp_path):
+        found = self._ids(
+            tmp_path,
+            "import os\n"
+            "def entries(root):\n"
+            "    return [p for p in os.listdir(root)]\n",
+        )
+        assert len(found) == 1 and "listdir" in found[0].message
+
+
+class TestSIM004Fixture:
+    def test_banned_raises_fire_and_taxonomy_is_clean(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "from simumax_tpu.core.errors import ConfigError\n"
+                "def f(mode):\n"
+                "    if mode == 1:\n"
+                "        raise ValueError('bad')\n"
+                "    if mode == 2:\n"
+                "        raise RuntimeError('bad')\n"
+                "    if mode == 3:\n"
+                "        raise Exception('bad')\n"
+                "    raise ConfigError('fine')\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM004"],
+                     root=str(tmp_path))
+        assert [f.line for f in report.findings] == [4, 6, 8]
+
+    def test_jaxref_is_out_of_scope(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/jaxref/k.py":
+                "def f():\n    raise ValueError('jax idiom')\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM004"],
+                     root=str(tmp_path))
+        assert not report.findings
+
+
+class TestSIM005Fixture:
+    def test_print_fires_outside_allowed_modules(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py": "print('hi')\n",
+            "simumax_tpu/cli.py": "print('allowed: CLI boundary')\n",
+            "simumax_tpu/observe/report.py":
+                "print('allowed: the reporter itself')\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM005"],
+                     root=str(tmp_path))
+        assert [f.path for f in report.findings] == ["simumax_tpu/x.py"]
+
+    def test_silent_broad_except_fires(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/x.py":
+                "try:\n    x = 1\nexcept:\n    pass\n"
+                "try:\n    y = 2\nexcept Exception:\n    '...'\n"
+                "try:\n    z = 3\nexcept OSError:\n    pass\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM005"],
+                     root=str(tmp_path))
+        assert [f.line for f in report.findings] == [3, 7]
+
+
+SIM006_CONFIG = """\
+NET_OPS = ("all_reduce", "p2p"{extra_op})
+
+class SystemConfig:
+    def compute_net_op_terms(self, op, size_bytes, path, comm_num=None):
+        if op == "all_reduce":
+            return size_bytes, 0.0
+        if op == "p2p":
+            return size_bytes, 1.0
+        return 0.0, 0.0
+"""
+
+SIM006_PERF = """\
+def place_strategy_paths(strategy, system):
+    paths = {}
+    paths["tp"] = system.place_group("tp", 1, strategy.tp_size)
+    paths["pp"] = system.place_group("pp", 1, strategy.pp_size)
+    return paths
+"""
+
+
+class TestSIM006Fixture:
+    def _run(self, tmp_path, model_body, extra_op=""):
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                SIM006_CONFIG.format(extra_op=extra_op),
+            "simumax_tpu/perf.py": SIM006_PERF,
+            "simumax_tpu/models/dense.py": model_body,
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM006"],
+                     root=str(tmp_path))
+        return report.findings
+
+    def test_covered_emission_is_clean(self, tmp_path):
+        assert not self._run(
+            tmp_path,
+            "def collectives():\n"
+            "    return [CollectiveCall('fwd', 'all_reduce', 'tp', 8)]\n",
+        )
+
+    def test_unknown_op_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def collectives():\n"
+            "    return [CollectiveCall('fwd', 'broadcast', 'tp', 8)]\n",
+        )
+        assert len(found) == 1
+        assert "not in NET_OPS" in found[0].message
+
+    def test_vocabulary_op_without_cost_branch_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def collectives():\n"
+            "    return [CollectiveCall('fwd', 'broadcast', 'tp', 8)]\n",
+            extra_op=", 'broadcast'",
+        )
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 2  # the emission site + the NET_OPS entry
+        assert "no cost branch" in msgs
+
+    def test_negative_guard_is_not_a_cost_branch(self, tmp_path):
+        # `op != "broadcast"` / a non-cost tweak must not count as
+        # coverage: only positive == / in comparisons prove a branch
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                'NET_OPS = ("all_reduce", "broadcast")\n\n'
+                "class SystemConfig:\n"
+                "    def compute_net_op_terms(self, op, size_bytes,"
+                " path, comm_num=None):\n"
+                '        if op != "broadcast":\n'
+                "            size_bytes *= 2\n"
+                '        if op == "all_reduce":\n'
+                "            return size_bytes, 0.0\n"
+                "        return 0.0, 0.0\n",
+            "simumax_tpu/perf.py": SIM006_PERF,
+            "simumax_tpu/models/dense.py":
+                "def collectives():\n"
+                "    return [CollectiveCall('fwd', 'broadcast', 'tp',"
+                " 8)]\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM006"],
+                     root=str(tmp_path))
+        msgs = "\n".join(f.message for f in report.findings)
+        assert len(report.findings) == 2
+        assert "no cost branch" in msgs
+
+    def test_unplaced_dim_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def collectives():\n"
+            "    return [CollectiveCall('fwd', 'p2p', 'sp', 8)]\n",
+        )
+        assert len(found) == 1
+        assert "'sp'" in found[0].message and "placed" in found[0].message
+
+    def test_unrelated_local_dict_keys_are_not_placed_dims(self,
+                                                           tmp_path):
+        # a stray lookup table inside place_strategy_paths must not
+        # make its keys count as placed CommPath dims
+        write_tree(tmp_path, {
+            "simumax_tpu/core/config.py":
+                SIM006_CONFIG.format(extra_op=""),
+            "simumax_tpu/perf.py":
+                "def place_strategy_paths(strategy, system):\n"
+                "    phase_map = {'fwd': 0, 'bwd': 1}\n"
+                "    paths = {}\n"
+                "    paths['tp'] = system.place_group("
+                "'tp', 1, strategy.tp_size)\n"
+                "    return paths\n",
+            "simumax_tpu/models/dense.py":
+                "def collectives(ctx):\n"
+                "    return [ctx.path('fwd')]\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM006"],
+                     root=str(tmp_path))
+        assert len(report.findings) == 1
+        assert "'fwd'" in report.findings[0].message
+
+
+# --------------------------------------------------------------------------
+# seeded-mutation drift tests on copies of the real tree
+# --------------------------------------------------------------------------
+
+
+class TestSeededMutations:
+    def _run(self, root):
+        report = run(paths=["simumax_tpu"], root=str(root))
+        return report, sorted({f.id for f in report.findings})
+
+    def test_real_tree_copy_baseline_is_clean(self, real_tree):
+        report, ids = self._run(real_tree)
+        assert ids == [], [f.render() for f in report.findings]
+        assert not report.unused, [f.render() for f in report.unused]
+        # the copied noqa justifications still suppress real findings
+        assert report.suppressed
+
+    def test_sim001_new_unkeyed_config_attribute(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            "        self.recompute = RecomputeConfig.from_strategy_dict(",
+            "        self.cache_blind_knob = 7\n"
+            "        self.recompute = RecomputeConfig.from_strategy_dict(",
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM001"], [f.render() for f in report.findings]
+        assert any("cache_blind_knob" in f.message
+                   for f in report.findings)
+
+    def test_sim001_negative_proper_field_is_clean(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            '    mesh_order: str = "tp,cp,dp,pp"',
+            '    mesh_order: str = "tp,cp,dp,pp"\n'
+            '    cache_keyed_knob: int = 0',
+        )
+        _, ids = self._run(real_tree)
+        assert ids == []
+
+    def test_sim001_planner_dropping_to_dict(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/service/planner.py",
+            '        ident["strategy"] = strategy.to_dict()',
+            '        ident["strategy"] = repr(strategy)',
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM001"]
+        assert any("strategy" in f.message for f in report.findings)
+
+    def test_sim002_unmirrored_strategy_field(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            '    mesh_order: str = "tp,cp,dp,pp"',
+            '    mesh_order: str = "tp,cp,dp,pp"\n'
+            '    drift_knob: int = 0',
+        )
+        patch_file(
+            real_tree, "simumax_tpu/perf.py",
+            "    st, sysc = strategy, system\n",
+            "    st, sysc = strategy, system\n"
+            "    _drift = strategy.drift_knob\n",
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM002"], [f.render() for f in report.findings]
+        assert any("'drift_knob'" in f.message for f in report.findings)
+
+    def test_sim002_negative_mirrored_in_kind_fields(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            '    mesh_order: str = "tp,cp,dp,pp"',
+            '    mesh_order: str = "tp,cp,dp,pp"\n'
+            '    drift_knob: int = 0',
+        )
+        patch_file(
+            real_tree, "simumax_tpu/perf.py",
+            "    st, sysc = strategy, system\n",
+            "    st, sysc = strategy, system\n"
+            "    _drift = strategy.drift_knob\n",
+        )
+        patch_file(
+            real_tree, "simumax_tpu/search/batched.py",
+            '        "attention_sparse_ratio", "mesh_order",',
+            '        "attention_sparse_ratio", "mesh_order", '
+            '"drift_knob",',
+        )
+        _, ids = self._run(real_tree)
+        assert ids == []
+
+    def test_sim003_unsorted_merge_iteration(self, real_tree):
+        path = os.path.join(str(real_tree),
+                            "simumax_tpu/search/searcher.py")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(
+                "\n\ndef _mutated_merge(outcomes):\n"
+                "    merged = []\n"
+                "    for key in set(outcomes):\n"
+                "        merged.append(key)\n"
+                "    return merged\n"
+            )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM003"], [f.render() for f in report.findings]
+        assert report.findings[0].path == "simumax_tpu/search/searcher.py"
+
+    def test_sim004_bare_valueerror(self, real_tree):
+        path = os.path.join(str(real_tree), "simumax_tpu/perf.py")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write("\n\ndef _mutated():\n"
+                    "    raise ValueError('drifted')\n")
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM004"], [f.render() for f in report.findings]
+
+    def test_sim005_bare_print(self, real_tree):
+        path = os.path.join(str(real_tree), "simumax_tpu/perf.py")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write("\n\ndef _mutated(x):\n    print(x)\n")
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM005"], [f.render() for f in report.findings]
+
+    def test_sim006_uncosted_collective(self, real_tree):
+        path = os.path.join(str(real_tree), "simumax_tpu/models/dense.py")
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(
+                "\n\ndef _mutated_collectives():\n"
+                "    return [CollectiveCall('fwd', 'broadcast', 'tp',"
+                " 1.0)]\n"
+            )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM006"], [f.render() for f in report.findings]
+        assert any("'broadcast'" in f.message for f in report.findings)
+
+
+# --------------------------------------------------------------------------
+# tools/lint.py noqa satellite
+# --------------------------------------------------------------------------
+
+
+class TestLintNoqa:
+    def _lint(self, tmp_path, content, name="mod.py"):
+        path = tmp_path / name
+        path.write_text(content)
+        return lint_mod.lint_file(str(path))
+
+    def test_unused_import_reported_with_code(self, tmp_path):
+        out = self._lint(tmp_path, "import os\n")
+        assert len(out) == 1 and "L001 unused import os" in out[0]
+
+    def test_flake8_alias_suppresses(self, tmp_path):
+        assert not self._lint(tmp_path, "import os  # noqa: F401\n")
+
+    def test_own_code_suppresses(self, tmp_path):
+        assert not self._lint(tmp_path, "import os  # noqa: L001\n")
+
+    def test_bare_noqa_suppresses(self, tmp_path):
+        assert not self._lint(tmp_path, "import os  # noqa\n")
+
+    def test_stale_suppression_reported(self, tmp_path):
+        out = self._lint(tmp_path, "x = 1  # noqa: F401\n")
+        assert len(out) == 1 and "L005 unused suppression" in out[0]
+
+    def test_foreign_codes_silent(self, tmp_path):
+        # E402/N802/SIMxxx belong to other tools: neither honored nor
+        # reported unused
+        assert not self._lint(
+            tmp_path,
+            "import sys\n"
+            "print(sys.path)  # noqa: E402\n"
+            "y = 2  # noqa: SIM003\n",
+        )
+
+    def test_tab_and_long_line_codes(self, tmp_path):
+        out = self._lint(
+            tmp_path, "x = 1\t\ny = '" + "a" * 120 + "'\n"
+        )
+        assert any("L002 tab" in o for o in out)
+        assert any("L003 line too long" in o for o in out)
+
+    def test_repo_tree_is_lint_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "tools/lint.py"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout

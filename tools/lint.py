@@ -1,7 +1,25 @@
 """Minimal dependency-free linter (reference ``tools/lint`` analog).
 
-Checks: syntax (compile), unused imports (AST), overlong lines, and
-tabs. Exit code 1 on findings. Usage::
+Checks (each with a stable code, so line suppressions can be precise):
+
+* ``L001`` unused import (flake8 alias: ``F401``)
+* ``L002`` tab character (alias: ``W191``)
+* ``L003`` line too long (alias: ``E501``)
+* ``L004`` syntax error
+* ``L005`` unused ``# noqa`` suppression
+
+Line-level ``# noqa`` suppressions are honored through the shared
+parser in ``tools/staticcheck/noqa.py`` (one implementation for both
+linters): a bare ``# noqa`` suppresses everything on its line, a coded
+``# noqa: F401`` suppresses the matching check. Codes belonging to
+other tools (``E402``, ``N802``, ``SIMxxx``...) are left alone —
+neither honored nor reported. Coded suppressions that match no
+finding are themselves reported (``L005``) so stale excuses cannot
+accumulate (bare ones are honored but not staleness-checked: they may
+be silencing the other linter) — the bug this replaces was the
+opposite: every ``noqa`` in the tree was silently ignored.
+
+Exit code 1 on findings. Usage::
 
     python tools/lint.py [paths...]
     # default paths: simumax_tpu tests tools examples
@@ -11,16 +29,35 @@ import ast
 import os
 import sys
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tools.staticcheck import noqa as noqa_mod  # noqa: E402
+from tools.staticcheck.core import _iter_py_files  # noqa: E402
+
 MAX_LINE = 100
+
+#: flake8 spellings accepted as aliases for our codes, so the
+#: ecosystem-idiomatic "noqa: F401" comment works here too
+ALIASES = {
+    "L001": ("F401",),
+    "L002": ("W191",),
+    "L003": ("E501",),
+}
+OWNED_CODES = {"L001", "L002", "L003", "L004", "L005"} | {
+    a for codes in ALIASES.values() for a in codes
+}
 
 
 def check_file(path):
+    """Return ``(line, code, message)`` findings for one file."""
     issues = []
     src = open(path).read()
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+        return [(e.lineno or 1, "L004", f"syntax error: {e.msg}")], src
     imported = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -32,23 +69,47 @@ def check_file(path):
                     imported[a.asname or a.name] = node.lineno
     names = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
     attrs = {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
-    is_init = os.path.basename(path) == "__init__.py"
     for name, lineno in imported.items():
-        if name == "annotations" or is_init:
-            continue  # __init__ re-exports are the public API
+        if name == "annotations":
+            continue
         if (
             name not in names
             and name not in attrs
             and f"{name}." not in src
             and f'"{name}"' not in src
         ):
-            issues.append(f"{path}:{lineno}: unused import {name}")
+            # NB: __init__.py re-exports are covered by the quoted-name
+            # fallback (an ``__all__`` entry) or a "noqa: F401" comment
+            # on the import line — no blanket skip any more
+            issues.append((lineno, "L001", f"unused import {name}"))
     for i, line in enumerate(src.splitlines(), 1):
         if "\t" in line:
-            issues.append(f"{path}:{i}: tab character")
+            issues.append((i, "L002", "tab character"))
         if len(line) > MAX_LINE and "http" not in line:
-            issues.append(f"{path}:{i}: line too long ({len(line)})")
-    return issues
+            issues.append((i, "L003", f"line too long ({len(line)})"))
+    return issues, src
+
+
+def lint_file(path):
+    """Check one file, apply its noqa directives, and report unused
+    ones. Returns printable finding strings."""
+    issues, src = check_file(path)
+    directives = noqa_mod.collect(src)
+    out = []
+    for lineno, code, msg in issues:
+        d = directives.get(lineno)
+        if noqa_mod.suppresses(d, code, ALIASES.get(code, ())):
+            continue
+        out.append(f"{path}:{lineno}: {code} {msg}")
+    # coded directives only: a bare noqa may be silencing the other
+    # linter (tools/staticcheck) and cannot be judged stale here
+    for d in noqa_mod.unused(directives, OWNED_CODES):
+        spec = "# noqa: " + ",".join(d.codes)
+        out.append(
+            f"{path}:{d.line}: L005 unused suppression `{spec}` "
+            f"(no matching finding on this line)"
+        )
+    return out
 
 
 def main(paths):
@@ -58,14 +119,9 @@ def main(paths):
         if not os.path.exists(p):
             print(f"error: no such path {p!r}")
             return 2
-        if os.path.isfile(p):
-            issues += check_file(p)
-            continue
-        for root, dirs, files in os.walk(p):
-            dirs[:] = [d for d in dirs if d != "__pycache__"]
-            for fn in files:
-                if fn.endswith(".py"):
-                    issues += check_file(os.path.join(root, fn))
+        # one directory walk implementation for both linters
+        for path in _iter_py_files(p):
+            issues += lint_file(path)
     for i in issues:
         print(i)
     print(f"{len(issues)} issue(s)")

@@ -1,0 +1,22 @@
+"""Domain-aware static analysis for simumax-tpu (see
+``docs/static_analysis.md``).
+
+Public API::
+
+    from tools.staticcheck import run
+    report = run(paths=["simumax_tpu"], select=["SIM005"])
+    report.exit_code     # 0 clean / 1 findings
+    report.findings      # list of Finding
+
+``python -m tools.staticcheck`` is the CLI.
+"""
+
+from tools.staticcheck.core import (  # noqa: F401
+    DEFAULT_PATHS,
+    Finding,
+    Project,
+    Report,
+    UsageError,
+    load_project,
+    run,
+)

@@ -1,0 +1,85 @@
+"""CLI for the domain-aware static-analysis pass::
+
+    python -m tools.staticcheck [paths...] [--select IDs] [--ignore IDs]
+                                [--json] [--json-file PATH] [--list]
+
+Default paths: ``simumax_tpu tests tools examples``. Exit codes:
+0 = clean, 1 = findings (incl. unused suppressions), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# `python tools/staticcheck/__main__.py` puts the package dir first on
+# sys.path; `python -m tools.staticcheck` from the repo root does not
+# need this, but keep both spellings working.
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.staticcheck import core  # noqa: E402
+from tools.staticcheck.checkers import REGISTRY  # noqa: E402
+
+
+def _split_ids(value):
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.staticcheck",
+        description="simumax-tpu domain invariant checkers "
+                    "(docs/static_analysis.md)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to analyze "
+                             f"(default: {' '.join(core.DEFAULT_PATHS)})")
+    parser.add_argument("--select", type=_split_ids, default=None,
+                        metavar="IDS",
+                        help="comma-separated checker ids to run")
+    parser.add_argument("--ignore", type=_split_ids, default=None,
+                        metavar="IDS",
+                        help="comma-separated checker ids to skip")
+    parser.add_argument("--json", action="store_true",
+                        help="print the JSON report to stdout")
+    parser.add_argument("--json-file", default=None, metavar="PATH",
+                        help="also write the JSON report to PATH")
+    parser.add_argument("--list", action="store_true",
+                        help="list the checker catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for cid in sorted(REGISTRY):
+            c = REGISTRY[cid]
+            print(f"{c.id}  {c.name}: {c.doc}")
+        return 0
+
+    try:
+        report = core.run(paths=args.paths or None, select=args.select,
+                          ignore=args.ignore)
+    except core.UsageError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    payload = report.to_dict()
+    if args.json_file:
+        with open(args.json_file, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    if args.json:
+        json.dump(payload, sys.stdout, indent=1)
+        print()
+    else:
+        for line in report.render_text():
+            print(line)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

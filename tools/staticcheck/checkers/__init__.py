@@ -1,0 +1,26 @@
+"""Checker registry: stable id -> checker object, in catalogue order.
+
+Adding a checker = writing a module with a ``CHECKER`` singleton
+(``id``, ``name``, ``doc``, ``check(project)``) and listing it here;
+``docs/static_analysis.md`` documents the contract.
+"""
+
+from tools.staticcheck.checkers import (
+    batched_drift,
+    cache_key,
+    collectives,
+    determinism,
+    discipline,
+    error_taxonomy,
+)
+
+ALL_CHECKERS = (
+    cache_key.CHECKER,       # SIM001
+    batched_drift.CHECKER,   # SIM002
+    determinism.CHECKER,     # SIM003
+    error_taxonomy.CHECKER,  # SIM004
+    discipline.CHECKER,      # SIM005
+    collectives.CHECKER,     # SIM006
+)
+
+REGISTRY = {c.id: c for c in ALL_CHECKERS}

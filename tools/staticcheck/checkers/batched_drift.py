@@ -1,0 +1,169 @@
+"""SIM002 — batched-engine drift.
+
+The batched sweep kernel (``search/batched.py``) re-implements the
+scalar cost model as array programs, and stays honest through two
+surfaces (``docs/search.md``): the **profile key** (``_KIND_FIELDS`` +
+explicit group-size terms) that decides when two layouts may share a
+block-kind profile, and the **fallback guard**
+(``check_supported`` raising ``UnsupportedBatched``) that routes
+unlowered features to the scalar oracle. A strategy field the scalar
+path starts reading that reaches *neither* surface is the exact drift
+PR 8's parity tests cannot catch: the batched engine silently reuses a
+profile across layouts that now differ, and parity holds on the tested
+grid while a swept grid returns wrong rankings.
+
+The checker computes, purely from the ASTs:
+
+* the **strategy vocabulary** — dataclass fields + properties of
+  ``StrategyConfig`` in ``core/config.py``;
+* the **scalar read set** — vocabulary names read as attributes
+  anywhere in the scalar cost path (``perf.py``, ``models/*.py``,
+  ``core/module.py``). Receiver-blind on purpose: a same-named
+  attribute on another object over-approximates, which can only add
+  coverage obligations, never hide one;
+* the **batched mirror surface** — vocabulary names read as attributes
+  anywhere in ``search/batched.py`` (this includes ``check_supported``
+  and ``_family_invalid_reason``) plus the string entries of the
+  ``_KIND_FIELDS`` profile-key tuple.
+
+Every scalar-read name must appear in the mirror surface or on the
+justified exemption list; stale exemptions (mirrored after all, or no
+longer read by the scalar path) are reported too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set, Tuple
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM002"
+
+CONFIG_REL = "simumax_tpu/core/config.py"
+BATCHED_REL = "simumax_tpu/search/batched.py"
+SCALAR_RELS = (
+    "simumax_tpu/perf.py",
+    "simumax_tpu/core/module.py",
+)
+SCALAR_DIR = "simumax_tpu/models/"
+
+#: scalar-read strategy fields deliberately absent from the batched
+#: mirror surface, each with its justification. Stale entries are
+#: reported.
+EXEMPT: Dict[str, str] = {
+    "global_batch_size": (
+        "derived property: micro_batch_size * micro_batch_num * "
+        "dp_size, all of whose inputs are mirrored (mbs/mbc are the "
+        "kernel's candidate axes; tp/cp/pp/world key the family)"
+    ),
+    "tokens_per_iter": (
+        "derived property: global_batch_size * seq_len — covered by "
+        "the same mirrored inputs plus seq_len in _KIND_FIELDS"
+    ),
+}
+
+
+def _strategy_vocabulary(config_tree: ast.AST) -> Set[str]:
+    vocab: Set[str] = set()
+    for cls in config_tree.body:
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "StrategyConfig"):
+            continue
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                if "ClassVar" not in ast.unparse(stmt.annotation):
+                    vocab.add(stmt.target.id)
+            elif isinstance(stmt, ast.FunctionDef):
+                for dec in stmt.decorator_list:
+                    if isinstance(dec, ast.Name) and dec.id == "property":
+                        vocab.add(stmt.name)
+    return vocab
+
+
+def _attribute_reads(tree: ast.AST, vocab: Set[str]):
+    """(name, lineno) for every vocabulary name read as an attribute."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in vocab \
+                and isinstance(node.ctx, ast.Load):
+            yield node.attr, node.lineno
+
+
+def _kind_fields_strings(batched_tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(batched_tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "_KIND_FIELDS"
+            for t in node.targets
+        ):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+    return out
+
+
+class BatchedDriftChecker:
+    id = ID
+    name = "batched-engine-drift"
+    doc = ("every strategy field the scalar cost path reads appears in "
+           "search/batched.py's profile key or its UnsupportedBatched "
+           "guard surface")
+
+    def check(self, project: Project):
+        config = project.find(CONFIG_REL)
+        batched = project.find(BATCHED_REL)
+        if config is None or config.tree is None \
+                or batched is None or batched.tree is None:
+            return
+        vocab = _strategy_vocabulary(config.tree)
+        if not vocab:
+            return
+
+        scalar_files = [
+            pf for rel in SCALAR_RELS
+            if (pf := project.find(rel)) is not None
+        ] + project.under(SCALAR_DIR)
+        reads: Dict[str, Tuple[str, int]] = {}
+        for pf in scalar_files:
+            if pf.tree is None:
+                continue
+            for name, lineno in _attribute_reads(pf.tree, vocab):
+                key = (pf.rel, lineno)
+                if name not in reads or key < reads[name]:
+                    reads[name] = key
+
+        mirror = {n for n, _ in _attribute_reads(batched.tree, vocab)}
+        mirror |= _kind_fields_strings(batched.tree) & vocab
+
+        for name in sorted(set(reads) - mirror - set(EXEMPT)):
+            rel, lineno = reads[name]
+            yield Finding(
+                ID, rel, lineno,
+                f"strategy field {name!r} is read by the scalar cost "
+                f"path but reaches neither search/batched.py's "
+                f"_KIND_FIELDS profile key nor its UnsupportedBatched "
+                f"guard — the batched engine would share profiles "
+                f"across layouts that differ on it. Mirror it or guard "
+                f"it (docs/search.md), or exempt it with a "
+                f"justification in "
+                f"tools/staticcheck/checkers/batched_drift.py",
+            )
+        for name in sorted(EXEMPT):
+            if name in mirror:
+                yield Finding(
+                    ID, batched.rel, 1,
+                    f"stale batched-drift exemption {name!r}: "
+                    f"search/batched.py now mirrors it — remove the "
+                    f"exemption",
+                )
+            elif name not in reads:
+                yield Finding(
+                    ID, batched.rel, 1,
+                    f"stale batched-drift exemption {name!r}: the "
+                    f"scalar cost path no longer reads it — remove "
+                    f"the exemption",
+                )
+
+
+CHECKER = BatchedDriftChecker()

@@ -1,0 +1,216 @@
+"""SIM001 — cache-key completeness.
+
+The planning service's correctness rests on one invariant: the
+content-addressed cache key covers **every** input the evaluation
+depends on (``docs/service.md``). The key is the canonical hash of the
+config triple's ``to_dict()`` renderings (``service/planner.py::
+query_identity`` -> ``service/store.py::canonical``), and ``to_dict``
+serializes exactly the *dataclass fields* — so any per-instance
+attribute a config class grows outside its dataclass fields is
+invisible to the key. If the evaluation reads it, the cache serves
+stale answers for changed inputs with no signal at all.
+
+The checker therefore enforces, over ``simumax_tpu/core/config.py``:
+
+1. every instance attribute assigned in a config class (``self.x = ...``
+   in any method, or ``obj.x = ...`` on a ``cls(...)``-constructed
+   object in a classmethod) is either a dataclass field — and thus
+   reaches the serialized identity — or on the explicit exemption list
+   below, each entry carrying its justification;
+2. exemption entries that no longer match any assignment are reported
+   as stale, so the list cannot silently outlive the code;
+3. ``service/planner.py::query_identity`` still routes each of
+   model / strategy / system through ``.to_dict()`` — the bridge that
+   makes (1) sufficient.
+
+Adding a new config knob as a proper dataclass field is always clean;
+adding per-instance state needs a justified exemption entry — that is
+the moment a human decides whether the cache key must grow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM001"
+
+CONFIG_REL = "simumax_tpu/core/config.py"
+PLANNER_REL = "simumax_tpu/service/planner.py"
+
+#: instance attributes deliberately excluded from the serialized
+#: identity. Every entry must keep matching an assignment in
+#: core/config.py, or the checker reports it as stale.
+EXEMPT: Dict[str, str] = {
+    "extra_fields": (
+        "unknown input keys are warned about at load and ignored by "
+        "the evaluation, so they cannot skew a cached answer"
+    ),
+    "config_path": (
+        "the path a config was loaded from is not identity — same "
+        "content hashes to the same key regardless of spelling "
+        "(docs/service.md)"
+    ),
+    "recompute": (
+        "derived deterministically in __post_init__ from the "
+        "serialized recompute_* fields; keying it would double-count"
+    ),
+    "hit_efficiency": (
+        "run-scoped observability, cleared by reset_status() before "
+        "every estimate — an output, never an input"
+    ),
+    "miss_efficiency": (
+        "run-scoped observability, cleared by reset_status() before "
+        "every estimate — an output, never an input"
+    ),
+    "real_comm_bw": (
+        "run-scoped observability, cleared by reset_status() before "
+        "every estimate — an output, never an input"
+    ),
+}
+
+
+def _is_dataclass_decorated(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(node, ast.Name) and node.id == "dataclass":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> Set[str]:
+    """Annotated class-body names (minus ClassVar) — what
+    ``dataclasses.fields`` / ``to_dict`` will serialize."""
+    fields: Set[str] = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name):
+            ann = ast.unparse(stmt.annotation)
+            if "ClassVar" in ann:
+                continue
+            fields.add(stmt.target.id)
+    return fields
+
+
+def _instance_targets(func: ast.FunctionDef) -> Iterable[ast.Attribute]:
+    """Attribute-assignment targets on ``self`` (or on a variable the
+    function bound to a ``cls(...)``-style construction)."""
+    receivers = {"self"}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            value = node.value
+            if isinstance(value, ast.Call):
+                root = value.func
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "cls":
+                    receivers.add(node.targets[0].id)
+    def flatten(t):
+        # `self.a, (self.b, *self.c) = ...` assigns through tuple/list
+        # unpacking — every element is an assignment target too
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                yield from flatten(elt)
+        elif isinstance(t, ast.Starred):
+            yield from flatten(t.value)
+        else:
+            yield t
+
+    for node in ast.walk(func):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in (x for raw in targets for x in flatten(raw)):
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id in receivers:
+                yield t
+
+
+class CacheKeyChecker:
+    id = ID
+    name = "cache-key-completeness"
+    doc = ("every config-class instance attribute is a serialized "
+           "dataclass field or on the justified exemption list; "
+           "query_identity still routes configs through to_dict()")
+
+    def check(self, project: Project):
+        config = project.find(CONFIG_REL)
+        if config is None or config.tree is None:
+            return
+        matched_exemptions: Set[str] = set()
+        for cls in config.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not (_is_dataclass_decorated(cls)
+                    or cls.name == "ConfigBase"):
+                continue
+            fields = _dataclass_fields(cls)
+            for stmt in cls.body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for target in _instance_targets(stmt):
+                    attr = target.attr
+                    if attr in fields:
+                        continue
+                    if attr in EXEMPT:
+                        matched_exemptions.add(attr)
+                        continue
+                    yield Finding(
+                        ID, config.rel, target.lineno,
+                        f"{cls.name}.{attr} is assigned but is not a "
+                        f"dataclass field: it never reaches the "
+                        f"serialized cache identity "
+                        f"(store.canonical via to_dict). Make it a "
+                        f"field, or add a justified exemption in "
+                        f"tools/staticcheck/checkers/cache_key.py",
+                    )
+        for name in sorted(set(EXEMPT) - matched_exemptions):
+            yield Finding(
+                ID, config.rel, 1,
+                f"stale cache-key exemption {name!r}: no config class "
+                f"assigns it any more — remove it from "
+                f"tools/staticcheck/checkers/cache_key.py",
+            )
+
+        planner = project.find(PLANNER_REL)
+        if planner is None or planner.tree is None:
+            return
+        qi = None
+        for node in planner.tree.body:
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name == "query_identity":
+                qi = node
+                break
+        if qi is None:
+            yield Finding(
+                ID, planner.rel, 1,
+                "query_identity() not found — the cache-key bridge "
+                "from configs to store.canonical is gone",
+            )
+            return
+        routed = set()
+        for node in ast.walk(qi):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "to_dict" \
+                    and isinstance(node.func.value, ast.Name):
+                routed.add(node.func.value.id)
+        for kind in ("model", "strategy", "system"):
+            if kind not in routed:
+                yield Finding(
+                    ID, planner.rel, qi.lineno,
+                    f"query_identity() no longer serializes {kind} via "
+                    f"{kind}.to_dict() — {kind} config fields would "
+                    f"drop out of the cache key",
+                )
+
+
+CHECKER = CacheKeyChecker()

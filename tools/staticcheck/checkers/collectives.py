@@ -1,0 +1,239 @@
+"""SIM006 — collective coverage.
+
+A model leaf emits collectives as ``CollectiveCall(phase, op, dim,
+...)`` records; the framework costs each over the strategy's mesh
+placement: ``op`` must be a branch of ``SystemConfig.
+compute_net_op_terms`` (the single implementation behind both
+``compute_net_op_time`` and the batched kernel's ``net_op_coeffs``)
+and ``dim`` must be a ``CommPath`` placed by
+``perf.place_strategy_paths``. Neither lookup fails loudly on a novel
+op: ``compute_net_op_terms`` asserts membership in ``NET_OPS`` but an
+op added to ``NET_OPS`` without a cost branch silently costs **zero**
+— the exact "free collective" bug class the README's accuracy
+validation exists to rule out. An unplaced dim at least raises at run
+time, but only on the first configuration that routes through it.
+
+Statically enforced, from the ASTs alone:
+
+1. every literal ``op`` a model emits is in ``NET_OPS``
+   (``core/config.py``);
+2. every such op is handled by an explicit comparison branch inside
+   ``compute_net_op_terms`` — no op can fall through to the implicit
+   zero;
+3. every ``op`` in ``NET_OPS`` has such a branch (a new vocabulary
+   entry cannot be costable-by-accident);
+4. every literal ``dim`` a model emits (``CollectiveCall`` arg or
+   ``ctx.path("...")`` lookup) is placed by ``place_strategy_paths``.
+
+Dynamic (non-literal) ops/dims are skipped — they are covered at the
+emission site by the literal vocabulary they are computed from.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM006"
+
+CONFIG_REL = "simumax_tpu/core/config.py"
+PERF_REL = "simumax_tpu/perf.py"
+MODULE_REL = "simumax_tpu/core/module.py"
+MODELS_DIR = "simumax_tpu/models/"
+
+
+def _literal(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_arg(call: ast.Call, index: int, kw: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    if len(call.args) > index:
+        return call.args[index]
+    return None
+
+
+def _net_ops(config_tree: ast.AST) -> Set[str]:
+    for node in config_tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "NET_OPS"
+            for t in node.targets
+        ):
+            return {
+                c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+    return set()
+
+
+def _costed_ops(config_tree: ast.AST) -> Set[str]:
+    """String literals *positively* matched against ``op`` inside
+    ``SystemConfig.compute_net_op_terms`` — its branch coverage.
+
+    Only ``op == "x"`` / ``op in (...)`` comparisons count: a negative
+    guard (``op != "x"``) or membership exclusion does not prove a
+    cost branch exists, and counting it would hide the silent-zero
+    fallthrough this checker exists to catch."""
+    func = None
+    for cls in config_tree.body:
+        if isinstance(cls, ast.ClassDef) and cls.name == "SystemConfig":
+            for stmt in cls.body:
+                if isinstance(stmt, ast.FunctionDef) \
+                        and stmt.name == "compute_net_op_terms":
+                    func = stmt
+    if func is None:
+        return set()
+    ops: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name) and node.left.id == "op"):
+            continue
+        for cmp_op, comparator in zip(node.ops, node.comparators):
+            if not isinstance(cmp_op, (ast.Eq, ast.In)):
+                continue
+            for c in ast.walk(comparator):
+                if isinstance(c, ast.Constant) \
+                        and isinstance(c.value, str):
+                    ops.add(c.value)
+    return ops
+
+
+def _placed_dims(perf_tree: ast.AST) -> Set[str]:
+    """Dims ``place_strategy_paths`` installs: literal first args of
+    ``place_group`` calls, literal subscript-assignment keys on the
+    ``paths`` dict itself, literal keys of a dict assigned to
+    ``sizes``/``paths`` (the placement comprehension iterates the
+    ``sizes`` keys), and ``CommPath(dim=...)`` literals — all within
+    the function body. Deliberately narrow: an unrelated local dict's
+    keys must never count as placed dims (that would hide an unplaced
+    ``ctx.path(...)`` — the hole this checker closes)."""
+    func = None
+    for node in perf_tree.body:
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "place_strategy_paths":
+            func = node
+    if func is None:
+        return set()
+    dims: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "place_group":
+                d = _literal(_call_arg(node, 0, "dim"))
+                if d:
+                    dims.add(d)
+            if isinstance(f, ast.Name) and f.id == "CommPath":
+                d = _literal(_call_arg(node, 0, "dim"))
+                if d:
+                    dims.add(d)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "paths":
+                    d = _literal(t.slice)
+                    if d:
+                        dims.add(d)
+            if isinstance(node.value, ast.Dict) and any(
+                isinstance(t, ast.Name) and t.id in ("sizes", "paths")
+                for t in node.targets
+            ):
+                for k in node.value.keys:
+                    d = _literal(k)
+                    if d:
+                        dims.add(d)
+    return dims
+
+
+def _emitted(project: Project):
+    """Literal (op, dim, rel, line) tuples from every
+    ``CollectiveCall(...)`` construction and literal ``.path("x")`` /
+    ``compute_net_op_time("op", ...)`` lookup in the model layer."""
+    files = [
+        pf for pf in (
+            [project.find(MODULE_REL), project.find(PERF_REL)]
+            + project.under(MODELS_DIR)
+        ) if pf is not None and pf.tree is not None
+    ]
+    calls = []
+    for pf in files:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "CollectiveCall":
+                op = _literal(_call_arg(node, 1, "op"))
+                dim = _literal(_call_arg(node, 2, "dim"))
+                calls.append((op, dim, pf.rel, node.lineno))
+            elif isinstance(f, ast.Attribute) and f.attr == "path":
+                dim = _literal(_call_arg(node, 0, "dim"))
+                if dim:
+                    calls.append((None, dim, pf.rel, node.lineno))
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr == "compute_net_op_time":
+                op = _literal(_call_arg(node, 0, "op"))
+                if op:
+                    calls.append((op, None, pf.rel, node.lineno))
+    return calls
+
+
+class CollectiveCoverageChecker:
+    id = ID
+    name = "collective-coverage"
+    doc = ("every (dim, op) a model can emit is costable: op has a "
+           "compute_net_op_terms branch, dim is placed by "
+           "place_strategy_paths")
+
+    def check(self, project: Project):
+        config = project.find(CONFIG_REL)
+        perf = project.find(PERF_REL)
+        if config is None or config.tree is None \
+                or perf is None or perf.tree is None:
+            return
+        net_ops = _net_ops(config.tree)
+        costed = _costed_ops(config.tree)
+        placed = _placed_dims(perf.tree)
+        if not net_ops or not placed:
+            return
+
+        for op, dim, rel, line in _emitted(project):
+            if op is not None:
+                if op not in net_ops:
+                    yield Finding(
+                        ID, rel, line,
+                        f"collective op {op!r} is not in NET_OPS "
+                        f"(core/config.py) — compute_net_op_terms "
+                        f"would assert on it",
+                    )
+                elif op not in costed:
+                    yield Finding(
+                        ID, rel, line,
+                        f"collective op {op!r} has no cost branch in "
+                        f"SystemConfig.compute_net_op_terms — it would "
+                        f"silently cost zero",
+                    )
+            if dim is not None and dim not in placed:
+                yield Finding(
+                    ID, rel, line,
+                    f"collective dim {dim!r} is not placed by "
+                    f"perf.place_strategy_paths — ctx.path({dim!r}) "
+                    f"raises at run time on the first strategy that "
+                    f"routes through it",
+                )
+        for op in sorted(net_ops - costed):
+            yield Finding(
+                ID, config.rel, 1,
+                f"NET_OPS entry {op!r} has no cost branch in "
+                f"compute_net_op_terms — any model emitting it would "
+                f"silently cost zero",
+            )
+
+
+CHECKER = CollectiveCoverageChecker()

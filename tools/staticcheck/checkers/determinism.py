@@ -1,0 +1,184 @@
+"""SIM003 — determinism lint for bit-identity paths.
+
+The repo's strongest contracts are bit-identity promises: ``--jobs N``
+sweeps merge identically to serial (PR 2), cache-on responses equal
+cache-off evaluations byte for byte (PR 9), the batched engine's top-k
+equals the scalar oracle (PR 8), and run-identity hashes must be stable
+across processes (PR 3). Any wall-clock read, global-RNG draw, or
+set-ordered iteration inside those paths can silently break all four —
+set iteration order varies *per process* under hash randomization, so
+the breakage only shows up as a cross-run flake.
+
+Flagged inside the scoped paths:
+
+* ``time.time()`` / ``time.time_ns()`` — wall-clock in a value that may
+  reach a hash, a merge, or a cached payload;
+* ``datetime.*.now()/today()/utcnow()`` — same, calendar flavored;
+* module-level ``random.*`` draws — the process-global RNG; use a
+  seeded ``random.Random(seed)`` instance instead;
+* ``for`` loops / comprehensions iterating a set expression (set
+  literal, ``set(...)``/``frozenset(...)`` call, set algebra thereof)
+  without ``sorted(...)``. Comprehensions consumed by an
+  order-insensitive reducer (``any/all/sum/min/max/len/sorted/set/
+  frozenset``) are not flagged;
+* ``os.listdir(...)`` not wrapped in ``sorted(...)`` — directory order
+  is filesystem-dependent.
+
+Intentional uses (e.g. the cache entry's ``created`` wall-clock stamp,
+which is header metadata and never part of a payload or key) carry a
+``# noqa: SIM003`` with a one-line justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.staticcheck.core import Finding, ParsedFile, Project
+
+ID = "SIM003"
+
+#: the paths that promise bit-identity (sweep/merge/hash/cost)
+SCOPE = (
+    "simumax_tpu/search/",
+    "simumax_tpu/service/store.py",
+    "simumax_tpu/service/planner.py",
+    "simumax_tpu/core/",
+    "simumax_tpu/perf.py",
+    "simumax_tpu/parallel/",
+    "simumax_tpu/models/",
+    "simumax_tpu/simulator/reduce.py",
+)
+
+#: module-level draws on the process-global RNG
+RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+    "expovariate", "seed",
+}
+
+#: builtins whose result does not depend on iteration order
+ORDER_FREE_CONSUMERS = {
+    "sorted", "any", "all", "sum", "min", "max", "len", "set",
+    "frozenset",
+}
+
+
+def _attr_chain(node: ast.AST):
+    """Dotted-name chain of an attribute expression, outermost last:
+    ``datetime.date.today`` -> ('datetime', 'date', 'today')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    return tuple(reversed(parts))
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    """Whether the expression statically produces a set: literals,
+    ``set()``/``frozenset()`` calls, or set algebra over such."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in ("set", "frozenset")):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+def _consumed_order_free(node: ast.AST, parents) -> bool:
+    """Whether a comprehension's result feeds an order-insensitive
+    reducer directly (``any(x for x in set(...))``)."""
+    parent = parents.get(node)
+    return (
+        isinstance(parent, ast.Call)
+        and isinstance(parent.func, ast.Name)
+        and parent.func.id in ORDER_FREE_CONSUMERS
+        and node in parent.args
+    )
+
+
+def scan(pf: ParsedFile):
+    tree = pf.tree
+    parents = pf.parents()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain:
+                if chain[0] == "time" and chain[-1] in ("time", "time_ns"):
+                    yield Finding(
+                        ID, pf.rel, node.lineno,
+                        "wall-clock time.time() in a bit-identity path — "
+                        "pass timestamps in, or justify with a noqa",
+                    )
+                elif (chain[0] in ("datetime", "date")
+                      and chain[-1] in ("now", "today", "utcnow")):
+                    yield Finding(
+                        ID, pf.rel, node.lineno,
+                        f"wall-clock {'.'.join(chain)}() in a "
+                        "bit-identity path — pass timestamps in, or "
+                        "justify with a noqa",
+                    )
+                elif chain[0] == "random" and len(chain) == 2 \
+                        and chain[1] in RANDOM_FNS:
+                    yield Finding(
+                        ID, pf.rel, node.lineno,
+                        f"random.{chain[1]}() draws the process-global "
+                        "RNG — use a seeded random.Random(seed) instance",
+                    )
+                elif chain[-2:] == ("os", "listdir") or \
+                        chain == ("os", "listdir"):
+                    parent = parents.get(node)
+                    wrapped = (
+                        isinstance(parent, ast.Call)
+                        and isinstance(parent.func, ast.Name)
+                        and parent.func.id == "sorted"
+                    )
+                    if not wrapped:
+                        yield Finding(
+                            ID, pf.rel, node.lineno,
+                            "os.listdir() order is filesystem-dependent "
+                            "— wrap in sorted(...)",
+                        )
+        if isinstance(node, ast.For) and _is_set_expr(node.iter):
+            yield Finding(
+                ID, pf.rel, node.iter.lineno,
+                "iteration over a set is hash-order-dependent — wrap "
+                "in sorted(...)",
+            )
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                             ast.DictComp)):
+            if any(_is_set_expr(g.iter) for g in node.generators):
+                if isinstance(node, ast.SetComp):
+                    continue  # result is a set again: no order to leak
+                # NB: DictComp IS flagged — dicts preserve insertion
+                # order, so a set-ordered build leaks into iteration
+                if _consumed_order_free(node, parents):
+                    continue
+                yield Finding(
+                    ID, pf.rel, node.lineno,
+                    "comprehension over a set is hash-order-dependent — "
+                    "wrap the set in sorted(...) or consume it with an "
+                    "order-insensitive reducer",
+                )
+
+
+class DeterminismChecker:
+    id = ID
+    name = "determinism"
+    doc = ("no wall-clock, global-RNG, or set-order dependence in "
+           "sweep/merge/hash/cost paths that promise bit-identity")
+
+    def check(self, project: Project):
+        for prefix in SCOPE:
+            for pf in project.under(prefix):
+                if pf.tree is not None:
+                    yield from scan(pf)
+
+
+CHECKER = DeterminismChecker()

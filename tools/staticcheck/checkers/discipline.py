@@ -1,0 +1,113 @@
+"""SIM005 — reporter / except discipline.
+
+Absorbs the two lint-style test guards as one checker (the tests are
+now thin wrappers over this module, so pytest and ``staticcheck`` can
+never disagree):
+
+* **no bare ``print(...)``** in ``simumax_tpu/`` library modules: user
+  facing report lines go through ``observe/report.py`` (so
+  ``--log-level`` / ``--log-json`` apply everywhere). The only modules
+  allowed to print are the reporter itself and the CLI boundary (which
+  owns stderr error lines).
+* **no bare ``except:``** and no silently-swallowing broad handlers
+  (``except Exception: pass``): every handler must either name the
+  exception kinds it understands (the ``core/errors.py`` taxonomy) or
+  actually do something with what it caught — record it, re-raise it,
+  substitute a value.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM005"
+
+#: modules allowed to call print(), project-relative
+ALLOWED_PRINT = (
+    "simumax_tpu/cli.py",
+    "simumax_tpu/observe/report.py",
+)
+
+SCOPE = "simumax_tpu/"
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body swallows the exception without a
+    trace: only ``pass``, ``...``, or a bare docstring."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue  # `...` or a string literal
+        return False
+    return True
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """True for ``except:`` and ``except (Base)Exception``."""
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(
+        isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+        for n in names
+    )
+
+
+def scan_print(tree: ast.AST, rel: str):
+    """Yield bare-print findings for one parsed module."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield Finding(
+                ID, rel, node.lineno,
+                "bare print() call — library modules report through "
+                "observe/report.py (get_reporter().info/...)",
+                rule="print",
+            )
+
+
+def scan_except(tree: ast.AST, rel: str):
+    """Yield except-discipline findings for one parsed module."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            yield Finding(
+                ID, rel, node.lineno,
+                "bare `except:` — name the exception kinds "
+                "(core/errors.py taxonomy) or re-raise",
+                rule="except",
+            )
+        elif _is_broad(node) and _is_silent(node):
+            yield Finding(
+                ID, rel, node.lineno,
+                "`except Exception: pass` swallows failures silently — "
+                "record, re-raise, or substitute a value",
+                rule="except",
+            )
+
+
+class DisciplineChecker:
+    id = ID
+    name = "reporter-except-discipline"
+    doc = ("no bare print() outside cli.py/observe/report.py and no "
+           "silent broad except handlers in simumax_tpu/")
+
+    def check(self, project: Project):
+        for pf in project.under(SCOPE):
+            if pf.tree is None:
+                continue
+            if pf.rel not in ALLOWED_PRINT:
+                yield from scan_print(pf.tree, pf.rel)
+            yield from scan_except(pf.tree, pf.rel)
+
+
+CHECKER = DisciplineChecker()

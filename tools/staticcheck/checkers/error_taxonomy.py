@@ -1,0 +1,75 @@
+"""SIM004 — error taxonomy discipline.
+
+Library modules must raise through the ``core/errors.py`` hierarchy,
+never bare ``ValueError`` / ``RuntimeError`` / ``Exception``: the layers
+above (strategy search, calibration, CLI, the HTTP server's 400/500
+mapping) react *per kind* — quarantine a candidate, retry a
+microbenchmark, print a one-line actionable message — and a bare stdlib
+raise falls through every one of those handlers as an anonymous crash.
+
+The taxonomy classes keep stdlib bases for compatibility
+(``ConfigError(ValueError)``, ``SimulationError(RuntimeError)``), so
+converting a raise site never breaks an existing ``except ValueError``.
+
+Scope: ``simumax_tpu/`` except ``jaxref/`` — the JAX reference models
+surface errors to JAX users in JAX's own idiom, not through the
+simulator's diagnostics, so stdlib raises are correct there.
+``AssertionError`` stays allowed everywhere: internal invariants are
+asserts by convention (PR 1), only *anticipated* failures get taxonomy
+classes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM004"
+
+SCOPE = "simumax_tpu/"
+EXCLUDED = ("simumax_tpu/jaxref/",)
+
+#: stdlib exception classes a library raise must not use directly
+BANNED = {
+    "ValueError": "ConfigError (or a sibling in core/errors.py)",
+    "RuntimeError": "SimulationError (or a sibling in core/errors.py)",
+    "Exception": "a core/errors.py taxonomy class",
+    "BaseException": "a core/errors.py taxonomy class",
+}
+
+
+def scan(tree: ast.AST, rel: str):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        name = None
+        if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+            name = exc.func.id
+        elif isinstance(exc, ast.Name):
+            name = exc.id
+        if name in BANNED:
+            yield Finding(
+                ID, rel, node.lineno,
+                f"raise {name} in a library module — use "
+                f"{BANNED[name]} so callers can react per kind",
+            )
+
+
+class ErrorTaxonomyChecker:
+    id = ID
+    name = "error-taxonomy"
+    doc = ("no raise ValueError/RuntimeError/Exception in simumax_tpu/ "
+           "library modules (excl. jaxref/) — use core/errors.py")
+
+    def check(self, project: Project):
+        for pf in project.under(SCOPE):
+            if pf.tree is None:
+                continue
+            if any(pf.rel.startswith(p) for p in EXCLUDED):
+                continue
+            yield from scan(pf.tree, pf.rel)
+
+
+CHECKER = ErrorTaxonomyChecker()

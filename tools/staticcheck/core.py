@@ -1,0 +1,310 @@
+"""Framework core for ``tools/staticcheck``: parse once, run a checker
+registry, apply ``# noqa`` suppressions, report.
+
+Design (see ``docs/static_analysis.md`` for the user-facing contract):
+
+* **one parse per file** — every selected path is read, tokenized (for
+  noqa directives) and ``ast.parse``d exactly once into a
+  :class:`ParsedFile`; all checkers share the trees through the
+  :class:`Project`, so adding a checker costs its walk, never a re-parse;
+* **checkers** are objects with a stable ``id`` (``SIMnnn``), a short
+  ``name``, a ``doc`` contract line, and ``check(project)`` yielding
+  :class:`Finding`s. Cross-file checkers look files up by project-relative
+  path (:meth:`Project.find`), so the same checker runs against the real
+  tree and against fixture trees in tests;
+* **suppression** — a finding is suppressed by a ``# noqa`` on its line
+  (bare, or naming the checker id; ``tools/staticcheck/noqa.py`` is the
+  shared parser). Directives that suppress nothing are themselves
+  reported (id ``NQA001``) so stale suppressions cannot accumulate;
+* **exit codes**: 0 = clean, 1 = findings (incl. unused suppressions),
+  2 = usage error (bad path, unknown checker id).
+
+The framework is dependency-free (stdlib only) and never imports the
+code under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence
+
+from tools.staticcheck import noqa as noqa_mod
+
+#: default analysis roots, mirroring ``tools/lint.py``
+DEFAULT_PATHS = ("simumax_tpu", "tests", "tools", "examples")
+
+#: pseudo-checker ids owned by the framework itself
+PARSE_ERROR_ID = "SIM000"   # file failed to parse
+UNUSED_NOQA_ID = "NQA001"   # suppression matching no finding
+
+JSON_SCHEMA = "simumax-staticcheck-v1"
+
+
+class UsageError(Exception):
+    """Bad invocation (unknown path / checker id): exit code 2."""
+
+
+class Finding:
+    """One reported defect, anchored to a file line.
+
+    ``rule`` optionally names the sub-rule within a checker (e.g.
+    SIM005's ``print`` vs ``except``) so consumers can discriminate
+    structurally instead of grepping message prose."""
+
+    __slots__ = ("id", "path", "line", "message", "rule", "suppressed")
+
+    def __init__(self, id: str, path: str, line: int, message: str,
+                 rule: str = ""):
+        self.id = id
+        self.path = path
+        self.line = line
+        self.message = message
+        self.rule = rule
+        self.suppressed = False
+
+    def sort_key(self):
+        return (self.path, self.line, self.id, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.id} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "rule": self.rule,
+        }
+
+
+class ParsedFile:
+    """One analyzed file: source, AST, noqa directives — parsed once.
+
+    ``rel`` is the project-layout-relative posix path (e.g.
+    ``simumax_tpu/core/config.py``) the checkers scope and anchor
+    findings on — computed by :func:`load_project` so it never
+    contains ``..`` even for path arguments outside the cwd."""
+
+    def __init__(self, rel: str, abspath: str):
+        self.abspath = abspath
+        self.rel = rel.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.noqa = noqa_mod.collect(self.source)
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.source, filename=self.rel)
+        except SyntaxError as e:
+            self.parse_error = e
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child node -> parent node map, built lazily once."""
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        parents[child] = node
+            self._parents = parents
+        return self._parents
+
+
+class Project:
+    """The parsed file set one run analyzes."""
+
+    def __init__(self, root: str, files: List[ParsedFile]):
+        self.root = root
+        self.files = files
+        self._by_rel = {f.rel: f for f in files}
+
+    def find(self, rel_suffix: str) -> Optional[ParsedFile]:
+        """Look a file up by project-relative posix path; falls back to
+        unique-suffix match so checkers written against the repo layout
+        also resolve files in fixture trees rooted differently."""
+        f = self._by_rel.get(rel_suffix)
+        if f is not None:
+            return f
+        matches = [
+            f for f in self.files
+            if f.rel.endswith("/" + rel_suffix) or f.rel == rel_suffix
+        ]
+        return matches[0] if len(matches) == 1 else None
+
+    def under(self, rel_prefix: str) -> List[ParsedFile]:
+        """Files whose project-relative path starts with ``rel_prefix``
+        (a directory prefix ending in ``/``, or an exact file path)."""
+        return [
+            f for f in self.files
+            if f.rel == rel_prefix or f.rel.startswith(rel_prefix)
+        ]
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    files: List[ParsedFile] = []
+    seen = set()
+    for p in paths:
+        full = os.path.abspath(
+            p if os.path.isabs(p) else os.path.join(root, p)
+        )
+        if not os.path.exists(full):
+            raise UsageError(f"no such path: {p!r}")
+        # anchor for layout-relative names: the root when the path is
+        # inside it, else the path's own parent — so an absolute or
+        # ../ argument (`staticcheck /repo/simumax_tpu` from anywhere)
+        # still yields `simumax_tpu/...` rels and the repo-layout
+        # checker scopes keep applying; rels never contain "..".
+        anchor = root
+        if os.path.relpath(full, root).startswith(".."):
+            anchor = os.path.dirname(full)
+        for abspath in _iter_py_files(full):
+            abspath = os.path.abspath(abspath)
+            if abspath in seen:
+                continue
+            seen.add(abspath)
+            files.append(
+                ParsedFile(os.path.relpath(abspath, anchor), abspath)
+            )
+    files.sort(key=lambda f: f.rel)
+    return Project(root, files)
+
+
+def resolve_checkers(registry, select: Optional[Sequence[str]] = None,
+                     ignore: Optional[Sequence[str]] = None):
+    """Apply ``--select`` / ``--ignore`` to the registry (a dict
+    ``id -> checker``); unknown ids are a :class:`UsageError`."""
+    known = set(registry)
+    for spec, flag in ((select, "--select"), (ignore, "--ignore")):
+        for cid in spec or ():
+            if cid.upper() not in known:
+                raise UsageError(
+                    f"{flag}: unknown checker id {cid!r} "
+                    f"(known: {', '.join(sorted(known))})"
+                )
+    chosen = list(registry.values())
+    if select:
+        wanted = {c.upper() for c in select}
+        chosen = [c for c in chosen if c.id in wanted]
+    if ignore:
+        dropped = {c.upper() for c in ignore}
+        chosen = [c for c in chosen if c.id not in dropped]
+    return chosen
+
+
+class Report:
+    """The outcome of one run: visible findings, suppressed findings,
+    unused-suppression findings, and the exit-code contract."""
+
+    def __init__(self, project: Project, selected_ids: List[str],
+                 findings: List[Finding], suppressed: List[Finding],
+                 unused: List[Finding], paths: Sequence[str]):
+        self.project = project
+        self.selected_ids = selected_ids
+        self.findings = findings
+        self.suppressed = suppressed
+        self.unused = unused
+        self.paths = list(paths)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.findings or self.unused) else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": JSON_SCHEMA,
+            "paths": self.paths,
+            "selected": self.selected_ids,
+            "findings": [f.to_dict() for f in self.findings],
+            "unused_suppressions": [f.to_dict() for f in self.unused],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "counts": {
+                "files": len(self.project.files),
+                "findings": len(self.findings),
+                "unused_suppressions": len(self.unused),
+                "suppressed": len(self.suppressed),
+            },
+            "exit_code": self.exit_code,
+        }
+
+    def render_text(self) -> List[str]:
+        lines = [f.render() for f in self.findings]
+        lines += [f.render() for f in self.unused]
+        n = len(self.findings) + len(self.unused)
+        lines.append(
+            f"{n} finding(s) ({len(self.suppressed)} suppressed) in "
+            f"{len(self.project.files)} file(s) "
+            f"[{','.join(self.selected_ids)}]"
+        )
+        return lines
+
+
+def run(paths: Optional[Sequence[str]] = None,
+        select: Optional[Sequence[str]] = None,
+        ignore: Optional[Sequence[str]] = None,
+        root: Optional[str] = None,
+        registry=None) -> Report:
+    """Parse ``paths`` once, run the selected checkers, apply noqa."""
+    if registry is None:
+        from tools.staticcheck.checkers import REGISTRY
+        registry = REGISTRY
+    paths = list(paths) if paths else list(DEFAULT_PATHS)
+    checkers = resolve_checkers(registry, select, ignore)
+    project = load_project(paths, root=root)
+
+    raw: List[Finding] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            raw.append(Finding(
+                PARSE_ERROR_ID, f.rel, f.parse_error.lineno or 1,
+                f"syntax error: {f.parse_error.msg}",
+            ))
+    for checker in checkers:
+        raw.extend(checker.check(project))
+    raw.sort(key=Finding.sort_key)
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_rel = {f.rel: f for f in project.files}
+    for finding in raw:
+        pf = by_rel.get(finding.path)
+        directive = pf.noqa.get(finding.line) if pf else None
+        if noqa_mod.suppresses(directive, finding.id):
+            finding.suppressed = True
+            suppressed.append(finding)
+        else:
+            findings.append(finding)
+
+    # unused-suppression reporting: only codes whose checker ran can be
+    # judged stale. Bare directives are never judged (they may be
+    # silencing another tool's finding on the line — see noqa.unused).
+    owned = {c.id for c in checkers}
+    unused_findings: List[Finding] = []
+    for pf in project.files:
+        for d in noqa_mod.unused(pf.noqa, owned):
+            spec = "# noqa: " + ",".join(d.codes)
+            unused_findings.append(Finding(
+                UNUSED_NOQA_ID, pf.rel, d.line,
+                f"unused suppression `{spec}` (no matching finding on "
+                f"this line; remove it or fix the code it was excusing)",
+            ))
+    unused_findings.sort(key=Finding.sort_key)
+    return Report(project, [c.id for c in checkers], findings,
+                  suppressed, unused_findings, paths)

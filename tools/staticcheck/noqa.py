@@ -1,0 +1,140 @@
+"""Line-level ``# noqa`` suppression parsing — the single implementation
+both ``tools/lint.py`` and ``tools/staticcheck`` honor, so the two
+linters can never disagree about what a suppression comment means.
+
+Contract (documented in ``docs/static_analysis.md``):
+
+* ``# noqa`` (bare) suppresses **every** finding on its physical line,
+  for every tool that honors this module.
+* ``# noqa: CODE1,CODE2`` (comma-separated) suppresses findings whose
+  code (or a declared alias of it — e.g. flake8's ``F401`` aliases
+  ``lint.py``'s ``L001``) is listed. Codes a tool does not own are
+  ignored by that tool — neither honored nor reported — because they
+  belong to a different linter sharing the comment namespace (flake8,
+  ruff, ...).
+* unused-suppression reporting is per-tool and **coded-only**: a tool
+  reports a directive as unused when it names at least one code the
+  tool owns and suppressed nothing in that run. Bare directives are
+  honored but never staleness-checked — no single tool can see the
+  other tools' findings on the line.
+
+Comments are found with :mod:`tokenize` so a ``# noqa`` inside a string
+literal is never mistaken for a directive; files that fail to tokenize
+(syntax errors) yield no directives.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, Optional, Tuple
+
+#: matches the directive inside a comment token. A code is a letter
+#: prefix followed by digits (``SIM004``, ``F401``, ``L001``);
+#: multiple codes must be **comma-separated** and the capture stops at
+#: the first token that is not one — so trailing justification prose
+#: ("noqa: SIM003 sorted on return", even prose mentioning another
+#: code id) can never widen the suppression.
+_CODE = r"[A-Za-z]+[0-9]+"
+_NOQA_RE = re.compile(
+    r"#\s*noqa"               # the marker
+    r"(?![^\s:])"             # word boundary: prose like "noqa's are
+                              # banned" / "noqa-style" is NOT a directive
+    rf"(?P<colon>\s*:\s*)?(?P<codes>{_CODE}(?:\s*,\s*{_CODE})*)?",
+    re.IGNORECASE,
+)
+
+
+class Directive:
+    """One ``# noqa`` comment: its line, its codes (empty = bare), and
+    whether any tool in this run used it to suppress a finding."""
+
+    __slots__ = ("line", "codes", "used")
+
+    def __init__(self, line: int, codes: Tuple[str, ...]):
+        self.line = line
+        self.codes = codes  # empty tuple means a bare directive
+        self.used = False
+
+    @property
+    def bare(self) -> bool:
+        return not self.codes
+
+    def __repr__(self) -> str:
+        spec = ",".join(self.codes) if self.codes else "<bare>"
+        return f"Directive(line={self.line}, codes={spec})"
+
+
+def parse_comment(text: str) -> Optional[Tuple[str, ...]]:
+    """Return the directive's code tuple (``()`` for a bare noqa) if the
+    comment text carries one, else None.
+
+    ``# noqa:`` followed by no parseable code (``# noqa: see below``)
+    is **not** a directive — treating it as bare would silently turn a
+    malformed coded suppression into a blanket one."""
+    m = _NOQA_RE.search(text)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return None if m.group("colon") else ()
+    return tuple(
+        c.upper() for c in re.split(r"[\s,]+", codes.strip()) if c
+    )
+
+
+def collect(source: str) -> Dict[int, Directive]:
+    """Map physical line number -> :class:`Directive` for one file."""
+    out: Dict[int, Directive] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            codes = parse_comment(tok.string)
+            if codes is not None:
+                out[tok.start[0]] = Directive(tok.start[0], codes)
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        # unparseable file: the caller's syntax check owns the report
+        return {}
+    return out
+
+
+def suppresses(directive: Optional[Directive], code: str,
+               aliases: Iterable[str] = ()) -> bool:
+    """Whether ``directive`` suppresses a finding of ``code`` (or one of
+    the tool-declared ``aliases`` for that code). Marks the directive
+    used on a match."""
+    if directive is None:
+        return False
+    if directive.bare:
+        directive.used = True
+        return True
+    wanted = {code.upper()}
+    wanted.update(a.upper() for a in aliases)
+    if wanted & set(directive.codes):
+        directive.used = True
+        return True
+    return False
+
+
+def unused(directives: Dict[int, Directive],
+           owned_codes: Iterable[str]) -> Iterable[Directive]:
+    """Directives this tool must report as unused: directives naming at
+    least one code in ``owned_codes`` that suppressed nothing.
+
+    Foreign-coded directives are never reported, and neither are bare
+    ones: a bare directive suppresses findings of *every* tool sharing
+    the comment namespace, and no single tool can see the others'
+    findings on the line — reporting it here would make a bare noqa
+    that legitimately silences the *other* linter fail this one.
+    Staleness checking is a coded-directive feature; the docs steer
+    suppressions to coded form for exactly this reason."""
+    owned = {c.upper() for c in owned_codes}
+    for line in sorted(directives):
+        d = directives[line]
+        if d.used or d.bare:
+            continue
+        if owned & set(d.codes):
+            yield d
