@@ -103,6 +103,7 @@ def run_sweep(spec, jobs, prune, engine="scalar", verify_topk=None):
     c = diag.counters
     total = int(c.get("sweep_cells_total", 0))
     pruned = int(c.get("sweep_cells_pruned", 0))
+    prefix = "sweep_batched_fallback["
     return {
         "rows": rows,
         "elapsed_s": elapsed,
@@ -115,10 +116,59 @@ def run_sweep(spec, jobs, prune, engine="scalar", verify_topk=None):
         "candidates_scored": int(
             c.get("sweep_batched_candidates_scored", 0)),
         "verified_rows": int(c.get("sweep_rows_verified", 0)),
+        # per-cell scalar fallbacks (reason histogram): the wide-grid
+        # gate expects this empty since PR 11's full-coverage lowering
+        "fallback_cells": int(c.get("sweep_batched_fallbacks", 0)),
+        "batched_fallbacks": {
+            k[len(prefix):-1]: int(v)
+            for k, v in sorted(c.items()) if k.startswith(prefix)
+        },
         # throughput counts every *dispatched* grid cell: pruning a cell
         # in O(closed-form) instead of O(model build) is the point
         "cells_per_sec": total / elapsed if elapsed > 0 else 0.0,
     }
+
+
+def run_kernel_bench(spec, n_cands):
+    """Raw kernel scoring throughput (candidates/s) on one
+    representative fold-heavy family of the grid's model/system: the
+    same large candidate batch through the numpy fold and the jitted
+    jax fold (results are bit-identical — tests/test_batched.py pins
+    it; this measures only speed). Returns per-backend candidates/s;
+    jax is None when not importable."""
+    from simumax_tpu.search.batched import BatchedScorer, jax_available
+
+    model = get_model_config(spec["model"])
+    system = get_system_config(spec["system"])
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = spec["world"]
+    st.tp_size, st.pp_size = 2, 4
+    st.enable_recompute = True
+    st.recompute_granularity = "full_block"
+    st.recompute_layer_num = 2
+    st.__post_init__()
+    scorer = BatchedScorer(model, system)
+    kern = scorer.kernel_for(st)
+    per_dp = spec["gbs"] // st.dp_size
+    splits = [(m, per_dp // m) for m in range(1, per_dp + 1)
+              if per_dp % m == 0]
+    mbs = [splits[i % len(splits)][0] for i in range(n_cands)]
+    mbc = [splits[i % len(splits)][1] for i in range(n_cands)]
+    nrc = [i % 5 for i in range(n_cands)]
+
+    def timed(backend):
+        t0 = time.perf_counter()
+        kern.score(mbs, mbc, nrc=nrc, backend=backend)
+        return n_cands / (time.perf_counter() - t0)
+
+    kern.score(mbs[:8], mbc[:8], nrc=nrc[:8], backend="numpy")  # warm
+    np_cps = timed("numpy")
+    jit_cps = None
+    if jax_available():
+        timed("jax")  # compile warmup — amortized across real sweeps
+        jit_cps = timed("jax")
+    return {"numpy_cands_per_sec": np_cps,
+            "jit_cands_per_sec": jit_cps}
 
 
 def main(argv=None):
@@ -137,6 +187,24 @@ def main(argv=None):
              "scalar oracle (default: topk = 5); recorded in the JSON",
     )
     ap.add_argument("--no-prune", action="store_true")
+    ap.add_argument(
+        "--kernel-bench", type=int, default=0, metavar="N",
+        help="with --engine batched: also measure raw kernel scoring "
+             "throughput on an N-candidate batch per backend "
+             "(numpy + jitted jax)",
+    )
+    ap.add_argument(
+        "--min-kernel-speedup", type=float, default=10.0, metavar="X",
+        help="with --kernel-bench and --baseline: fail (exit 1) when "
+             "the jitted kernel's candidates/s is below X times the "
+             "baseline sweep's candidates/s (default 10)",
+    )
+    ap.add_argument(
+        "--max-fallback-cells", type=int, default=None, metavar="N",
+        help="with --engine batched: fail (exit 1) when more than N "
+             "cells fell back to the scalar path (0 = the zero-"
+             "fallback coverage gate on the wide grid)",
+    )
     ap.add_argument(
         "--baseline", metavar="JSON",
         help="previously saved bench JSON line to gate against "
@@ -176,9 +244,12 @@ def main(argv=None):
     }
     if args.engine == "batched":
         # the batched engine's contract: how many cells rode the
-        # kernel (vs scalar fallback), the largest candidate batch one
-        # kernel call scored, and the scalar-verified row count
+        # kernel (vs scalar fallback, with the reason histogram), the
+        # largest candidate batch one kernel call scored, and the
+        # scalar-verified row count
         result["batched_cells"] = measured["batched_cells"]
+        result["fallback_cells"] = measured["fallback_cells"]
+        result["batched_fallbacks"] = measured["batched_fallbacks"]
         result["max_score_batch"] = measured["max_score_batch"]
         result["candidates_scored"] = measured["candidates_scored"]
         result["verify_topk"] = (
@@ -205,6 +276,20 @@ def main(argv=None):
         ]
         result["topk_matches_serial"] = same
     ok = True
+    if args.max_fallback_cells is not None and args.engine == "batched":
+        fb_ok = measured["fallback_cells"] <= args.max_fallback_cells
+        result["fallback_ok"] = fb_ok
+        ok = ok and fb_ok
+    kernel = None
+    if args.kernel_bench and args.engine == "batched":
+        kernel = run_kernel_bench(spec, args.kernel_bench)
+        result["kernel_bench_candidates"] = args.kernel_bench
+        result["kernel_numpy_cands_per_sec"] = round(
+            kernel["numpy_cands_per_sec"], 1)
+        result["kernel_jit_cands_per_sec"] = (
+            round(kernel["jit_cands_per_sec"], 1)
+            if kernel["jit_cands_per_sec"] is not None else None
+        )
     if args.baseline:
         with open(args.baseline) as f:
             base = json.load(f)
@@ -243,8 +328,41 @@ def main(argv=None):
             round(1.0 - measured["cells_per_sec"] / base["value"], 4)
             if base["value"] else 0.0
         )
-        ok = measured["cells_per_sec"] >= floor
-        result["regression_ok"] = ok
+        reg_ok = measured["cells_per_sec"] >= floor
+        result["regression_ok"] = reg_ok
+        ok = ok and reg_ok
+        # jitted-kernel throughput gate: the raw candidates/s of the
+        # jax fold must beat the recorded sweep's candidates/s by
+        # --min-kernel-speedup (the PR-11 >= 10x acceptance gate).
+        # A gate that was REQUESTED but cannot run fails loudly —
+        # never a silent skip (a broken jax import must not make the
+        # acceptance criterion pass vacuously)
+        if kernel is not None:
+            if kernel["jit_cands_per_sec"] is None:
+                print(json.dumps({
+                    "error": "--kernel-bench was requested but the jax "
+                             "backend is unavailable (import failed): "
+                             "the --min-kernel-speedup gate cannot "
+                             "run — fix the jax install or drop "
+                             "--kernel-bench",
+                }))
+                return 2
+            if not (base.get("candidates_scored")
+                    and base.get("elapsed_s")):
+                print(json.dumps({
+                    "error": f"baseline {args.baseline} lacks "
+                             f"candidates_scored/elapsed_s; re-record "
+                             f"it with a plain --engine batched run to "
+                             f"use the --min-kernel-speedup gate",
+                }))
+                return 2
+            base_cps = base["candidates_scored"] / base["elapsed_s"]
+            speedup = kernel["jit_cands_per_sec"] / base_cps
+            result["baseline_cands_per_sec"] = round(base_cps, 1)
+            result["kernel_jit_speedup"] = round(speedup, 2)
+            k_ok = speedup >= args.min_kernel_speedup
+            result["kernel_speedup_ok"] = k_ok
+            ok = ok and k_ok
     print(json.dumps(result))
     return 0 if ok else 1
 

@@ -372,6 +372,7 @@ def _run_search(args, diag):
             engine=args.engine,
             verify_topk=args.verify_topk,
             store=store,
+            search_mode="guided" if args.guided else "grid",
         )
     if store is not None and args.engine == "batched":
         from simumax_tpu.service.planner import save_batched_profiles
@@ -1320,6 +1321,15 @@ def main(argv=None):
         "--verify-topk", type=int, default=None, metavar="K",
         help="with --engine batched: how many ranked rows to re-verify "
              "with the scalar oracle (default: --topk)",
+    )
+    ps.add_argument(
+        "--guided", action="store_true",
+        help="Pareto-guided search: screen every cell with one cheap "
+             "batched-kernel score, fully evaluate only the "
+             "(iter_time, peak_mem, comm_fraction) frontier and its "
+             "local neighborhoods, refining around the top-k — "
+             "skipped cells appear as status=screened CSV rows "
+             "(see docs/search.md)",
     )
     ps.add_argument(
         "--simulate-check", action="store_true",

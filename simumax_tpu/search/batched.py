@@ -21,21 +21,31 @@ programs whose leading axis is the candidate batch:
   candidate;
 * activation-peak replay — ``LLMModel.activation_events`` mirrored per
   *block kind* (plain / recomputed x dense / MoE) and composed across a
-  stage's layer runs in closed form instead of walking every layer;
-* the 1F1B pipeline replay — evaluated with a lean exact re-implementation
-  of ``PerfLLM.calculate_1f1b_bubble``'s recurrence (the replay's values
-  are order-independent max/+ algebra, so the lean loop reproduces them
-  bit-for-bit).
+  stage's layer runs in closed form instead of walking every layer; at
+  vp>1 the per-chunk compositions feed the SAME interleaved-order
+  schedule-position replay the scalar path folds
+  (``perf.interleaved_stage_peak``);
+* the pipeline replays — evaluated with lean exact re-implementations
+  of ``PerfLLM.calculate_1f1b_bubble`` / ``calculate_interleaved_
+  schedule``'s recurrences (:func:`fold_1f1b` / :func:`fold_interleaved`
+  — the replays' values are order-independent max/+ algebra, so one
+  pass over a cached topological order reproduces them bit-for-bit),
+  optionally lowered to a vmapped ``jax.lax.scan`` under ``jax.jit``
+  (CPU, x64) that is **bit-identical** to the numpy fold — the numpy
+  path remains the no-JAX fallback.
 
 The scalar path stays the **oracle**: the sweep's ``engine="batched"``
 mode re-verifies its top-k rows with ``evaluate_strategy`` (see
 ``searcher.py``), and ``tests/test_batched.py`` pins batched == scalar
 within 1e-9 for every non-pruned candidate across the
-dense/MoE/MLA x pp x recompute/ZeRO parity grid.
+dense/MoE/MLA x pp/vp x cp x fp8/dropout/dispatch_probs/offload x
+recompute-family/variance x ZeRO parity grid.
 
-Configurations outside the supported surface raise
-:class:`UnsupportedBatched` and the caller silently falls back to the
-scalar path per cell (documented in ``docs/search.md``).
+Since PR 11 the kernel lowers every strategy family the sweep axes can
+produce; the tiny residual surface of :func:`check_supported` raises
+:class:`UnsupportedBatched` and the caller falls back to the scalar
+path per cell with counted telemetry (documented in ``docs/search.md``
+"Fallback contract").
 """
 
 from __future__ import annotations
@@ -56,7 +66,10 @@ from simumax_tpu.core.errors import FeasibilityError
 from simumax_tpu.core.module import GemmBase
 from simumax_tpu.models.dense import CoreAttention
 from simumax_tpu.models.moe import GroupLinearBase
-from simumax_tpu.parallel.pipeline import one_f_one_b_order
+from simumax_tpu.parallel.pipeline import (
+    interleaved_order,
+    one_f_one_b_order,
+)
 from simumax_tpu.perf import place_strategy_paths, stage_layer_split
 from simumax_tpu.search.prune import clone_strategy
 
@@ -73,33 +86,34 @@ class UnsupportedBatched(Exception):
 
 def check_supported(st: StrategyConfig, model: ModelConfig,
                     system: SystemConfig) -> None:
-    """Raise :class:`UnsupportedBatched` for strategy/model features the
-    kernel does not lower. The list is the documented fallback contract
-    (docs/search.md): anything here silently uses the scalar path."""
+    """Raise :class:`UnsupportedBatched` for the few residual
+    configurations the kernel routes back to the scalar oracle. Since
+    PR 11 every strategy family (vp>1, cp>1, fp8, dropout,
+    dispatch_probs, offload, moe_act/mla_up recompute, variance tails,
+    pallas sdp, DP-comm overlap) is lowered; what remains — each
+    justified in docs/search.md — is:
+
+    * unknown recompute granularities (a new granularity must be lowered
+      deliberately, not silently treated as one of the known three);
+    * unknown model/attention types (same reasoning — the model universe
+      is part of the lowering contract);
+    * swiglu fan shapes the scalar walk rejects with an
+      ``AssertionError``: the fallback makes both engines quarantine the
+      cell identically instead of the kernel scoring an impossible
+      shape.
+    """
     rc = st.recompute
 
     def need(cond: bool, what: str):
         if not cond:
             raise UnsupportedBatched(what)
 
-    need(st.vp_size == 1, "interleaved pipeline (vp > 1)")
-    need(st.cp_size == 1, "context parallelism (cp > 1)")
-    need(not st.fp8, "quantized matmul path (fp8)")
-    need(not st.enable_dropout, "dropout modeling")
-    need(st.sdp_backend == "xla", "non-xla sdp backend")
-    need(not st.overlap_grad_reduce and not st.overlap_param_gather,
-         "grad-reduce/param-gather overlap modeling")
-    need(not st.dispatch_probs, "dispatch_probs combine fusion")
-    need(not st.offload_groupgemm_col_inputs,
-         "groupgemm input host offload")
-    need(not rc.moe_act_recompute and not rc.mla_up_proj_recompute,
-         "moe_act/mla_up_proj module recompute")
-    need(not rc.variance and not rc.tail_modules,
-         "recompute variance-tail model")
     need(rc.granularity in ("none", "selective", "full_block"),
          f"recompute granularity {rc.granularity!r}")
-    need(model.model_type in ("dense", "moe"), model.model_type)
-    need(model.attention_type in ("gqa", "mla"), model.attention_type)
+    need(model.model_type in ("dense", "moe"),
+         f"model type {model.model_type!r}")
+    need(model.attention_type in ("gqa", "mla"),
+         f"attention type {model.attention_type!r}")
     # shapes the scalar walk would reject with an AssertionError
     # (quarantined cell): fall back so both engines quarantine alike
     if model.use_swiglu:
@@ -131,6 +145,7 @@ def _family_invalid_reason(st: StrategyConfig, model: ModelConfig,
     ``_cross_sanity_check``): a non-None reason means every batch split
     of this family evaluates to ``row = None`` in the scalar path."""
     m = model
+    rc = st.recompute
     if st.world_size <= 0:
         return "world_size"
     if st.world_size % (st.tp_size * st.cp_size * st.pp_size):
@@ -147,10 +162,61 @@ def _family_invalid_reason(st: StrategyConfig, model: ModelConfig,
     if st.world_size > system.total_chips:
         return "world > chips"
     head_shard = st.tp_size
+    if st.cp_size > 1 and st.cp_comm_type == "a2a":
+        head_shard *= st.cp_size  # Ulysses scatters heads over cp too
     if m.head_num % head_shard:
-        return "head_num % tp"
+        return "head_num % tp*cp"
+    if st.cp_size > 1 and st.cp_comm_type == "a2a" \
+            and m.attention_type != "mla":
+        # ContextParallelA2A._replication of the kv heads
+        kvl = max(m.kv_head_num // st.tp_size, 1)
+        if kvl >= st.cp_size:
+            if kvl % st.cp_size:
+                return "kv heads % cp"
+        elif st.cp_size % kvl:
+            return "cp % kv heads"
     if m.model_type == "moe" and m.expert_num % st.ep_size:
         return "expert_num % ep"
+    # candidate-dependent ConfigError guards of sanity_check /
+    # _cross_sanity_check the sweep axes can reach
+    if st.vp_size > 1:
+        if st.pp_size <= 1:
+            return "vpp needs pp > 1"
+        if st.vpp_group_size < st.pp_size:
+            return "vpp group < pp"
+    if st.use_math_sdp and st.use_flash_sdp:
+        return "math+flash sdp"
+    if st.dispatch_probs and m.model_type == "moe" and not m.use_swiglu:
+        return "dispatch_probs needs swiglu"
+    if rc.mla_up_proj_recompute and m.attention_type != "mla":
+        return "mla_up recompute on non-mla"
+    if rc.moe_act_recompute and m.model_type != "moe":
+        return "moe_act recompute on non-moe"
+    if st.offload_groupgemm_col_inputs and st.enable_recompute \
+            and st.recompute_granularity in ("full_block",
+                                             "full_recompute"):
+        return "offload + full_block recompute"
+    if st.fp8:
+        needed = [f"{st.quant_dtype}_matmul"]
+        if m.model_type == "moe" and st.group_linear_mode == "parallel":
+            needed.append(f"{st.quant_dtype}_group_matmul")
+        for op_key in needed:
+            if op_key not in system.accelerator.op:
+                return f"no {op_key} table"
+    if st.sdp_backend == "pallas":
+        if not st.use_flash_sdp:
+            return "pallas needs flash sdp"
+        from simumax_tpu.core.utils import pallas_attention_supported
+
+        if st.cp_size > 1 and st.cp_comm_type == "all_gather":
+            sq_attn, skv_attn = st.seq_len // st.cp_size, st.seq_len
+        else:
+            sq_attn = skv_attn = st.seq_len
+        if not pallas_attention_supported(sq_attn, skv_attn,
+                                          m.head_size):
+            return "pallas shape unsupported"
+    if st.mesh_order != "tp,cp,dp,pp" and st.ep_size != 1:
+        return "mesh_order + ep"
     # layer split over virtual stages (PerfBase._cross_sanity_check)
     total_stages = st.pp_size * st.vp_size
     layers = m.layer_num
@@ -261,6 +327,191 @@ def fold_1f1b(pp: int, mbc: int, fwd: Sequence[float],
     return max(clock), clock
 
 
+_IORDER_CACHE: Dict[Tuple[int, int, int, int], list] = {}
+
+
+def _flat_interleaved_order(pp: int, mbc: int, vp: int,
+                            group: int) -> list:
+    """One dependency-consistent flat op order for the interleaved
+    (VPP) replay, computed once per (pp, mbc, vp, group) and cached —
+    the interleaved analog of :func:`_flat_1f1b_order`."""
+    key = (pp, mbc, vp, group)
+    flat = _IORDER_CACHE.get(key)
+    if flat is not None:
+        return flat
+    orders = [interleaved_order(pp, s, mbc, vp, group)
+              for s in range(pp)]
+    doneF, doneB = set(), set()
+    idx = [0] * pp
+    flat = []
+    remaining = sum(len(o) for o in orders)
+    while remaining:
+        progressed = False
+        for s in range(pp):
+            o = orders[s]
+            while idx[s] < len(o):
+                kind, c, mb = o[idx[s]]
+                if kind == "F":
+                    if s > 0 and (s - 1, c, mb) not in doneF:
+                        break
+                    if s == 0 and c > 0 \
+                            and (pp - 1, c - 1, mb) not in doneF:
+                        break
+                    doneF.add((s, c, mb))
+                    flat.append((s, 0, c, mb))
+                else:
+                    if s < pp - 1 and (s + 1, c, mb) not in doneB:
+                        break
+                    if s == pp - 1 and c < vp - 1 \
+                            and (0, c + 1, mb) not in doneB:
+                        break
+                    doneB.add((s, c, mb))
+                    flat.append((s, 1, c, mb))
+                idx[s] += 1
+                remaining -= 1
+                progressed = True
+        assert progressed, \
+            "interleaved schedule deadlocked (internal error)"
+    if len(_IORDER_CACHE) > 64:
+        _IORDER_CACHE.clear()
+    _IORDER_CACHE[key] = flat
+    return flat
+
+
+def fold_interleaved(pp: int, vp: int, mbc: int, group: int,
+                     fwd, bwd, p2p: float,
+                     p2p_async: bool) -> Tuple[float, List[float]]:
+    """Exact lean re-implementation of the interleaved replay in
+    ``PerfLLM.calculate_interleaved_schedule``: ``fwd``/``bwd`` are
+    per-``[stage][chunk]`` times; returns ``(total, per_stage_end)``.
+    Like :func:`fold_1f1b`, the replay's values solve a max-plus
+    recurrence, so one pass over a cached topological order reproduces
+    the scalar numbers bit-for-bit (fuzz-tested in
+    ``tests/test_batched.py``)."""
+    flat = _flat_interleaved_order(pp, mbc, vp, group)
+    F: Dict[tuple, float] = {}
+    B: Dict[tuple, float] = {}
+    clock = [0.0] * pp
+    blocking = 0.0 if p2p_async else p2p
+    last = pp - 1
+    for s, kind, c, mb in flat:
+        cl = clock[s]
+        if kind == 0:
+            if s > 0:
+                dep = F[(s - 1, c, mb)] + p2p
+            elif c > 0:
+                dep = F[(last, c - 1, mb)] + p2p
+            else:
+                dep = 0.0
+            start = cl if cl >= dep else dep
+            end = start + fwd[s][c]
+            F[(s, c, mb)] = end
+            if s < last or c < vp - 1:
+                end += blocking
+        else:
+            if s < last:
+                dep = B[(s + 1, c, mb)] + p2p
+            elif c < vp - 1:
+                dep = B[(0, c + 1, mb)] + p2p
+            else:
+                dep = 0.0  # loss chunk: ready after own fwd
+            start = cl if cl >= dep else dep
+            end = start + bwd[s][c]
+            B[(s, c, mb)] = end
+            if s > 0 or c > 0:
+                end += blocking
+        clock[s] = end
+    return max(clock), clock
+
+
+# --------------------------------------------------------------------------
+# JIT backend: the 1F1B fold lowered to a vmapped jax.lax.scan
+# --------------------------------------------------------------------------
+
+#: compiled fold cache, keyed (pp, mbc) — shapes recur across a sweep's
+#: layouts, so each compile amortizes over every family sharing them
+_FOLD_JIT_CACHE: Dict[Tuple[int, int], object] = {}
+
+#: minimum candidate-group size for backend="auto" to dispatch the
+#: jitted fold: below it the XLA dispatch overhead beats the win and
+#: the numpy fold (bit-identical — tested) stays faster
+JIT_GROUP_MIN = 256
+
+_JAX = None
+
+
+def jax_available() -> bool:
+    """Whether the jax backend can be used (import guarded: the numpy
+    execution path remains the no-JAX fallback, so CPU-only machines
+    without jax keep the full engine)."""
+    global _JAX
+    if _JAX is None:
+        try:
+            import jax
+            import jax.numpy
+
+            _JAX = jax.numpy is not None
+        except Exception:
+            _JAX = False
+    return _JAX
+
+
+def _jit_fold_1f1b(pp: int, mbc: int):
+    """Build (or fetch) the jitted vmapped 1F1B fold for one (pp, mbc)
+    shape: a ``jax.lax.scan`` over the cached flat op order, vmapped
+    over the candidate axis. Performs exactly the float-op sequence of
+    :func:`fold_1f1b`, so with x64 enabled the results are
+    bit-identical to the numpy fold (pinned in tests/test_batched.py).
+
+    Must be called (traced AND executed) inside
+    ``jax.experimental.enable_x64()``."""
+    got = _FOLD_JIT_CACHE.get((pp, mbc))
+    if got is not None:
+        return got
+    import jax
+    import jax.numpy as jnp
+
+    flat = _flat_1f1b_order(pp, mbc)
+    s_arr = jnp.array([f[0] for f in flat], dtype=jnp.int32)
+    k_arr = jnp.array([f[1] for f in flat], dtype=jnp.int32)
+    i_arr = jnp.array([f[2] for f in flat], dtype=jnp.int32)
+    last = pp - 1
+
+    def fold_one(fwd, bwd, p2p, blocking):
+        F0 = jnp.zeros((pp, mbc), dtype=jnp.float64)
+        B0 = jnp.zeros((pp, mbc), dtype=jnp.float64)
+        clock0 = jnp.zeros((pp,), dtype=jnp.float64)
+
+        def step(carry, op):
+            clock, F, B = carry
+            s, kind, i = op
+            c = clock[s]
+            depF = jnp.where(s > 0, F[(s - 1) % pp, i] + p2p, c)
+            startF = jnp.maximum(c, depF)
+            endF0 = startF + fwd[s]
+            depB = jnp.where(s < last, B[(s + 1) % pp, i] + p2p, c)
+            startB = jnp.maximum(c, depB)
+            endB0 = startB + bwd[s]
+            isF = kind == 0
+            F = F.at[s, i].set(jnp.where(isF, endF0, F[s, i]))
+            B = B.at[s, i].set(jnp.where(isF, B[s, i], endB0))
+            endF = endF0 + jnp.where(s < last, blocking, 0.0)
+            endB = endB0 + jnp.where(s > 0, blocking, 0.0)
+            clock = clock.at[s].set(jnp.where(isF, endF, endB))
+            return (clock, F, B), None
+
+        (clock, _, _), _ = jax.lax.scan(
+            step, (clock0, F0, B0), (s_arr, k_arr, i_arr))
+        return jnp.max(clock), clock
+
+    fn = jax.jit(
+        jax.vmap(fold_one, in_axes=(1, 1, 0, 0), out_axes=(0, 1)))
+    if len(_FOLD_JIT_CACHE) > 256:
+        _FOLD_JIT_CACHE.clear()
+    _FOLD_JIT_CACHE[(pp, mbc)] = fn
+    return fn
+
+
 # --------------------------------------------------------------------------
 # Leaf records
 # --------------------------------------------------------------------------
@@ -273,8 +524,9 @@ class _Leaf:
         "name", "flops", "accessed", "op_key", "key_fn", "bw_key",
         "cache_raw", "cache_eff", "fwd_temp", "bwd_temp", "in_b", "out_b",
         "numel", "moe", "coll", "rc", "seg", "variance_tail",
+        "is_core", "is_cp",
         "cost_fwd", "cost_bwd_act", "cost_bwd_w",
-        "net_fwd", "net_bwd_act", "net_bwd_w", "fsdp",
+        "net_fwd", "net_bwd_act", "net_bwd_w", "fsdp", "cp_hidden",
     )
 
     def __init__(self, name):
@@ -297,6 +549,10 @@ class _Leaf:
         self.rc = False
         self.seg = None
         self.variance_tail = False
+        #: the block's CoreAttention (async-CP overlap budget anchor)
+        self.is_core = False
+        #: a ContextParallelA2A mirror (async-CP hiding candidate)
+        self.is_cp = False
 
 
 class _Kernel:
@@ -340,13 +596,14 @@ class _Kernel:
     #: separately in normalized form), and at ZeRO >= 2 the
     #: data-parallel group sizes are appended explicitly.
     _KIND_FIELDS = (
-        "seq_len", "dtype", "quant_dtype", "tp_size", "cp_size",
+        "seq_len", "dtype", "fp8", "quant_dtype", "tp_size", "cp_size",
         "ep_size", "etp_size", "moe_capacity_factor",
         "group_linear_mode", "enable_sequence_parallel", "cp_comm_type",
         "cp_a2a_mode", "zero_state", "use_fused_norm", "use_math_sdp",
         "use_flash_sdp", "sdp_backend", "use_fused_ce",
         "use_fp32_accum_grad", "optimizer_style",
-        "attention_sparse_ratio", "mesh_order",
+        "attention_sparse_ratio", "mesh_order", "enable_dropout",
+        "dispatch_probs", "offload_groupgemm_col_inputs",
     )
 
     def _kind_key(self, tag, ub: tuple, wiring) -> tuple:
@@ -484,16 +741,20 @@ class _Kernel:
 
     def _linear(self, name, rows_in, k, n, numel, *,
                 sp_comm: bool, col: bool, moe_param=False,
-                count_params=True):
+                count_params=True, quantized=False):
         """Shared LinearCol/LinearRow lowering.
 
         ``rows_in`` — the GEMM rows m (already gathered for col layers
         under SP); ``k``/``n`` the local contraction/output dims;
         ``sp_comm`` — the layer issues the SP/TP collectives; ``col`` —
-        column-parallel (AG-in) vs row-parallel (RS-out)."""
+        column-parallel (AG-in) vs row-parallel (RS-out); ``quantized``
+        — the leaf rides the low-precision MXU path under ``st.fp8``
+        (mirror of ``GemmBase``: the quant op table plus the
+        input-quantization cast traffic per phase)."""
         st = self.st
         e = st.element_size
         g = st.grad_element_size
+        quant = quantized and st.fp8
         lf = _Leaf(name)
         m = rows_in
         f = 2.0 * m * k * n
@@ -501,8 +762,17 @@ class _Kernel:
         io = (m * k + k * n + m * n) * e
         wextra = k * n * (g - e)
         lf.accessed = {"fwd": io, "bwd_act": io, "bwd_w": io + wextra}
+        if quant:
+            # GemmBase.quant_cast_bytes: read the bf16 GEMM input +
+            # write its 1-byte copy, per phase's own (m, k)
+            lf.accessed = {
+                "fwd": lf.accessed["fwd"] + m * k * (e + 1.0),
+                "bwd_act": lf.accessed["bwd_act"] + m * n * (e + 1.0),
+                "bwd_w": lf.accessed["bwd_w"] + k * m * (e + 1.0),
+            }
+        op_key = f"{st.quant_dtype}_matmul" if quant else "matmul"
         for ph in ("fwd", "bwd_act", "bwd_w"):
-            lf.op_key[ph] = "matmul"
+            lf.op_key[ph] = op_key
             lf.key_fn[ph] = self._gemm_keyfn(ph, rows_in, k, n)
         pn = numel if count_params else 0.0
         lf.numel = pn
@@ -558,6 +828,52 @@ class _Kernel:
         lf.out_b = nb
         return lf
 
+    def _dropout(self, name, nb):
+        """Mirror of ``models.dense.Dropout``: memory-bound elementwise
+        with a cached 1-byte mask per element."""
+        lf = _Leaf(name)
+        numel = nb / self.st.element_size
+        lf.accessed = {"fwd": 2 * nb + numel, "bwd_act": 2 * nb + numel}
+        lf.op_key = {"fwd": "default", "bwd_act": "default"}
+        lf.cache_raw = numel
+        lf.in_b = nb
+        lf.out_b = nb
+        return lf
+
+    def _cp_a2a(self, name, in_bytes, r=1.0):
+        """Mirror of ``ContextParallelA2A``: one Ulysses re-shard stage.
+        ``r`` is the kv-head replication factor (scatter_heads with
+        fewer kv heads than cp ranks); the collective moves the full
+        logical tensor (per-chip bytes x r x cp) and the re-sharded
+        copy is a forward transient."""
+        st = self.st
+        lf = _Leaf(name)
+        lf.is_cp = True
+        exposed = st.cp_a2a_mode == "sync_cp"
+        nbytes = in_bytes * r * st.cp_size
+        lf.coll = [("fwd", "all2all", "cp", nbytes, exposed, False),
+                   ("bwd_act", "all2all", "cp", nbytes, exposed, False)]
+        lf.fwd_temp = in_bytes * r
+        lf.in_b = in_bytes
+        lf.out_b = in_bytes * r
+        return lf
+
+    def _kv_allgather(self, name, in_bytes):
+        """Mirror of ``KVAllGather`` (cp=all_gather ring family): fwd
+        all-gather of k/v over cp, bwd reduce-scatter of the grad; the
+        gathered copy stays live through the attention backward."""
+        st = self.st
+        lf = _Leaf(name)
+        full = in_bytes * st.cp_size
+        lf.coll = [("fwd", "all_gather", "cp", full, True, False),
+                   ("bwd_act", "reduce_scatter", "cp", full, True,
+                    False)]
+        lf.fwd_temp = full
+        lf.bwd_temp = full
+        lf.in_b = in_bytes
+        lf.out_b = full
+        return lf
+
     # -- block kinds -------------------------------------------------------
     def _attention_leaves(self, b: int) -> List[_Leaf]:
         st, m = self.st, self.model
@@ -573,6 +889,9 @@ class _Kernel:
             out += self._mla_leaves(b)
             return out
         hd = m.head_size
+        cp = st.cp_size
+        a2a = cp > 1 and st.cp_comm_type == "a2a"
+        allg = cp > 1 and st.cp_comm_type == "all_gather"
         q_out = m.head_num * hd
         kv_out = m.kv_head_num * hd
         qkv_out = q_out + 2 * kv_out
@@ -580,7 +899,7 @@ class _Kernel:
         rows = b * s_out
         qkv = self._linear("qkv_proj", rows, m.hidden_size,
                            out_local, float(m.hidden_size * out_local),
-                           sp_comm=True, col=True)
+                           sp_comm=True, col=True, quantized=True)
         qkv.cache_raw = A
         if sp and tp > 1:
             qkv.fwd_temp = qkv.fwd_temp + A * tp
@@ -600,23 +919,39 @@ class _Kernel:
         rope.out_b = qb + kb
         out.append(rope)
 
-        out.append(self._core_leaf(b, s_out, hl, kvl, hd, hd))
+        if a2a:
+            r = 1 if kvl >= cp else cp // kvl
+            out.append(self._cp_a2a("cp_a2a_q", qb))
+            out.append(self._cp_a2a("cp_a2a_k", kb, r))
+            out.append(self._cp_a2a("cp_a2a_v", kb, r))
+            out.append(self._core_leaf(b, s_out * cp, s_out * cp,
+                                       hl // cp, (kvl * r) // cp, hd,
+                                       hd))
+            out.append(self._cp_a2a("cp_a2a_o", qb))
+        elif allg:
+            out.append(self._kv_allgather("kv_allgather_k", kb))
+            out.append(self._kv_allgather("kv_allgather_v", kb))
+            out.append(self._core_leaf(b, s_out, s_out * cp, hl, kvl,
+                                       hd, hd))
+        else:
+            out.append(self._core_leaf(b, s_out, s_out, hl, kvl, hd,
+                                       hd))
 
         in_local = q_out // tp
         op = self._linear("out_proj", rows, in_local,
                           m.hidden_size, float(in_local * m.hidden_size),
-                          sp_comm=True, col=False)
+                          sp_comm=True, col=False, quantized=True)
         op.cache_raw = rows * in_local * e
         op.in_b = rows * in_local * e
         op.out_b = A
         out.append(op)
         return out
 
-    def _core_leaf(self, b, s_out, hl, kvl, d, dv) -> _Leaf:
+    def _core_leaf(self, b, sq, skv, hl, kvl, d, dv) -> _Leaf:
         st, m = self.st, self.model
         e = st.element_size
         lf = _Leaf("core_attention")
-        sq = skv = s_out
+        lf.is_core = True
         causal = bool(m.use_causal_attention)
         sparse = st.attention_sparse_ratio if causal else 0.0
         qk = 2.0 * b * hl * sq * skv * d
@@ -686,7 +1021,7 @@ class _Kernel:
             qu = self._linear("q_up", rows_out, m.q_lora_rank,
                               q_out // tp, float(m.q_lora_rank
                                                  * (q_out // tp)),
-                              sp_comm=True, col=True)
+                              sp_comm=True, col=True, quantized=True)
             qu.cache_raw = rows_sp * m.q_lora_rank * e
             if sp and tp > 1:
                 qu.fwd_temp = qu.fwd_temp + qu.cache_raw * tp
@@ -697,7 +1032,7 @@ class _Kernel:
         else:
             qp = self._linear("q_proj", rows_out, h,
                               q_out // tp, float(h * (q_out // tp)),
-                              sp_comm=True, col=True)
+                              sp_comm=True, col=True, quantized=True)
             qp.cache_raw = A
             if sp and tp > 1:
                 qp.fwd_temp = qp.fwd_temp + A * tp
@@ -719,7 +1054,7 @@ class _Kernel:
         kvu = self._linear("kv_up", rows_out, m.kv_lora_rank,
                            kvu_out // tp,
                            float(m.kv_lora_rank * (kvu_out // tp)),
-                           sp_comm=True, col=True)
+                           sp_comm=True, col=True, quantized=True)
         kvu.cache_raw = rows_sp * m.kv_lora_rank * e
         if sp and tp > 1:
             kvu.fwd_temp = kvu.fwd_temp + kvu.cache_raw * tp
@@ -746,12 +1081,28 @@ class _Kernel:
         rope.in_b = qb + kb
         rope.out_b = qb + kb
         out.append(rope)
-        out.append(self._core_leaf(b, s_out, hl, hl, qk_dim,
-                                   m.v_head_dim))
+        cp = st.cp_size
+        vb = b * s_out * hl * m.v_head_dim * e
+        if cp > 1 and st.cp_comm_type == "a2a":
+            out.append(self._cp_a2a("cp_a2a_q", qb))
+            out.append(self._cp_a2a("cp_a2a_k", kb))
+            out.append(self._cp_a2a("cp_a2a_v", vb))
+            out.append(self._core_leaf(b, s_out * cp, s_out * cp,
+                                       hl // cp, hl // cp, qk_dim,
+                                       m.v_head_dim))
+            out.append(self._cp_a2a("cp_a2a_o", vb))
+        elif cp > 1 and st.cp_comm_type == "all_gather":
+            out.append(self._kv_allgather("kv_allgather_k", kb))
+            out.append(self._kv_allgather("kv_allgather_v", vb))
+            out.append(self._core_leaf(b, s_out, s_out * cp, hl, hl,
+                                       qk_dim, m.v_head_dim))
+        else:
+            out.append(self._core_leaf(b, s_out, s_out, hl, hl, qk_dim,
+                                       m.v_head_dim))
         in_feats = m.head_num * m.v_head_dim
         op = self._linear("out_proj", rows_out,
                           in_feats // tp, h, float((in_feats // tp) * h),
-                          sp_comm=True, col=False)
+                          sp_comm=True, col=False, quantized=True)
         op.cache_raw = rows_out * (in_feats // tp) * e
         op.in_b = rows_out * (in_feats // tp) * e
         op.out_b = A
@@ -773,7 +1124,7 @@ class _Kernel:
         rows = b * s_out
         up = self._linear(prefix + "up_proj", rows, h,
                           fan // tp, float(h * (fan // tp)),
-                          sp_comm=True, col=True)
+                          sp_comm=True, col=True, quantized=True)
         up.cache_raw = A
         if sp and tp > 1:
             up.fwd_temp = up.fwd_temp + A * tp
@@ -794,7 +1145,7 @@ class _Kernel:
         act.out_b = o_b
         down = self._linear(prefix + "down_proj", rows,
                             f // tp, h, float((f // tp) * h),
-                            sp_comm=True, col=False)
+                            sp_comm=True, col=False, quantized=True)
         down.cache_raw = rows * (f // tp) * e
         down.in_b = rows * (f // tp) * e
         down.out_b = A
@@ -854,6 +1205,13 @@ class _Kernel:
             disp.coll.append(("fwd", "all2all", "ep", full, True, False))
             disp.coll.append(("bwd_act", "all2all", "ep", full, True,
                               False))
+            if st.dispatch_probs:
+                # router probs ride their own a2a to the experts
+                probs_full = b * s_sp * m.topk * 4.0 * st.ep_size
+                disp.coll.append(("fwd", "all2all", "ep", probs_full,
+                                  True, False))
+                disp.coll.append(("bwd_act", "all2all", "ep",
+                                  probs_full, True, False))
         out.append(disp)
 
         fan = 2 * m.moe_ffn_hidden_size if m.use_swiglu \
@@ -862,14 +1220,20 @@ class _Kernel:
                                       h, fan // etp, ng))
         act = _Leaf("expert_swiglu" if m.use_swiglu else "expert_gelu")
         i_b = t1 * (fan // etp) * e
+        weighted = st.dispatch_probs and m.use_swiglu
+        # dispatch_probs fuses the prob weighting into the expert
+        # activation (weighted-SiLU): one fp32 prob per routed token
+        # read each phase and cached for the dL/dprob term
+        probs_b = t1 * 4.0 if weighted else 0.0
         if m.use_swiglu:
             o_b = t1 * (((fan // etp)) // 2) * e
-            act.accessed = {"fwd": i_b + o_b, "bwd_act": 2 * i_b + o_b}
+            act.accessed = {"fwd": i_b + o_b + probs_b,
+                            "bwd_act": 2 * i_b + o_b + probs_b}
         else:
             o_b = i_b
             act.accessed = {"fwd": 2 * i_b, "bwd_act": 3 * i_b}
         act.op_key = {"fwd": "default", "bwd_act": "default"}
-        act.cache_raw = i_b
+        act.cache_raw = i_b + probs_b
         act.in_b = i_b
         act.out_b = o_b
         out.append(act)
@@ -880,7 +1244,13 @@ class _Kernel:
         comb.accessed = {"fwd": in_b + A, "bwd_act": in_b + A}
         comb.op_key = {"fwd": "default", "bwd_act": "default"}
         comb.bw_key = {"fwd": "permute_fwd", "bwd_act": "permute_bwd"}
-        comb.cache_raw = in_b
+        if st.dispatch_probs:
+            # weighting already happened in the expert activation: the
+            # combine is a pure layout op — nothing cached, just the
+            # in/out copies live at once
+            comb.fwd_temp = max(in_b, A)
+        else:
+            comb.cache_raw = in_b
         comb.in_b = in_b
         comb.out_b = A
         pre = in_b
@@ -914,14 +1284,25 @@ class _Kernel:
         st = self.st
         e = st.element_size
         g = st.grad_element_size
+        quant = st.fp8
         lf = _Leaf(name)
         f = 2.0 * t1 * k * n
         lf.flops = {"fwd": f, "bwd_act": f, "bwd_w": f}
         io = (t1 * k + ng * k * n + t1 * n) * e
         wextra = ng * k * n * (g - e)
         lf.accessed = {"fwd": io, "bwd_act": io, "bwd_w": io + wextra}
+        if quant:
+            # GroupLinearBase.quant_cast_bytes: totals over all experts;
+            # bwd_act quantizes the output-grad (tokens x n)
+            lf.accessed = {
+                ph: lf.accessed[ph]
+                + t1 * (n if ph == "bwd_act" else k) * (e + 1.0)
+                for ph in ("fwd", "bwd_act", "bwd_w")
+            }
         sequential = st.group_linear_mode == "sequential"
         op_key = "matmul" if sequential else "group_matmul"
+        if quant:
+            op_key = f"{st.quant_dtype}_{op_key}"
         for ph in ("fwd", "bwd_act", "bwd_w"):
             lf.op_key[ph] = op_key
 
@@ -975,6 +1356,8 @@ class _Kernel:
         leaves.append(inorm)
         attn = self._attention_leaves(b)
         leaves += attn
+        if st.enable_dropout:
+            leaves.append(self._dropout("attn_dropout", A))
         add1 = _Leaf("residual_attn")
         add1.accessed = {"fwd": 3 * A}
         add1.op_key = {"fwd": "default"}
@@ -988,6 +1371,8 @@ class _Kernel:
         else:
             mlp = self._mlp_leaves(b)
         leaves += mlp
+        if st.enable_dropout:
+            leaves.append(self._dropout("mlp_dropout", A))
         add2 = _Leaf("residual_mlp")
         add2.accessed = {"fwd": 3 * A}
         add2.op_key = {"fwd": "default"}
@@ -997,7 +1382,7 @@ class _Kernel:
         # stash sub-lists for recompute wiring
         self._last_block_parts = {
             "input_norm": inorm, "pre_mlp_norm": pnorm,
-            "attention": attn, "mlp": mlp,
+            "attention": attn, "mlp": mlp, "is_moe": is_moe,
         }
         return leaves
 
@@ -1032,6 +1417,8 @@ class _Kernel:
                                  False))
         emb.in_b = ids_b
         emb.out_b = out_b
+        if st.enable_dropout:
+            return [emb, self._dropout("embedding_dropout", out_b)]
         return [emb]
 
     def _post_leaves(self, b: int, preprocess: bool) -> List[_Leaf]:
@@ -1078,19 +1465,36 @@ class _Kernel:
     # -- recompute wiring --------------------------------------------------
     def _wire_block(self, leaves: List[_Leaf], recompute: bool):
         """Apply the recompute segment marking of
-        ``LLMBlock._wire_recompute`` + the cache override of
-        ``MetaModule._comp_leaf_info`` to one block's leaf list."""
+        ``LLMBlock._wire_recompute`` (incl. the megatron tail-module /
+        ``recompute_variance`` variance-tail model and the
+        moe_act / mla_up_proj module granularities) + the cache
+        overrides of ``MetaModule._comp_leaf_info`` and the
+        ``offload_groupgemm_col_inputs`` host-offload of
+        ``GroupLinearCol`` to one block's leaf list."""
         rc = self.st.recompute
         for lf in leaves:
             lf.cache_eff = lf.cache_raw
             lf.rc = False
             lf.seg = None
-        if not recompute or not rc.enabled:
-            return
+            lf.variance_tail = False
+        if recompute and rc.enabled:
+            self._mark_segments(leaves)
+        # GroupLinearCol host offload (reference moe_module.py:962-979):
+        # applies only OUTSIDE recompute segments — a replay regenerates
+        # the input in HBM, so there is nothing to offload there
+        if self.st.offload_groupgemm_col_inputs:
+            for lf in leaves:
+                if lf.name == "group_linear_col" and not lf.rc:
+                    lf.bwd_temp = lf.bwd_temp + lf.cache_raw
+                    lf.cache_raw = 0.0
+                    lf.cache_eff = 0.0
+
+    def _mark_segments(self, leaves: List[_Leaf]):
+        rc = self.st.recompute
         parts = self._last_block_parts
         segments: List[List[_Leaf]] = []
 
-        def mark(seg_leaves: List[_Leaf]):
+        def mark(seg_leaves: List[_Leaf], variance=None):
             fresh = [l for l in seg_leaves if not l.rc]
             if not fresh:
                 return
@@ -1103,27 +1507,51 @@ class _Kernel:
                 if i == 0:
                     # FIRST leaf keeps the segment input cached
                     l.cache_eff = l.in_b
+            # mark_recompute: variance=None follows the strategy's
+            # global flag; the LAST claimed leaf becomes the tail
+            if variance is None:
+                variance = rc.variance
+            if variance:
+                fresh[-1].variance_tail = True
         if rc.granularity == "full_block":
             mark(list(leaves))
             return
+
+        def tail(module_name):
+            # megatron tail modules force the tail model on exactly
+            # their own segments; None -> the global variance flag
+            return True if module_name in rc.tail_modules else None
+
         # selective — same claim order as _wire_recompute
         attn = parts["attention"]
         if rc.sdp_recompute:
-            core = [l for l in attn if l.name in
-                    ("core_attention", "mla_core_attention")]
-            for c in core:
+            for c in [l for l in attn if l.is_core]:
                 mark([c])
         if rc.attn_recompute:
             mark(list(attn))
         if rc.attn_norm_recompute:
-            mark([parts["input_norm"]])
+            mark([parts["input_norm"]], variance=tail("layernorm"))
             for l in attn:
                 if l.name in ("kv_norm", "q_norm"):
-                    mark([l])
+                    mark([l], variance=tail("layernorm"))
+        if rc.mla_up_proj_recompute:
+            # MLA up-projections only: latent caches stay, the big
+            # q/kv expansions replay
+            for name in ("q_up", "kv_up"):
+                for l in attn:
+                    if l.name == name:
+                        mark([l], variance=tail("mla_up_proj"))
         if rc.mlp_recompute:
             mark(list(parts["mlp"]))
         if rc.mlp_norm_recompute:
-            mark([parts["pre_mlp_norm"]])
+            mark([parts["pre_mlp_norm"]], variance=tail("layernorm"))
+        if rc.moe_act_recompute and parts["is_moe"] \
+                and not rc.mlp_recompute:
+            # expert activation only; skipped when the whole mlp is
+            # already one segment
+            for l in parts["mlp"]:
+                if l.name in ("expert_swiglu", "expert_gelu"):
+                    mark([l], variance=tail("moe_act"))
 
     # -- leaf costing ------------------------------------------------------
     def _cost_leaves(self, leaves: List[_Leaf]):
@@ -1148,15 +1576,22 @@ class _Kernel:
                 setattr(lf, f"cost_{ph}", t)
             net = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
             fsdp = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+            cph = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
             for (ph, op, dim, size, exposed, is_fsdp) in lf.coll:
                 t = self._net_time(dim, op, size)
                 if exposed:
                     net[ph] = net[ph] + t
+                elif lf.is_cp:
+                    # async-CP a2a: hidden under the attention-core
+                    # compute; the excess is re-exposed in
+                    # _block_totals (bound_async_cp_overlap mirror)
+                    cph[ph] = cph[ph] + t
                 if is_fsdp:
                     fsdp[ph] = fsdp[ph] + t
             lf.net_fwd, lf.net_bwd_act, lf.net_bwd_w = (
                 net["fwd"], net["bwd_act"], net["bwd_w"])
             lf.fsdp = fsdp
+            lf.cp_hidden = cph
 
     def _block_totals(self, leaves: List[_Leaf],
                       expose_fsdp: bool = True) -> dict:
@@ -1169,6 +1604,9 @@ class _Kernel:
         comp = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
         net = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
         fsdp_tot = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        core_comp = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        cp_hidden = {"fwd": 0.0, "bwd_act": 0.0, "bwd_w": 0.0}
+        rc_cp_fwd = 0.0
         fsdp_rc_fwd = 0.0
         recompute_t = 0.0
         for lf in leaves:
@@ -1180,18 +1618,41 @@ class _Kernel:
             net["bwd_w"] += lf.net_bwd_w
             for ph in ("fwd", "bwd_act", "bwd_w"):
                 fsdp_tot[ph] += lf.fsdp[ph]
-            if lf.rc and not lf.variance_tail:
-                recompute_t += lf.cost_fwd + lf.net_fwd
+                cp_hidden[ph] += lf.cp_hidden[ph]
+            if lf.is_core:
+                core_comp["fwd"] += lf.cost_fwd
+                core_comp["bwd_act"] += lf.cost_bwd_act
+            if lf.rc:
+                if not lf.variance_tail:
+                    recompute_t += lf.cost_fwd + lf.net_fwd
+                # the re-exposure shares below land on ANY checkpointed
+                # leaf's recompute_time (expose_unhidden has no
+                # variance-tail carve-out)
                 fsdp_rc_fwd += lf.fsdp["fwd"]
+                rc_cp_fwd += lf.cp_hidden["fwd"]
+        # async-CP re-exposure (bound_async_cp_overlap): the a2a hides
+        # only under the attention-core compute; the excess returns to
+        # the critical path before the block-level FSDP hook runs
+        for ph in ("fwd", "bwd_act"):
+            hidden = cp_hidden[ph]
+            if hidden <= 0:
+                continue
+            extra = max(hidden - core_comp[ph], 0.0)
+            net[ph] += extra
+            if ph == "fwd" and extra > 0:
+                recompute_t += extra * (rc_cp_fwd / hidden)
+            cp_hidden[ph] = hidden - extra  # still-hidden remainder
         # FSDP re-exposure (zero>=3): hidden beyond the block's own
-        # compute budget returns to the critical path; the recompute
-        # replay picks up its leaves' share of the fwd extra
+        # compute budget returns to the critical path — the compute
+        # already granted to async-CP hiding is not available twice;
+        # the recompute replay picks up its leaves' share of the fwd
+        # extra
         if expose_fsdp and self.st.zero_state >= 3:
             for ph in ("fwd", "bwd_act", "bwd_w"):
                 hidden = fsdp_tot[ph]
                 if hidden <= 0:
                     continue
-                budget = max(comp[ph], 0.0)
+                budget = max(comp[ph] - cp_hidden[ph], 0.0)
                 extra = max(hidden - budget, 0.0)
                 net[ph] += extra
                 if ph == "fwd":
@@ -1212,6 +1673,9 @@ class _Kernel:
         return {
             "fwd": fwd_time, "bwd": bwd_time, "cache": cache,
             "dense_numel": dn, "moe_numel": mn,
+            # exposed-comm share of this block's step time — guided
+            # search Pareto telemetry only, never a parity surface
+            "net": net["fwd"] + net["bwd_act"] + net["bwd_w"],
             # every probe of one block shares its entry-live anchor, so
             # the stage composition only ever needs the block's max
             "probe_max": max(probes) if probes else float("-inf"),
@@ -1276,7 +1740,8 @@ class _Kernel:
     # -- scoring -----------------------------------------------------------
     def score(self, mbs: Sequence[int], mbc: Sequence[int],
               nrc: Optional[Sequence[int]] = None,
-              cost_margin: Optional[float] = None) -> Optional[dict]:
+              cost_margin: Optional[float] = None,
+              backend: str = "auto") -> Optional[dict]:
         """Score a candidate batch: arrays of ``micro_batch_size``,
         ``micro_batch_num``, and (for full-block recompute) the probed
         ``recompute_layer_num`` per candidate. Returns per-candidate
@@ -1329,7 +1794,9 @@ class _Kernel:
         wiring = (
             ("rc", rc.granularity, rc.sdp_recompute, rc.attn_recompute,
              rc.attn_norm_recompute, rc.mlp_recompute,
-             rc.mlp_norm_recompute)
+             rc.mlp_norm_recompute, rc.moe_act_recompute,
+             rc.mla_up_proj_recompute, rc.variance,
+             tuple(sorted(rc.tail_modules)))
             if rc.enabled else ("plain",)
         )
         dense_layers = m.dense_layer_num if m.model_type == "moe" \
@@ -1343,6 +1810,7 @@ class _Kernel:
                 "delta": np.array([p["delta"] for p in parts]),
                 "dense_numel": parts[0]["dense_numel"],
                 "moe_numel": parts[0]["moe_numel"],
+                "net": np.array([p.get("net", 0.0) for p in parts]),
                 "probe_max": np.array([p["probe_max"] for p in parts]),
             }
 
@@ -1386,16 +1854,26 @@ class _Kernel:
             return got
 
         NEG = np.full(ncand, -np.inf)
-        stage_fwd, stage_bwd = [], []
-        stage_peak, stage_cache, stage_model = [], [], []
-        stage_params = []
+        vp = st.vp_size
+        total_v = pp * vp
+        # per-(stage, chunk) composition in virtual-stage order (the
+        # layer offsets PerfLLM.build walks); at vp=1 this is exactly
+        # the historical per-stage loop
+        chunk_fwd: Dict[tuple, object] = {}
+        chunk_bwd: Dict[tuple, object] = {}
+        chunk_cache: Dict[tuple, object] = {}
+        chunk_peak: Dict[tuple, object] = {}
+        chunk_net: Dict[tuple, object] = {}
+        chunk_params: Dict[tuple, tuple] = {}
         offset = 0
-        for s in range(pp):
-            L_s = self.counts[s][0]
-            preprocess = s == 0
-            postprocess = s == pp - 1
+        for v in range(total_v):
+            c, s = divmod(v, pp)
+            L_s = self.counts[s][c]
+            preprocess = v == 0
+            postprocess = v == total_v - 1
             boundary = min(max(dense_layers - offset, 0), L_s)
-            # run lengths (arrays): rc region = idx_in_stage < nrc
+            # run lengths (arrays): rc region = idx_in_stage < nrc,
+            # where idx_in_stage is the layer's index within ITS chunk
             nrc_s = np.where(nrc_a < 0, float(L_s),
                              np.minimum(nrc_a, float(L_s)))
             if not rc_active:
@@ -1420,16 +1898,17 @@ class _Kernel:
             fwd = zeros
             bwd = zeros
             cache = zeros
+            net = zeros
             dn = mn = 0.0
             peak_rows = []
             live = zeros
-            pre_tot = None
             if preprocess:
                 pre_tot = boundary_totals(
                     ("pre",), lambda bv: self._pre_leaves(bv))
                 fwd = fwd + expand(pre_tot["fwd"])
                 bwd = bwd + expand(pre_tot["bwd"])
                 cache = cache + expand(pre_tot["cache"])
+                net = net + expand(pre_tot["net"])
                 dn += pre_tot["dense_numel"]
                 peak_rows.append(live + expand(pre_tot["probe_max"]))
                 live = live + expand(pre_tot["delta"])
@@ -1437,6 +1916,7 @@ class _Kernel:
                 fwd = fwd + cnt * expand(tot["fwd"])
                 bwd = bwd + cnt * expand(tot["bwd"])
                 cache = cache + cnt * expand(tot["cache"])
+                net = net + cnt * expand(tot["net"])
                 delta = expand(tot["delta"])
                 peak_entry = live + (cnt - 1.0) * delta
                 peak_rows.append(
@@ -1464,34 +1944,60 @@ class _Kernel:
                 fwd = fwd + expand(post_tot["fwd"])
                 bwd = bwd + expand(post_tot["bwd"])
                 cache = cache + expand(post_tot["cache"])
+                net = net + expand(post_tot["net"])
                 dn += post_tot["dense_numel"]
                 peak_rows.append(live + expand(post_tot["probe_max"]))
                 live = live + expand(post_tot["delta"])
             peak_pt = np.maximum(
                 np.max(np.stack(peak_rows), axis=0) if peak_rows else zeros,
                 0.0)
+            chunk_fwd[(s, c)] = fwd
+            chunk_bwd[(s, c)] = bwd
+            chunk_cache[(s, c)] = cache
+            chunk_peak[(s, c)] = peak_pt
+            chunk_net[(s, c)] = net
+            chunk_params[(s, c)] = (dn, mn)
+            offset += L_s
+
+        stage_fwd, stage_bwd = [], []
+        stage_peak, stage_cache, stage_model = [], [], []
+        stage_params, stage_net = [], []
+        for s in range(pp):
+            fwd = bwd = cache = net = zeros
+            dn = mn = 0.0
+            for c in range(vp):
+                fwd = fwd + chunk_fwd[(s, c)]
+                bwd = bwd + chunk_bwd[(s, c)]
+                cache = cache + chunk_cache[(s, c)]
+                net = net + chunk_net[(s, c)]
+                dn += chunk_params[(s, c)][0]
+                mn += chunk_params[(s, c)][1]
             w, g, s_b = self._pinfo(dn, False)
             mw, mg, ms = self._pinfo(mn, True)
-            model_bytes = w + g + s_b + mw + mg + ms
             stage_fwd.append(fwd)
             stage_bwd.append(bwd)
             stage_cache.append(cache)
-            stage_peak.append(peak_pt)
-            stage_model.append(model_bytes)
+            stage_peak.append(chunk_peak[(s, 0)])
+            stage_model.append(w + g + s_b + mw + mg + ms)
+            stage_net.append(net)
             stage_params.append({
                 "dense_numel": dn, "moe_numel": mn,
             })
-            offset += L_s
 
-        # ---- memory (analysis_mem, vp=1)
+        # ---- memory (analysis_mem)
         cap = self.system.mem_bytes * st.mem_factor
-        peaks = []
-        for s in range(pp):
-            live_mb = np.minimum(mbc_a, float(pp - s))
-            peaks.append(stage_model[s]
-                         + np.maximum(live_mb - 1.0, 0.0) * stage_cache[s]
-                         + stage_peak[s])
-        max_peak = np.max(np.stack(peaks), axis=0)
+        if vp > 1:
+            max_peak = self._interleaved_peaks(
+                chunk_cache, chunk_peak, stage_model, mbc_a, ncand)
+        else:
+            peaks = []
+            for s in range(pp):
+                live_mb = np.minimum(mbc_a, float(pp - s))
+                peaks.append(
+                    stage_model[s]
+                    + np.maximum(live_mb - 1.0, 0.0) * stage_cache[s]
+                    + stage_peak[s])
+            max_peak = np.max(np.stack(peaks), axis=0)
 
         # ---- cost (analysis_cost)
         boundary_bytes = b * s_sp * m.hidden_size * e
@@ -1500,7 +2006,8 @@ class _Kernel:
         dp_rs, dp_ag = [], []
         optim = []
         for s in range(pp):
-            rs, ag = self._dp_terms(s, stage_params[s], mbc_a, ncand)
+            rs, ag = self._dp_terms(s, stage_params[s], mbc_a, ncand,
+                                    stage_fwd[s], stage_bwd[s])
             dp_rs.append(rs)
             dp_ag.append(ag)
             optim.append(self._optim_time(stage_params[s]))
@@ -1512,7 +2019,44 @@ class _Kernel:
                          for i in range(ncand)]
         totals = np.empty(ncand)
         ends = np.empty((pp, ncand))
+        # jax backend: candidates sharing (pp, mbc) ride one vmapped
+        # jitted scan instead of a Python fold each. Results are
+        # bit-identical to the numpy fold (x64; pinned in tests), so
+        # "auto" may mix backends freely — it dispatches to XLA only
+        # when the group is big enough to amortize the call overhead.
+        folded = [False] * ncand
+        jit_groups: Dict[int, List[int]] = {}
+        if pp > 1 and vp == 1 and backend in ("jax", "auto") \
+                and jax_available():
+            by_mbc: Dict[int, List[int]] = {}
+            for i in range(ncand):
+                if need_cost[i]:
+                    by_mbc.setdefault(int(mbc_a[i]), []).append(i)
+            for mbc_i, idxs in by_mbc.items():
+                if backend == "jax" or len(idxs) >= JIT_GROUP_MIN:
+                    jit_groups[mbc_i] = idxs
+        if jit_groups:
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                for mbc_i, idxs in jit_groups.items():
+                    fn = _jit_fold_1f1b(pp, mbc_i)
+                    fwd_mat = np.stack(
+                        [stage_fwd[s][idxs] for s in range(pp)])
+                    bwd_mat = np.stack(
+                        [stage_bwd[s][idxs] for s in range(pp)])
+                    p2p_vec = np.asarray(p2p_t)[idxs]
+                    blocking_vec = p2p_vec if not st.pp_comm_async \
+                        else np.zeros(len(idxs))
+                    tot, ends_g = fn(fwd_mat, bwd_mat, p2p_vec,
+                                     blocking_vec)
+                    totals[idxs] = np.asarray(tot)
+                    ends[:, idxs] = np.asarray(ends_g)
+                    for i in idxs:
+                        folded[i] = True
         for i in range(ncand):
+            if folded[i]:
+                continue
             if not need_cost[i]:
                 totals[i] = math.inf
                 ends[:, i] = math.inf
@@ -1521,6 +2065,17 @@ class _Kernel:
                 tot = mbc_a[i] * (stage_fwd[0][i] + stage_bwd[0][i])
                 totals[i] = tot
                 ends[0, i] = tot
+            elif vp > 1:
+                fwds = [[float(chunk_fwd[(s, c)][i]) for c in range(vp)]
+                        for s in range(pp)]
+                bwds = [[float(chunk_bwd[(s, c)][i]) for c in range(vp)]
+                        for s in range(pp)]
+                tot, ends_i = fold_interleaved(
+                    pp, vp, int(mbc_a[i]), st.vpp_group_size, fwds,
+                    bwds, p2p_t[i], st.pp_comm_async)
+                totals[i] = tot
+                for s in range(pp):
+                    ends[s, i] = ends_i[s]
             else:
                 fwds = [stage_fwd[s][i] for s in range(pp)]
                 bwds = [stage_bwd[s][i] for s in range(pp)]
@@ -1539,6 +2094,11 @@ class _Kernel:
         model_flops = self._flops_per_token * tokens
         per_chip = model_flops / st.world_size / iter_time
         peak_flops = self.system.accelerator.op["default"].tflops * 1e12
+        # exposed-comm share — guided-search Pareto telemetry (NOT a
+        # scalar-parity surface; see docs/search.md "Guided search")
+        comm_time = np.max(
+            np.stack([mbc_a * stage_net[s] + dp_rs[s] + dp_ag[s]
+                      for s in range(pp)]), axis=0)
         return {
             "iter_time": iter_time,
             "mfu": per_chip / peak_flops,
@@ -1546,14 +2106,53 @@ class _Kernel:
             "max_peak_bytes": max_peak,
             "fits_margin_bytes": cap - max_peak,
             "usable_bytes": cap,
+            "comm_time": comm_time,
+            "comm_fraction": np.where(
+                np.isfinite(iter_time) & (iter_time > 0),
+                comm_time / np.where(iter_time > 0, iter_time, 1.0),
+                0.0),
         }
 
-    def _n_buckets(self, numel: float, group: int) -> int:
-        """Megatron DDP bucket count from the SAME sizing helper the
-        scalar path (and the simulator) use — one source, so a cap or
-        partial-bucket tweak can never desynchronize the engines.
-        Memoized: numel/group are layout constants re-queried per
-        score call."""
+    def _interleaved_peaks(self, chunk_cache, chunk_peak, stage_model,
+                           mbc_a, ncand):
+        """vp>1 per-stage peak: the SAME schedule-position replay
+        ``PerfLLM._analysis_mem_interleaved`` folds
+        (``perf.interleaved_stage_peak``), per candidate."""
+        from simumax_tpu.parallel.pipeline import interleaved_order
+        from simumax_tpu.perf import interleaved_stage_peak
+
+        st = self.st
+        pp, vp = st.pp_size, st.vp_size
+        max_peak = np.empty(ncand)
+        orders_by_mbc: Dict[int, list] = {}
+        for i in range(ncand):
+            mbc_i = int(mbc_a[i])
+            orders = orders_by_mbc.get(mbc_i)
+            if orders is None:
+                orders = [
+                    interleaved_order(pp, s, mbc_i, vp,
+                                      st.vpp_group_size)
+                    for s in range(pp)
+                ]
+                orders_by_mbc[mbc_i] = orders
+            peak_i = -math.inf
+            for s in range(pp):
+                cache = {c: float(chunk_cache[(s, c)][i])
+                         for c in range(vp)}
+                peakpt = {c: float(chunk_peak[(s, c)][i])
+                          for c in range(vp)}
+                peak_sched, _, _, _ = interleaved_stage_peak(
+                    orders[s], cache, peakpt)
+                peak_i = max(peak_i, stage_model[s] + peak_sched)
+            max_peak[i] = peak_i
+        return max_peak
+
+    def _bucket_info(self, numel: float, group: int) -> Tuple[int, float]:
+        """Megatron DDP bucket (count, last-bucket numel) from the SAME
+        sizing helper the scalar path (and the simulator) use — one
+        source, so a cap or partial-bucket tweak can never
+        desynchronize the engines. Memoized: numel/group are layout
+        constants re-queried per score call."""
         cache = getattr(self, "_bucket_counts", None)
         if cache is None:
             cache = self._bucket_counts = {}
@@ -1562,20 +2161,25 @@ class _Kernel:
         if got is None:
             from simumax_tpu.core.utils import dp_comm_buckets
 
-            got = len(dp_comm_buckets(numel, group))
+            buckets = dp_comm_buckets(numel, group)
+            got = (len(buckets), buckets[-1] if buckets else 0.0)
             cache[key] = got
         return got
 
-    def _dp_terms(self, stage: int, params: dict, mbc_a, ncand):
+    def _dp_terms(self, stage: int, params: dict, mbc_a, ncand,
+                  stage_fwd, stage_bwd):
         """Exposed (reduce-scatter, all-gather) DP comm per stage —
-        mirror of ``PerfLLM._compute_dp_time`` without the (unsupported)
-        overlap flags."""
+        mirror of ``PerfLLM._compute_dp_time`` including the Megatron
+        ``overlap_grad_reduce`` / ``overlap_param_gather`` hiding
+        (``stage_fwd``/``stage_bwd`` are the stage's per-microbatch
+        phase times, the overlap budgets)."""
         st, m = self.st, self.model
         zeros = np.zeros(ncand)
         g_el = 2.0 if st.grad_reduce_in_bf16 else 4.0
         p_el = st.element_size
         rs = zeros
         ag = zeros
+        last_bucket_times = []  # per stream: its final bucket's rs time
         dense_numel = params["dense_numel"]
         moe_numel = params["moe_numel"]
         for numel, dim, group in (
@@ -1585,21 +2189,41 @@ class _Kernel:
             if group <= 1 or not numel or st.zero_state >= 3:
                 continue
             op = "reduce_scatter" if st.zero_state >= 1 else "all_reduce"
-            nbuckets = self._n_buckets(numel, group)
+            nbuckets, last_nb = self._bucket_info(numel, group)
             k_rs, l_rs = self._coeffs(dim, op)
             r = k_rs * (numel * g_el) + nbuckets * l_rs
+            last_bucket_times.append(k_rs * (last_nb * g_el) + l_rs)
             if st.zero_state == 2:
                 r = r * mbc_a
             rs = rs + r
             if st.zero_state >= 1:
                 k_ag, l_ag = self._coeffs(dim, "all_gather")
                 ag = ag + k_ag * (numel * p_el) + nbuckets * l_ag
+        tied = 0.0
         if (st.pp_size > 1 and not m.untie_embeddings
                 and stage in (0, st.pp_size - 1)):
             emb_grad = (m.padded_vocab_size * m.hidden_size
                         / st.tp_size * st.grad_element_size)
-            rs = rs + 2 * self._net_time("pp", "p2p", emb_grad)
-        return rs, ag
+            tied = 2 * self._net_time("pp", "p2p", emb_grad)
+        # Megatron overlap flags: the bucketed grad reduce hides under
+        # the backward (per microbatch at ZeRO-2, the last microbatch
+        # otherwise — each stream's FINAL bucket is never hideable);
+        # the ZeRO-1 param all-gather hides under the next iteration's
+        # first forward chunk (1/vp of the stage's forward)
+        if st.overlap_grad_reduce or st.overlap_param_gather:
+            active = (rs + ag + tied) > 0
+            if st.overlap_grad_reduce:
+                n_windows = mbc_a if st.zero_state == 2 else 1.0
+                bkt_tail = max(last_bucket_times) \
+                    if last_bucket_times else 0.0
+                hidden = np.minimum(
+                    np.maximum(rs - bkt_tail * n_windows, 0.0),
+                    stage_bwd * n_windows)
+                rs = rs - np.where(active, hidden, 0.0)
+            if st.overlap_param_gather:
+                hidden = np.minimum(ag, stage_fwd / st.vp_size)
+                ag = ag - np.where(active, hidden, 0.0)
+        return rs + tied, ag
 
     def _optim_time(self, params: dict) -> float:
         """Mirror of ``PerfLLM._compute_optim_time`` (scalar: params are
@@ -1640,9 +2264,14 @@ class BatchedScorer:
     BATCH_FIELDS = ("micro_batch_size", "micro_batch_num",
                     "recompute_layer_num")
 
-    def __init__(self, model: ModelConfig, system: SystemConfig):
+    def __init__(self, model: ModelConfig, system: SystemConfig,
+                 backend: str = "auto"):
         self.model = model
         self.system = system
+        #: fold execution backend: "numpy" | "jax" | "auto" (jax for
+        #: large candidate groups when importable — results are
+        #: bit-identical either way, see docs/search.md)
+        self.backend = backend
         self._kernels: Dict[tuple, _Kernel] = {}
         #: block-kind profile cache shared across family kernels (see
         #: ``_Kernel._kind_key`` — profiles are pp/mbc-independent)
@@ -1725,7 +2354,8 @@ class BatchedScorer:
             stats["max_batch"] = len(splits)
         scores = kern.score([s[0] for s in splits],
                             [s[1] for s in splits], nrc=nrc,
-                            cost_margin=cost_margin)
+                            cost_margin=cost_margin,
+                            backend=self.backend)
         return kern, scores
 
     # -- the three family walks -------------------------------------------
@@ -1776,6 +2406,9 @@ class BatchedScorer:
     def search_selective(self, st: StrategyConfig):
         from simumax_tpu.search.searcher import _SELECTIVE_COMBOS
 
+        if st.vp_size > 1 and st.micro_batch_num % st.vpp_group_size:
+            # sanity_check would reject every combo at this split
+            return None
         best = None
         for combo in _SELECTIVE_COMBOS:
             cand = clone_strategy(st)
@@ -1803,6 +2436,9 @@ class BatchedScorer:
 
     def search_recompute_layers(self, st: StrategyConfig,
                                 model: ModelConfig):
+        if st.vp_size > 1 and st.micro_batch_num % st.vpp_group_size:
+            # sanity_check would reject every probed layer count
+            return None
         layers_per_stage = -(-model.layer_num
                              // (st.pp_size * st.vp_size))
         probe = clone_strategy(st)
@@ -1832,7 +2468,7 @@ class BatchedScorer:
             scores = kern.score(
                 [st.micro_batch_size] * len(all_n),
                 [st.micro_batch_num] * len(all_n),
-                nrc=all_n, cost_margin=0.0,
+                nrc=all_n, cost_margin=0.0, backend=self.backend,
             )
             if scores is None:
                 return None
@@ -1842,7 +2478,7 @@ class BatchedScorer:
         else:
             first = kern.score([st.micro_batch_size],
                                [st.micro_batch_num], nrc=[0],
-                               cost_margin=0.0)
+                               cost_margin=0.0, backend=self.backend)
             _scored(1)
             if first is None:
                 return None
@@ -1854,7 +2490,8 @@ class BatchedScorer:
                     _scored(1)
                     got = kern.score([st.micro_batch_size],
                                      [st.micro_batch_num], nrc=[mid],
-                                     cost_margin=0.0)
+                                     cost_margin=0.0,
+                                     backend=self.backend)
                     cache[mid] = got
                 return got, 0
         lo, hi = 0, layers_per_stage
@@ -1875,6 +2512,64 @@ class BatchedScorer:
                 lo = mid + 1
         return best
 
+    @staticmethod
+    def family_strategy(st: StrategyConfig,
+                        rc_family: str) -> StrategyConfig:
+        """The recompute-family canonical wiring of a sweep cell —
+        single source for the cell walk (:meth:`evaluate_cell`) and
+        the guided screen (:meth:`screen_cell`), so the two can never
+        screen one configuration and evaluate another."""
+        cand = clone_strategy(st)
+        if rc_family == "none":
+            cand.enable_recompute = False
+        elif rc_family == "selective":
+            cand.enable_recompute = True
+            cand.recompute_granularity = "selective"
+            cand.recompute_layer_num = -1
+            cand.sdp_recompute = True
+        elif rc_family == "full_block":
+            cand.enable_recompute = True
+            cand.recompute_granularity = "full_block"
+            cand.recompute_layer_num = -1
+        else:
+            from simumax_tpu.core.config import ConfigError
+
+            raise ConfigError(
+                f"unknown recompute family {rc_family!r}",
+                phase="search")
+        cand.__post_init__()
+        return cand
+
+    def screen_cell(self, st: StrategyConfig, rc_family: str,
+                    model: ModelConfig,
+                    global_batch_size: int) -> Optional[dict]:
+        """One-candidate guided-search screen of a sweep cell: score
+        the family's canonical (mbs=1, mbc=per_dp) split — under the
+        family's own recompute wiring — and return its
+        ``{iter_time, peak_bytes, comm_fraction}`` Pareto triple, or
+        ``None`` when the family is invalid (the scalar path would
+        reject every split). Raises :class:`UnsupportedBatched` for
+        families outside the lowering surface; the guided search then
+        evaluates the cell unconditionally (conservative)."""
+        if st.dp_size < 1 or global_batch_size % st.dp_size:
+            return None
+        per_dp = global_batch_size // st.dp_size
+        st_rc = self.family_strategy(st, rc_family)
+        st_rc.micro_batch_size = 1
+        st_rc.micro_batch_num = per_dp
+        st_rc.__post_init__()
+        if st_rc.vp_size > 1 and per_dp % st_rc.vpp_group_size:
+            return None
+        kern = self.kernel_for(st_rc)
+        scores = kern.score([1], [per_dp], backend=self.backend)
+        if scores is None:
+            return None
+        return {
+            "iter_time": float(scores["iter_time"][0]),
+            "peak_bytes": float(scores["max_peak_bytes"][0]),
+            "comm_fraction": float(scores["comm_fraction"][0]),
+        }
+
     def evaluate_cell(self, st: StrategyConfig, rc_family: str,
                       model: ModelConfig, global_batch_size: int):
         """Mirror of ``searcher._evaluate_sweep_cell``. Returns
@@ -1889,21 +2584,14 @@ class BatchedScorer:
                 phase="search", global_batch_size=global_batch_size,
                 dp=st.dp_size,
             )
-        st_rc = clone_strategy(st)
+        st_rc = self.family_strategy(st, rc_family)
         if rc_family == "none":
-            st_rc.enable_recompute = False
-            st_rc.__post_init__()
             got = self.search_micro_batch_config(
                 st_rc, global_batch_size, gib_margin=1.0)
             if got is None:
                 return None
             return got[0], got[1], 1.0
         if rc_family == "selective":
-            st_rc.enable_recompute = True
-            st_rc.recompute_granularity = "selective"
-            st_rc.recompute_layer_num = -1
-            st_rc.sdp_recompute = True
-            st_rc.__post_init__()
             base = self.search_micro_batch_config(
                 st_rc, global_batch_size, gib_margin=1.0)
             if base is not None:
@@ -1917,14 +2605,10 @@ class BatchedScorer:
             if got is None:
                 return None
             return got[0], got[1], 0.0
-        if rc_family == "full_block":
-            st_rc.micro_batch_size = 1
-            st_rc.micro_batch_num = global_batch_size // st.dp_size
-            got = self.search_recompute_layers(st_rc, model)
-            if got is None:
-                return None
-            return got[0], got[1], 0.0
-        from simumax_tpu.core.config import ConfigError
-
-        raise ConfigError(f"unknown recompute family {rc_family!r}",
-                          phase="search")
+        # full_block (family_strategy already rejected unknown names)
+        st_rc.micro_batch_size = 1
+        st_rc.micro_batch_num = global_batch_size // st.dp_size
+        got = self.search_recompute_layers(st_rc, model)
+        if got is None:
+            return None
+        return got[0], got[1], 0.0

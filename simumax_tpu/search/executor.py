@@ -158,18 +158,31 @@ def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
         ):
             row = None
             batched_done = False
+            fallback_reason = None
             if env.engine == "batched":
                 from simumax_tpu.search.batched import UnsupportedBatched
 
-                scorer = _batched_scorer(env.model, env.system)
-                stats_before = dict(scorer.stats)
-                try:
-                    got = scorer.evaluate_cell(
-                        st, cell.rc, env.model, env.global_batch_size
-                    )
-                    batched_done = True
-                except UnsupportedBatched:
-                    batched_done = False  # scalar fallback below
+                if env.project_dualpp or env.simulate:
+                    # both need the built scalar estimate — fall back
+                    # per cell, counted like any other fallback (no
+                    # whole-sweep downgrade)
+                    fallback_reason = ("project_dualpp"
+                                       if env.project_dualpp
+                                       else "simulate")
+                else:
+                    scorer = _batched_scorer(env.model, env.system)
+                    stats_before = dict(scorer.stats)
+                    try:
+                        got = scorer.evaluate_cell(
+                            st, cell.rc, env.model, env.global_batch_size
+                        )
+                        batched_done = True
+                    except UnsupportedBatched as exc:
+                        fallback_reason = str(exc)  # scalar path below
+                if fallback_reason is not None:
+                    diagnostics.count("sweep_batched_fallbacks")
+                    diagnostics.count(
+                        f"sweep_batched_fallback[{fallback_reason}]")
                 if batched_done:
                     diagnostics.count("sweep_cells_batched")
                     # per-cell scoring-telemetry deltas: additive so the
@@ -195,6 +208,10 @@ def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
                     env.global_batch_size, cache, env.project_dualpp,
                     simulate=env.simulate,
                 )
+                if row is not None and fallback_reason is not None:
+                    # audit trail: this row came from the scalar
+                    # fallback path (CSV column + journal field)
+                    row["batched_fallback"] = fallback_reason
     except Exception as exc:  # quarantine upstream, keep sweeping
         err = {
             "error_type": type(exc).__name__,

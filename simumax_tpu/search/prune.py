@@ -215,6 +215,89 @@ def effective_layout_key(st: StrategyConfig, rc: str) -> tuple:
             st.zero_state, st.etp_size, rc)
 
 
+def pareto_frontier(points: dict) -> set:
+    """Keys of the non-dominated points (minimize every objective):
+    the guided search's frontier over per-cell
+    ``(iter_time, peak_bytes, comm_fraction)`` screening triples.
+    Deterministic: iteration is over sorted keys, and equal points are
+    all kept (neither dominates the other strictly)."""
+    keys = sorted(points)
+    frontier = set()
+    for k in keys:
+        p = points[k]
+        dominated = False
+        for k2 in keys:
+            if k2 == k:
+                continue
+            q = points[k2]
+            if all(q[i] <= p[i] for i in range(len(p))) \
+                    and any(q[i] < p[i] for i in range(len(p))):
+                dominated = True
+                break
+        if not dominated:
+            frontier.add(k)
+    return frontier
+
+
+class CellNeighborhood:
+    """Local-neighborhood structure of a sweep grid: two cells are
+    neighbors when their layout coordinates differ by at most one index
+    step along exactly one swept axis (tp/cp/ep/pp/zero) — or share the
+    layout with a different recompute family. The guided search's
+    refinement expands evaluation around frontier cells through this
+    structure (docs/search.md "Guided search")."""
+
+    _AXES = ("tp", "cp", "ep", "pp", "zero")
+
+    def __init__(self, cells: Sequence[SweepCell]):
+        self._axis_vals = [
+            sorted({getattr(c, a) for c in cells}) for a in self._AXES
+        ]
+        self._by_coord: dict = {}
+        self._coord: dict = {}
+        for c in cells:
+            coord = tuple(
+                vals.index(getattr(c, a))
+                for a, vals in zip(self._AXES, self._axis_vals)
+            )
+            self._coord[c.idx] = coord
+            self._by_coord.setdefault(coord, []).append(c)
+
+    def neighbors(self, cell: SweepCell):
+        """Every cell within one axis step of ``cell`` (including its
+        own layout's other recompute families), in deterministic grid
+        order."""
+        coord = self._coord[cell.idx]
+        out = []
+        seen = set()
+        for cand in self._by_coord.get(coord, ()):
+            if cand.idx != cell.idx and cand.idx not in seen:
+                seen.add(cand.idx)
+                out.append(cand)
+        for ax in range(len(self._AXES)):
+            for step in (-1, 1):
+                j = coord[ax] + step
+                if j < 0 or j >= len(self._axis_vals[ax]):
+                    continue
+                ncoord = coord[:ax] + (j,) + coord[ax + 1:]
+                for cand in self._by_coord.get(ncoord, ()):
+                    if cand.idx not in seen:
+                        seen.add(cand.idx)
+                        out.append(cand)
+        return sorted(out, key=lambda c: c.idx)
+
+
+def screened_row(st: StrategyConfig, rc: str, screen: dict) -> dict:
+    """A CSV-compatible ``status=screened`` row for a guided-search
+    cell that was screened but not selected for full evaluation; the
+    screening triple rides along for auditability."""
+    row = base_cell_row(st, rc, "screened")
+    row["screen_iter_ms"] = screen["iter_time"] * 1e3
+    row["screen_peak_gib"] = screen["peak_bytes"] / GiB
+    row["screen_comm_fraction"] = screen["comm_fraction"]
+    return row
+
+
 def enumerate_cells(
     base_strategy: StrategyConfig,
     model: ModelConfig,

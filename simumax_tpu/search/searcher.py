@@ -645,6 +645,7 @@ def search_best_parallel_strategy(
     verify_topk: Optional[int] = None,
     store=None,
     on_cell=None,
+    search_mode: str = "grid",
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
@@ -680,10 +681,23 @@ def search_best_parallel_strategy(
     vectorized cost kernel (``search/batched.py``) instead of walking a
     ``PerfLLM`` object graph per candidate, then re-verifies the top
     ``verify_topk`` ranked rows (default: ``topk``) with the scalar
-    oracle — the returned top-k rows are exact scalar rows. Cells the
-    kernel does not model silently fall back to the scalar path
-    (documented in ``docs/search.md``); ``project_dualpp`` / ``simulate``
-    sweeps fall back entirely (both need the built estimate).
+    oracle — the returned top-k rows are exact scalar rows. Since PR 11
+    the kernel covers every strategy family; the tiny residual surface
+    (and ``project_dualpp`` / ``simulate``, which need the built
+    estimate) falls back to the scalar path PER CELL with counted
+    telemetry: a ``sweep_batched_fallbacks`` total, a per-reason
+    ``sweep_batched_fallback[...]`` histogram, and a
+    ``batched_fallback`` column on the affected rows
+    (``docs/search.md``).
+
+    ``search_mode="guided"`` replaces exhaustive grid evaluation with
+    Pareto-guided selection: every cell is screened with one cheap
+    batched-kernel score, only the (iter_time, peak_mem, comm_fraction)
+    frontier plus seeds and their local neighborhoods evaluate fully,
+    refining around the top-k until stable; skipped cells appear as
+    ``status=screened`` CSV rows. Journaled and resumable exactly like
+    the grid walk (guided journals are mode-stamped). See
+    ``docs/search.md`` "Guided search".
 
     ``store`` (a ``service.store.ContentStore``) adds the persistent
     per-cell layer (``docs/service.md``): every finished cell is written
@@ -705,14 +719,20 @@ def search_best_parallel_strategy(
     if engine not in ("scalar", "batched"):
         raise ConfigError(f"unknown search engine {engine!r}",
                           phase="search")
+    if search_mode not in ("grid", "guided"):
+        raise ConfigError(f"unknown search_mode {search_mode!r}",
+                          phase="search")
     if engine == "batched" and (project_dualpp or simulate):
+        # both need the built scalar estimate: every cell falls back to
+        # the scalar path PER CELL — counted in the batched_fallbacks
+        # histogram and tagged in the CSV, never a silent whole-sweep
+        # engine downgrade
         diagnostics.warn(
             "search",
-            "engine='batched' does not support project_dualpp/simulate "
-            "(both need the built scalar estimate); using the scalar "
-            "engine",
+            "engine='batched' with project_dualpp/simulate evaluates "
+            "every cell on the scalar path (per-cell fallback, counted "
+            "in batched_fallbacks)",
         )
-        engine = "scalar"
     # run identity for the journal: everything a cell row depends on
     # besides the swept dims themselves — model, hardware fingerprint,
     # batch size, and every estimate-relevant base-strategy field the
@@ -724,6 +744,10 @@ def search_best_parallel_strategy(
         # batched rows differ from scalar rows in last-ulp floats and
         # placeholder attribution columns: refuse cross-engine resume
         identity_extra["engine"] = engine
+    if search_mode != "grid":
+        # a guided journal covers only the frontier neighborhood, not
+        # the whole grid: refuse cross-mode resume
+        identity_extra["search_mode"] = search_mode
     identity = json.loads(json.dumps({
         **identity_extra,
         "model": model.model_name,
@@ -841,7 +865,8 @@ def search_best_parallel_strategy(
     diagnostics.count("sweep_cells_deduped", len(deduped_rows))
     diagnostics.count("sweep_cells_replayed", len(replayed))
     diagnostics.count("sweep_cells_cached", len(cached))
-    diagnostics.count("sweep_cells_evaluated", len(to_run))
+    if search_mode == "grid":
+        diagnostics.count("sweep_cells_evaluated", len(to_run))
     diagnostics.counters["sweep_jobs"] = max(1, int(jobs or 1))
     # every PerfLLM built under a candidate reports into this run's
     # collector (Diagnostics.active()) instead of a throwaway one
@@ -925,8 +950,7 @@ def search_best_parallel_strategy(
                                    error=prior.get("error"))
                 if on_cell is not None:
                     on_cell(cell.key, status, prior.get("row"))
-            outcomes = run_cells(
-                to_run,
+            run_kwargs = dict(
                 base_strategy=base_strategy, model=model, system=system,
                 global_batch_size=global_batch_size,
                 project_dualpp=project_dualpp,
@@ -934,6 +958,17 @@ def search_best_parallel_strategy(
                 cache=cache, diagnostics=diagnostics, jobs=jobs,
                 on_done=_checkpoint, simulate=simulate, engine=engine,
             )
+            screened_rows: List[dict] = []
+            if search_mode == "guided":
+                outcomes, screened_rows = _run_guided(
+                    cells, to_run, replayed, cached, base_strategy,
+                    model, diagnostics, topk, run_kwargs,
+                    global_batch_size, system,
+                )
+                diagnostics.count("sweep_cells_evaluated",
+                                  len(outcomes))
+            else:
+                outcomes = run_cells(to_run, **run_kwargs)
     finally:
         if journal:
             journal.close()
@@ -994,7 +1029,7 @@ def search_best_parallel_strategy(
             for r in rows
         ]
         csv_rows = csv_result_rows + quarantine + pruned_rows \
-            + deduped_rows
+            + deduped_rows + screened_rows
         fields: List[str] = []
         for r in csv_rows:
             for k in r:
@@ -1005,6 +1040,117 @@ def search_best_parallel_strategy(
             w.writeheader()
             w.writerows(csv_rows)
     return rows[:topk]
+
+
+def _run_guided(cells, to_run, replayed, cached, base_strategy, model,
+                diagnostics, topk, run_kwargs, global_batch_size,
+                system):
+    """Pareto-guided evaluation (docs/search.md "Guided search"):
+    screen every schedulable cell with one cheap batched-kernel score,
+    fully evaluate only the Pareto frontier over
+    (iter_time, peak_mem, comm_fraction) plus seeds and their local
+    neighborhoods, then iteratively refine around the current top-k
+    until no unevaluated neighbor remains. Returns
+    ``(outcomes, screened_rows)`` — outcomes only for evaluated cells;
+    the screened-but-skipped cells become auditable ``status=screened``
+    CSV rows. Journaling/resume ride the normal ``run_cells``
+    checkpoint hook, so a killed guided sweep resumes like a grid one."""
+    from simumax_tpu.search import executor as _executor
+    from simumax_tpu.search.batched import UnsupportedBatched
+    from simumax_tpu.search.prune import (
+        CellNeighborhood,
+        pareto_frontier,
+        screened_row,
+    )
+
+    hood = CellNeighborhood(cells)
+    by_idx = {c.idx: c for c in cells}
+    to_run_by_idx = {c.idx: c for c in to_run}
+    scorer = _executor._batched_scorer(model, system)
+    screens: Dict[int, Optional[dict]] = {}
+    cell_strategies: Dict[int, object] = {}
+    must = set()
+    for cell in to_run:
+        st_c = make_cell_strategy(base_strategy, cell.tp, cell.cp,
+                                  cell.ep, cell.pp, cell.zero)
+        cell_strategies[cell.idx] = st_c
+        try:
+            tri = scorer.screen_cell(st_c, cell.rc, model,
+                                     global_batch_size)
+        except UnsupportedBatched:
+            must.add(cell.idx)  # unscreenable: evaluate unconditionally
+            continue
+        except Exception as exc:
+            # conservative: ANY screen failure (incl. a FeasibilityError
+            # the prune layer should have caught) must not skip the
+            # cell — evaluating it reproduces grid mode's verdict
+            # (quarantined error row) instead of silently dropping it
+            diagnostics.warn(
+                "search",
+                f"guided screen failed for {cell.key}: {exc}",
+            )
+            must.add(cell.idx)
+            continue
+        screens[cell.idx] = tri
+    diagnostics.count("sweep_cells_screened", len(screens) + len(must))
+    valid = {i: t for i, t in screens.items() if t is not None}
+    frontier = pareto_frontier({
+        i: (t["iter_time"], t["peak_bytes"], t["comm_fraction"])
+        for i, t in valid.items()
+    })
+    # seeds: the frontier plus the fastest-screened cells (covers
+    # frontier gaps when one objective dominates the ranking)
+    n_seed = max(topk, 4)
+    by_time = sorted(valid,
+                     key=lambda i: (valid[i]["iter_time"], i))[:n_seed]
+    seeds = set(frontier) | set(by_time)
+    selected = set(seeds) | must
+    for i in sorted(seeds):
+        for nb in hood.neighbors(by_idx[i]):
+            selected.add(nb.idx)
+    # already-settled cells (journal replay / store) participate in the
+    # refinement ranking but are never re-evaluated
+    rows_by_idx: Dict[int, dict] = {}
+    for idx, prior in list(replayed.items()) + list(cached.items()):
+        row = prior.get("row")
+        if prior.get("status") == "ok" and row and row.get("fits"):
+            rows_by_idx[idx] = row
+    outcomes: Dict[int, object] = {}
+    evaluated = set()
+    wave = sorted(i for i in selected if i in to_run_by_idx)
+    while wave:
+        got = run_cells([to_run_by_idx[i] for i in wave], **run_kwargs)
+        outcomes.update(got)
+        evaluated.update(wave)
+        for i, out in got.items():
+            if out.status == "ok" and out.row and out.row.get("fits"):
+                rows_by_idx[i] = out.row
+        # refine: expand around the current top-k until it stabilizes
+        top = sorted(
+            rows_by_idx,
+            key=lambda i: (-rows_by_idx[i]["mfu"], i),
+        )[:topk]
+        new = set()
+        for i in top:
+            cell = by_idx.get(i)
+            if cell is None:
+                continue
+            for nb in hood.neighbors(cell):
+                if nb.idx in to_run_by_idx and nb.idx not in selected:
+                    new.add(nb.idx)
+        selected |= new
+        wave = sorted(i for i in new if i not in evaluated)
+    screened_rows = []
+    for cell in to_run:
+        if cell.idx in selected:
+            continue
+        tri = screens.get(cell.idx)
+        if tri is None:
+            continue  # invalid family: an empty cell either way
+        screened_rows.append(
+            screened_row(cell_strategies[cell.idx], cell.rc, tri))
+    diagnostics.count("sweep_cells_guided_skipped", len(screened_rows))
+    return outcomes, screened_rows
 
 
 def _verify_topk_rows(rows, base_strategy, model, system, k,

@@ -25,6 +25,8 @@ from simumax_tpu.search.batched import (
     BatchedScorer,
     UnsupportedBatched,
     fold_1f1b,
+    fold_interleaved,
+    jax_available,
 )
 
 
@@ -186,6 +188,115 @@ class TestScoreParity:
                 assert float(batch[key][i]) == float(single[key][0])
 
 
+class TestNewFamilyParity:
+    """PR-11 coverage families: every configuration the kernel used to
+    route to the scalar path is now lowered and must match the scalar
+    oracle within 1e-9."""
+
+    def test_context_parallel_grid(self):
+        cases = [
+            dict(cp_size=2, tp_size=1),
+            dict(cp_size=2, tp_size=2),
+            dict(cp_size=4, tp_size=2),
+            dict(cp_size=2, tp_size=2, cp_comm_type="all_gather"),
+            dict(cp_size=4, tp_size=1, cp_comm_type="all_gather",
+                 pp_size=2),
+            dict(cp_size=2, tp_size=2, cp_a2a_mode="async_cp"),
+            dict(cp_size=2, tp_size=2, zero_state=3,
+                 cp_a2a_mode="async_cp"),
+            dict(cp_size=2, tp_size=2, enable_recompute=True,
+                 recompute_granularity="selective", attn_recompute=True,
+                 cp_a2a_mode="async_cp"),
+        ]
+        _assert_candidate_parity("llama2-tiny", "tpu_v5e_256", 8, cases)
+
+    def test_dropout_overlap_variance(self):
+        cases = [
+            dict(enable_dropout=True),
+            dict(enable_dropout=True, pp_size=2, enable_recompute=True,
+                 recompute_granularity="full_block",
+                 recompute_layer_num=1),
+            dict(overlap_grad_reduce=True),
+            dict(overlap_grad_reduce=True, overlap_param_gather=True,
+                 pp_size=2, micro_batch_num=8),
+            dict(overlap_grad_reduce=True, zero_state=2),
+            dict(enable_recompute=True,
+                 recompute_granularity="selective", attn_recompute=True,
+                 recompute_variance=True),
+            dict(enable_recompute=True,
+                 recompute_granularity="selective", sdp_recompute=True,
+                 mlp_recompute=True, recompute_variance=True,
+                 zero_state=3),
+        ]
+        _assert_candidate_parity("llama2-tiny", "tpu_v5e_256", 8, cases)
+
+    def test_vpp_grid(self):
+        cases = [
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8),
+            dict(pp_size=2, interleaving_size=4, micro_batch_num=8),
+            dict(pp_size=4, tp_size=2, interleaving_size=2,
+                 micro_batch_num=8),
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8,
+                 enable_recompute=True,
+                 recompute_granularity="full_block",
+                 recompute_layer_num=2),
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8,
+                 zero_state=2, overlap_grad_reduce=True,
+                 overlap_param_gather=True),
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8,
+                 pp_comm_async=False),
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8,
+                 microbatch_group_size_per_vp_stage=4),
+            dict(pp_size=2, interleaving_size=2, micro_batch_num=8,
+                 cp_size=2, enable_dropout=True),
+        ]
+        _assert_candidate_parity("llama3-8b", "tpu_v5p_256", 16, cases)
+
+    def test_fp8_and_pallas(self):
+        cases = [
+            dict(fp8=True),
+            dict(fp8=True, tp_size=2, pp_size=2, micro_batch_num=8),
+            dict(sdp_backend="pallas"),
+        ]
+        _assert_candidate_parity("llama3-8b", "tpu_v5p_256", 8, cases)
+        moe_cases = [
+            dict(fp8=True, ep_size=2),
+            dict(fp8=True, ep_size=2, group_linear_mode="sequential"),
+        ]
+        _assert_candidate_parity("mixtral-8x1b", "tpu_v5e_256", 8,
+                                 moe_cases)
+
+    def test_moe_module_families(self):
+        cases = [
+            dict(ep_size=2, dispatch_probs=True),
+            dict(ep_size=2, offload_groupgemm_col_inputs=True),
+            dict(ep_size=2, offload_groupgemm_col_inputs=True,
+                 enable_recompute=True,
+                 recompute_granularity="selective", mlp_recompute=True),
+            dict(ep_size=2, moe_act_recompute=True,
+                 enable_recompute=True,
+                 recompute_granularity="selective"),
+            dict(ep_size=2, megatron_recompute=True,
+                 enable_recompute=True,
+                 recompute_granularity="selective",
+                 megatron_recompute_modules=["moe_act", "layernorm"]),
+        ]
+        _assert_candidate_parity("mixtral-8x1b", "tpu_v5e_256", 8,
+                                 cases)
+
+    def test_mla_module_families(self):
+        cases = [
+            dict(tp_size=2, pp_size=3, ep_size=2,
+                 mla_up_proj_recompute=True, enable_recompute=True,
+                 recompute_granularity="selective"),
+            dict(tp_size=2, ep_size=2, cp_size=2),
+            dict(tp_size=1, pp_size=3, ep_size=2, interleaving_size=3,
+                 micro_batch_num=12),
+        ]
+        _assert_candidate_parity("deepseekv2-lite", "tpu_v5e_256", 12,
+                                 cases)
+
+
 # --------------------------------------------------------------------------
 # 1F1B fold == the scalar event-matched replay
 # --------------------------------------------------------------------------
@@ -222,6 +333,113 @@ class TestFold1F1B:
                 [p["bwd"] for p in phases], p2p, asy)
             assert got_total == want_total
             assert got_ends == want_ends
+
+
+class TestFoldInterleaved:
+    def _replay(self, pp, vp, mbc, group, fwd_t, bwd_t, p2p, asy):
+        import types
+
+        perf = PerfLLM.__new__(PerfLLM)
+        perf.strategy = types.SimpleNamespace(
+            pp_size=pp, micro_batch_num=mbc, vp_size=vp,
+            vpp_group_size=group, pp_comm_async=asy)
+        perf._interleaved_result = None
+        perf.chunks = {
+            (s, c): types.SimpleNamespace(
+                chunk_idx=c, stage_idx=s,
+                boundary_bytes=lambda: 1.0,
+                cost_info=types.SimpleNamespace(
+                    fwd_time=fwd_t[s][c], bwd_time=bwd_t[s][c]),
+            )
+            for s in range(pp) for c in range(vp)
+        }
+        perf.system = types.SimpleNamespace(
+            compute_net_op_time=lambda op, b, path: p2p)
+        perf.ctx = types.SimpleNamespace(path=lambda d: None)
+        res = perf.calculate_interleaved_schedule()
+        return res["total"], res["per_stage_end"]
+
+    def test_fold_matches_replay_fuzz(self):
+        rng = random.Random(4321)
+        for _ in range(60):
+            pp = rng.choice([2, 3, 4])
+            vp = rng.choice([2, 3])
+            group = pp * rng.choice([1, 2])
+            mbc = group * rng.randint(1, 4)
+            asy = rng.random() < 0.5
+            p2p = rng.uniform(0.0, 2.0)
+            fwd_t = [[rng.uniform(0.01, 5.0) for _ in range(vp)]
+                     for _ in range(pp)]
+            bwd_t = [[rng.uniform(0.01, 5.0) for _ in range(vp)]
+                     for _ in range(pp)]
+            want_total, want_ends = self._replay(
+                pp, vp, mbc, group, fwd_t, bwd_t, p2p, asy)
+            got_total, got_ends = fold_interleaved(
+                pp, vp, mbc, group, fwd_t, bwd_t, p2p, asy)
+            assert got_total == want_total
+            assert got_ends == want_ends
+
+
+# --------------------------------------------------------------------------
+# JIT backend: jax fold == numpy fold, bit for bit
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not jax_available(), reason="jax not importable")
+class TestJitBackend:
+    def _batch(self, n):
+        splits = [(1, 8), (2, 4), (4, 2), (8, 1)]
+        mbs = [splits[i % 4][0] for i in range(n)]
+        mbc = [splits[i % 4][1] for i in range(n)]
+        nrc = [i % 3 for i in range(n)]
+        return mbs, mbc, nrc
+
+    def test_jit_bit_identical_to_numpy(self):
+        import numpy as np
+
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        st = _base(8, tp_size=2, pp_size=2, enable_recompute=True,
+                   recompute_granularity="full_block",
+                   recompute_layer_num=1)
+        kern = BatchedScorer(model, system).kernel_for(st)
+        mbs, mbc, nrc = self._batch(64)
+        a = kern.score(mbs, mbc, nrc=nrc, backend="numpy")
+        b = kern.score(mbs, mbc, nrc=nrc, backend="jax")
+        for key in ("iter_time", "mfu", "tgs", "max_peak_bytes",
+                    "fits_margin_bytes"):
+            assert np.array_equal(a[key], b[key]), key
+
+    def test_auto_backend_bit_identical_above_threshold(self):
+        import numpy as np
+
+        from simumax_tpu.search.batched import JIT_GROUP_MIN
+
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        st = _base(8, pp_size=2)
+        kern = BatchedScorer(model, system).kernel_for(st)
+        n = 2 * JIT_GROUP_MIN
+        mbs, mbc, nrc = self._batch(n)
+        a = kern.score(mbs, mbc, nrc=nrc, backend="numpy")
+        b = kern.score(mbs, mbc, nrc=nrc, backend="auto")
+        for key in ("iter_time", "mfu", "max_peak_bytes"):
+            assert np.array_equal(a[key], b[key]), key
+
+    def test_blocking_p2p_and_margin_paths(self):
+        import numpy as np
+
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        st = _base(8, pp_size=2, pp_comm_async=False)
+        kern = BatchedScorer(model, system).kernel_for(st)
+        mbs, mbc, nrc = self._batch(32)
+        a = kern.score(mbs, mbc, nrc=nrc, cost_margin=1.0,
+                       backend="numpy")
+        b = kern.score(mbs, mbc, nrc=nrc, cost_margin=1.0,
+                       backend="jax")
+        for key in ("iter_time", "mfu", "max_peak_bytes"):
+            assert np.array_equal(a[key], b[key]), key
 
 
 # --------------------------------------------------------------------------
@@ -328,10 +546,12 @@ class TestEngineParity:
 
 
 class TestFallbacks:
-    def test_vpp_cells_fall_back_to_scalar(self, tmp_path):
-        model = get_model_config("llama2-tiny")
-        system = get_system_config("tpu_v5e_256")
-        base = _base(8, interleaving_size=2)
+    def test_vpp_cells_are_batched(self, tmp_path):
+        """vp>1 rides the kernel since PR 11 — no fallback, identical
+        rows (the whole-sweep-fallback contract of PR 8 is gone)."""
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        base = _base(16, interleaving_size=2)
         lists = dict(tp_list=(1, 2), pp_list=(2,), zero_list=(1,))
         rows_s, _ = _run_engine("scalar", model, system, base, 16,
                                 tmp_path / "s.csv", **lists)
@@ -339,13 +559,15 @@ class TestFallbacks:
                                      tmp_path / "b.csv", **lists)
         assert [_row_key_live(r) for r in rows_s] == \
             [_row_key_live(r) for r in rows_b]
-        # whole-cell fallback: nothing was batched
-        assert not diag_b.counters.get("sweep_cells_batched")
-        # fallback rows are scalar rows — identical floats
+        assert diag_b.counters.get("sweep_cells_batched")
+        assert not diag_b.counters.get("sweep_batched_fallbacks")
         for a, b in zip(rows_s, rows_b):
             assert a["mfu"] == b["mfu"]
 
-    def test_dualpp_falls_back_with_warning(self):
+    def test_dualpp_falls_back_per_cell_with_histogram(self):
+        """project_dualpp needs the built scalar estimate: every cell
+        falls back individually, counted by reason — never a silent
+        whole-sweep downgrade."""
         model = get_model_config("llama2-tiny")
         system = get_system_config("tpu_v5e_256")
         diag = Diagnostics()
@@ -356,7 +578,25 @@ class TestFallbacks:
             project_dualpp=True,
         )
         assert rows and "dualpp_mfu" in rows[0]
+        assert diag.counters.get("sweep_batched_fallbacks") == 3
+        assert diag.counters.get(
+            "sweep_batched_fallback[project_dualpp]") == 3
+        assert rows[0].get("batched_fallback") == "project_dualpp"
         assert any("batched" in w.message for w in diag.warnings)
+
+    def test_simulate_falls_back_per_cell_with_histogram(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        diag = Diagnostics()
+        rows = search_best_parallel_strategy(
+            _base(8), model, system, 8,
+            tp_list=(1,), pp_list=(1,), zero_list=(1,),
+            recompute_types=("none",),
+            topk=2, diagnostics=diag, engine="batched", simulate=True,
+        )
+        assert rows and "sim_ms" in rows[0]
+        assert diag.counters.get(
+            "sweep_batched_fallback[simulate]") == 1
 
     def test_unknown_engine_rejected(self):
         model = get_model_config("llama2-tiny")
@@ -370,13 +610,133 @@ class TestFallbacks:
                 engine="warp-drive",
             )
 
-    def test_unsupported_feature_raises_for_kernel(self):
+    def test_residual_contract_raises_for_kernel(self):
+        """The residual check_supported surface: an unknown recompute
+        granularity must still route to the scalar oracle instead of
+        being silently scored as one of the known three."""
         model = get_model_config("llama2-tiny")
         system = get_system_config("tpu_v5e_256")
         scorer = BatchedScorer(model, system)
-        st = _base(8, cp_size=2, tp_size=1)
+        st = _base(8)
+        st.recompute.granularity = "experimental_granularity"
         with pytest.raises(UnsupportedBatched):
             scorer.kernel_for(st)
+
+
+class TestGuidedSearch:
+    """Pareto-guided search: top-k must reproduce the exhaustive
+    grid's, while evaluating strictly fewer cells on the wide grids."""
+
+    @staticmethod
+    def _run(base, model, system, gbs, mode, diag=None, **kw):
+        diag = diag if diag is not None else Diagnostics()
+        rows = search_best_parallel_strategy(
+            copy.deepcopy(base), model, system, gbs, topk=5,
+            diagnostics=diag, search_mode=mode, **kw)
+        return rows, diag
+
+    def test_guided_matches_grid_topk_fewer_cells(self):
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        base = _base(64)
+        lists = dict(tp_list=(1, 2, 4, 8), pp_list=(1, 2, 4, 8),
+                     zero_list=(0, 1, 2, 3), engine="batched")
+        rows_g, diag_g = self._run(base, model, system, 64, "grid",
+                                   **lists)
+        rows_u, diag_u = self._run(base, model, system, 64, "guided",
+                                   **lists)
+        assert [_row_key_live(r) for r in rows_g] == \
+            [_row_key_live(r) for r in rows_u]
+        assert [r["mfu"] for r in rows_g] == [r["mfu"] for r in rows_u]
+        n_grid = diag_g.counters["sweep_cells_evaluated"]
+        n_guided = diag_u.counters["sweep_cells_evaluated"]
+        assert n_guided < n_grid
+        assert diag_u.counters.get("sweep_cells_guided_skipped")
+
+    def test_guided_seeded_small_grids(self):
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        rng = random.Random(20260803)
+        for _ in range(3):
+            tp_list = tuple(sorted(rng.sample([1, 2, 4], 2)))
+            pp_list = tuple(sorted(rng.sample([1, 2], 2)))
+            zero_list = tuple(sorted(rng.sample([0, 1, 2, 3], 2)))
+            lists = dict(tp_list=tp_list, pp_list=pp_list,
+                         zero_list=zero_list, engine="batched")
+            base = _base(8)
+            rows_g, _ = self._run(base, model, system, 16, "grid",
+                                  **lists)
+            rows_u, _ = self._run(base, model, system, 16, "guided",
+                                  **lists)
+            # guided top-k ⊇ exhaustive top-k (here: identical lists)
+            assert [_row_key_live(r) for r in rows_g] == \
+                [_row_key_live(r) for r in rows_u], (tp_list, pp_list,
+                                                     zero_list)
+
+    def test_guided_journal_resume(self, tmp_path):
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        base = _base(64)
+        lists = dict(tp_list=(1, 2, 4), pp_list=(1, 2, 4),
+                     zero_list=(1, 3), engine="batched")
+        journal = str(tmp_path / "guided.jsonl")
+        rows1, diag1 = self._run(base, model, system, 64, "guided",
+                                 journal_path=journal, **lists)
+        assert diag1.counters["sweep_cells_evaluated"] > 0
+        rows2, diag2 = self._run(base, model, system, 64, "guided",
+                                 resume=journal, **lists)
+        # every previously evaluated cell replays from the journal
+        assert diag2.counters["sweep_cells_evaluated"] == 0
+        assert diag2.counters["sweep_cells_replayed"] == \
+            diag1.counters["sweep_cells_evaluated"]
+        assert [_row_key_live(r) for r in rows1] == \
+            [_row_key_live(r) for r in rows2]
+
+    def test_guided_grid_journals_refuse_cross_mode_resume(
+            self, tmp_path):
+        from simumax_tpu.core.config import ConfigError
+
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        lists = dict(tp_list=(1, 2), pp_list=(1,), zero_list=(1,),
+                     engine="batched")
+        journal = str(tmp_path / "grid.jsonl")
+        self._run(_base(8), model, system, 16, "grid",
+                  journal_path=journal, **lists)
+        with pytest.raises(ConfigError):
+            self._run(_base(8), model, system, 16, "guided",
+                      resume=journal, **lists)
+
+    def test_guided_csv_screened_rows(self, tmp_path):
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        csv_path = tmp_path / "guided.csv"
+        diag = Diagnostics()
+        self._run(_base(64), model, system, 64, "guided",
+                  csv_path=str(csv_path), engine="batched",
+                  tp_list=(1, 2, 4, 8), pp_list=(1, 2, 4, 8),
+                  zero_list=(0, 1, 2, 3), diag=diag)
+        rows = _csv_rows(csv_path)
+        screened = [r for r in rows if r.get("status") == "screened"]
+        assert len(screened) == diag.counters[
+            "sweep_cells_guided_skipped"]
+        assert screened and screened[0]["screen_iter_ms"]
+        # a screened cell must not also appear as a result row
+        result_keys = {_row_key(r) for r in rows
+                       if r.get("status") in ("", "ok")}
+        assert not result_keys & {_row_key(r) for r in screened}
+
+    def test_unknown_search_mode_rejected(self):
+        from simumax_tpu.core.config import ConfigError
+
+        model = get_model_config("llama2-tiny")
+        system = get_system_config("tpu_v5e_256")
+        with pytest.raises(ConfigError):
+            search_best_parallel_strategy(
+                _base(8), model, system, 8,
+                tp_list=(1,), pp_list=(1,), zero_list=(1,),
+                search_mode="telepathic",
+            )
 
 
 def _row_key_live(r):

@@ -805,6 +805,48 @@ class TestSeededMutations:
         _, ids = self._run(real_tree)
         assert ids == []
 
+    def test_sim002_unmirrored_model_field(self, real_tree):
+        # the PR-11 extension: a MODEL field the scalar cost path
+        # starts reading must reach the batched kernel too
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            "    use_causal_attention: bool = True",
+            "    use_causal_attention: bool = True\n"
+            "    model_drift_knob: int = 0",
+        )
+        patch_file(
+            real_tree, "simumax_tpu/perf.py",
+            "    st, m = strategy, model\n",
+            "    st, m = strategy, model\n"
+            "    _mdrift = model.model_drift_knob\n",
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM002"], [f.render() for f in report.findings]
+        assert any("model field 'model_drift_knob'" in f.message
+                   for f in report.findings)
+
+    def test_sim002_negative_model_field_mirrored(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/core/config.py",
+            "    use_causal_attention: bool = True",
+            "    use_causal_attention: bool = True\n"
+            "    model_drift_knob: int = 0",
+        )
+        patch_file(
+            real_tree, "simumax_tpu/perf.py",
+            "    st, m = strategy, model\n",
+            "    st, m = strategy, model\n"
+            "    _mdrift = model.model_drift_knob\n",
+        )
+        patch_file(
+            real_tree, "simumax_tpu/search/batched.py",
+            "        self.paths = place_strategy_paths(st, system)",
+            "        self.paths = place_strategy_paths(st, system)\n"
+            "        _mdrift = self.model.model_drift_knob",
+        )
+        _, ids = self._run(real_tree)
+        assert ids == []
+
     def test_sim003_unsorted_merge_iteration(self, real_tree):
         path = os.path.join(str(real_tree),
                             "simumax_tpu/search/searcher.py")

@@ -34,18 +34,66 @@ _KEY = ("tp", "cp", "ep", "pp", "zero", "mbs", "mbc", "recompute",
         "recompute_layers")
 _METRICS = ("mfu", "iter_ms", "tgs", "peak_gib", "mem_margin_gib")
 
+#: PR-11 coverage-family variants (--coverage): each runs both engines
+#: on a small grid whose base strategy exercises one of the newly
+#: lowered families, so a coverage regression shows up as a parity
+#: delta in the forensics artifact, per family
+COVERAGE_VARIANTS = {
+    "vpp": dict(model="llama3-8b", system="tpu_v5p_256", world=16,
+                gbs=16, tp_list=(1, 2), pp_list=(2,), zero_list=(1,),
+                base=dict(interleaving_size=2)),
+    "cp": dict(model="llama2-tiny", system="tpu_v5e_256", world=8,
+               gbs=16, tp_list=(1, 2), pp_list=(1,), zero_list=(1,),
+               cp_list=(1, 2)),
+    "fp8": dict(model="llama3-8b", system="tpu_v5p_256", world=8,
+                gbs=16, tp_list=(1, 2), pp_list=(1, 2), zero_list=(1,),
+                base=dict(fp8=True)),
+    "dropout_overlap": dict(
+        model="llama2-tiny", system="tpu_v5e_256", world=8, gbs=16,
+        tp_list=(1, 2), pp_list=(1, 2), zero_list=(1, 2),
+        base=dict(enable_dropout=True, overlap_grad_reduce=True,
+                  overlap_param_gather=True)),
+    "dispatch_probs": dict(
+        model="mixtral-8x1b", system="tpu_v5e_256", world=8, gbs=8,
+        tp_list=(1, 2), pp_list=(1,), zero_list=(1,), ep_list=(2,),
+        base=dict(dispatch_probs=True)),
+    "offload": dict(
+        model="mixtral-8x1b", system="tpu_v5e_256", world=8, gbs=8,
+        tp_list=(1, 2), pp_list=(1,), zero_list=(1,), ep_list=(2,),
+        base=dict(offload_groupgemm_col_inputs=True),
+        recompute_types=("none", "selective")),
+    "moe_act_variance": dict(
+        model="mixtral-8x1b", system="tpu_v5e_256", world=8, gbs=8,
+        tp_list=(1,), pp_list=(1, 2), zero_list=(1,), ep_list=(2,),
+        base=dict(moe_act_recompute=True, recompute_variance=True)),
+    "mla_up": dict(
+        model="deepseekv2-lite", system="tpu_v5e_256", world=12,
+        gbs=12, tp_list=(1, 2), pp_list=(1,), zero_list=(1,),
+        ep_list=(2,), base=dict(mla_up_proj_recompute=True)),
+}
+
 
 def _run(engine, spec, csv_path):
     model = get_model_config(spec["model"])
     system = get_system_config(spec["system"])
     base = get_strategy_config("tp1_pp1_dp8_mbs1")
     base.world_size = spec["world"]
+    for k, v in spec.get("base", {}).items():
+        setattr(base, k, v)
+    base.__post_init__()
     diag = Diagnostics()
+    kwargs = {}
+    if "cp_list" in spec:
+        kwargs["cp_list"] = spec["cp_list"]
+    if "ep_list" in spec:
+        kwargs["ep_list"] = spec["ep_list"]
+    if "recompute_types" in spec:
+        kwargs["recompute_types"] = spec["recompute_types"]
     rows = search_best_parallel_strategy(
         base, model, system, spec["gbs"],
         tp_list=spec["tp_list"], pp_list=spec["pp_list"],
         zero_list=spec["zero_list"], topk=5, csv_path=csv_path,
-        diagnostics=diag, engine=engine,
+        diagnostics=diag, engine=engine, **kwargs,
     )
     import csv as _csv
 
@@ -54,12 +102,7 @@ def _run(engine, spec, csv_path):
     return rows, csv_rows, diag
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--grid", choices=sorted(GRIDS), default="standard")
-    ap.add_argument("--out", default="batched_parity_diff.json")
-    args = ap.parse_args(argv)
-    spec = GRIDS[args.grid]
+def _compare(spec):
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
@@ -90,8 +133,7 @@ def main(argv=None):
     def status_set(rows, status):
         return sorted(key(r) for r in rows if r.get("status") == status)
 
-    report = {
-        "grid": args.grid,
+    return {
         "topk_scalar": [{k: r[k] for k in _KEY} for r in rows_s],
         "topk_batched": [{k: r[k] for k in _KEY} for r in rows_b],
         "topk_ordering_identical": (
@@ -107,14 +149,43 @@ def main(argv=None):
             e.to_dict() for e in diag_b.errors
         ],
     }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--grid", choices=sorted(GRIDS), default="standard")
+    ap.add_argument(
+        "--coverage", action="store_true",
+        help="also diff every PR-11 coverage-family variant (vpp, cp, "
+             "fp8, dropout/overlap, dispatch_probs, offload, "
+             "moe_act/variance, mla_up) on small dedicated grids",
+    )
+    ap.add_argument("--out", default="batched_parity_diff.json")
+    args = ap.parse_args(argv)
+    report = {"grid": args.grid, **_compare(GRIDS[args.grid])}
+    ok = report["topk_ordering_identical"] \
+        and not report["ok_row_deltas_beyond_1e9"]
+    if args.coverage:
+        report["coverage_variants"] = {}
+        for name, spec in COVERAGE_VARIANTS.items():
+            sub = _compare(spec)
+            report["coverage_variants"][name] = sub
+            ok = ok and sub["topk_ordering_identical"] \
+                and not sub["ok_row_deltas_beyond_1e9"]
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1, default=str)
     print(json.dumps({
         "out": args.out,
         "topk_ordering_identical": report["topk_ordering_identical"],
-        "deltas_beyond_1e9": len(deltas),
+        "deltas_beyond_1e9": len(report["ok_row_deltas_beyond_1e9"]),
+        "coverage_variants_ok": (
+            {n: (v["topk_ordering_identical"]
+                 and not v["ok_row_deltas_beyond_1e9"])
+             for n, v in report.get("coverage_variants", {}).items()}
+            if args.coverage else None
+        ),
     }))
-    return 0 if report["topk_ordering_identical"] and not deltas else 1
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -63,12 +63,29 @@ EXEMPT: Dict[str, str] = {
     ),
 }
 
+#: scalar-read ModelConfig fields deliberately absent from the batched
+#: mirror surface. The model is part of the BatchedScorer's identity
+#: (one scorer per (model, system) — never shared across models), so
+#: model fields need no profile-key entry; this list instead polices
+#: that every model field the scalar COST path consumes is read
+#: somewhere in the kernel's lowering. Stale entries are reported.
+EXEMPT_MODEL: Dict[str, str] = {
+    "model_name": (
+        "presentation only: error messages and result base_info, never "
+        "a cost input"
+    ),
+    "dense_layers": (
+        "reaches the kernel through the dense_layer_num property "
+        "(model_type-guarded alias the kernel reads directly)"
+    ),
+}
 
-def _strategy_vocabulary(config_tree: ast.AST) -> Set[str]:
+
+def _class_vocabulary(config_tree: ast.AST, cls_name: str) -> Set[str]:
     vocab: Set[str] = set()
     for cls in config_tree.body:
         if not (isinstance(cls, ast.ClassDef)
-                and cls.name == "StrategyConfig"):
+                and cls.name == cls_name):
             continue
         for stmt in cls.body:
             if isinstance(stmt, ast.AnnAssign) and isinstance(
@@ -80,6 +97,10 @@ def _strategy_vocabulary(config_tree: ast.AST) -> Set[str]:
                     if isinstance(dec, ast.Name) and dec.id == "property":
                         vocab.add(stmt.name)
     return vocab
+
+
+def _strategy_vocabulary(config_tree: ast.AST) -> Set[str]:
+    return _class_vocabulary(config_tree, "StrategyConfig")
 
 
 def _attribute_reads(tree: ast.AST, vocab: Set[str]):
@@ -116,14 +137,23 @@ class BatchedDriftChecker:
         if config is None or config.tree is None \
                 or batched is None or batched.tree is None:
             return
-        vocab = _strategy_vocabulary(config.tree)
-        if not vocab:
-            return
-
         scalar_files = [
             pf for rel in SCALAR_RELS
             if (pf := project.find(rel)) is not None
         ] + project.under(SCALAR_DIR)
+        for cls_name, exempt, what in (
+            ("StrategyConfig", EXEMPT, "strategy"),
+            ("ModelConfig", EXEMPT_MODEL, "model"),
+        ):
+            vocab = _class_vocabulary(config.tree, cls_name)
+            if not vocab:
+                continue
+            yield from self._check_vocab(
+                project, batched, scalar_files, vocab, exempt, what)
+
+    def _check_vocab(self, project: Project, batched, scalar_files,
+                     vocab: Set[str], exempt: Dict[str, str],
+                     what: str):
         reads: Dict[str, Tuple[str, int]] = {}
         for pf in scalar_files:
             if pf.tree is None:
@@ -136,32 +166,32 @@ class BatchedDriftChecker:
         mirror = {n for n, _ in _attribute_reads(batched.tree, vocab)}
         mirror |= _kind_fields_strings(batched.tree) & vocab
 
-        for name in sorted(set(reads) - mirror - set(EXEMPT)):
+        for name in sorted(set(reads) - mirror - set(exempt)):
             rel, lineno = reads[name]
             yield Finding(
                 ID, rel, lineno,
-                f"strategy field {name!r} is read by the scalar cost "
+                f"{what} field {name!r} is read by the scalar cost "
                 f"path but reaches neither search/batched.py's "
-                f"_KIND_FIELDS profile key nor its UnsupportedBatched "
-                f"guard — the batched engine would share profiles "
-                f"across layouts that differ on it. Mirror it or guard "
-                f"it (docs/search.md), or exempt it with a "
-                f"justification in "
-                f"tools/staticcheck/checkers/batched_drift.py",
+                f"_KIND_FIELDS profile key nor any of its attribute "
+                f"reads (incl. the UnsupportedBatched guard surface) — "
+                f"the batched engine would silently ignore a "
+                f"configuration it must model. Mirror it or guard it "
+                f"(docs/search.md), or exempt it with a justification "
+                f"in tools/staticcheck/checkers/batched_drift.py",
             )
-        for name in sorted(EXEMPT):
+        for name in sorted(exempt):
             if name in mirror:
                 yield Finding(
                     ID, batched.rel, 1,
-                    f"stale batched-drift exemption {name!r}: "
+                    f"stale batched-drift {what} exemption {name!r}: "
                     f"search/batched.py now mirrors it — remove the "
                     f"exemption",
                 )
             elif name not in reads:
                 yield Finding(
                     ID, batched.rel, 1,
-                    f"stale batched-drift exemption {name!r}: the "
-                    f"scalar cost path no longer reads it — remove "
+                    f"stale batched-drift {what} exemption {name!r}: "
+                    f"the scalar cost path no longer reads it — remove "
                     f"the exemption",
                 )
 
