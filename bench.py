@@ -49,6 +49,13 @@ def load_last_result():
     except (OSError, ValueError):
         return None
 
+
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
 import warnings
 
 warnings.filterwarnings("ignore")
@@ -209,6 +216,7 @@ def main():
         )
     persist_result(result)
     print(json.dumps(result))
+    record_safely(result)
 
 
 def _tunnel_alive(timeout_s=100, retries=2):

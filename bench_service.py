@@ -46,6 +46,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
 import warnings
 
 warnings.filterwarnings("ignore")
@@ -294,10 +300,20 @@ def main(argv=None):
     ap.add_argument("--skip-parity", action="store_true",
                     help="skip the bit-identity sample check (it "
                          "re-evaluates a few queries cache-off)")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm span recording (observe/telemetry.py) "
+                         "for the whole burst — the telemetry-overhead "
+                         "gate runs the bench this way and compares "
+                         "against the tracing-off baseline")
     args = ap.parse_args(argv)
 
     from simumax_tpu.service.planner import Planner
     from simumax_tpu.service.server import make_server
+
+    if args.trace:
+        from simumax_tpu.observe.telemetry import get_tracer
+
+        get_tracer().configure(enabled=True)
 
     tmp = None
     cache_dir = args.cache_dir
@@ -398,7 +414,10 @@ def main(argv=None):
         )
         result["regression_ok"] = qps_warm >= floor
         ok = ok and result["regression_ok"]
+    if args.trace:
+        result["trace"] = True
     print(json.dumps(result))
+    record_safely(result)
     return 0 if ok else 1
 
 

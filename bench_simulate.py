@@ -46,6 +46,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
 import warnings
 
 warnings.filterwarnings("ignore")
@@ -250,6 +256,7 @@ def main(argv=None):
         ok = result["value"] >= floor
         result["regression_ok"] = ok
     print(json.dumps(result))
+    record_safely(result)
     return 0 if ok else 1
 
 

@@ -42,6 +42,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
 import warnings
 
 warnings.filterwarnings("ignore")
@@ -364,6 +370,7 @@ def main(argv=None):
             result["kernel_speedup_ok"] = k_ok
             ok = ok and k_ok
     print(json.dumps(result))
+    record_safely(result)
     return 0 if ok else 1
 
 

@@ -148,6 +148,60 @@ def _diagnosed(diag, args):
     _check_strict(diag, args)
 
 
+@contextlib.contextmanager
+def _traced(args, name):
+    """Run a command body under a root telemetry trace when
+    ``--trace-requests PATH`` was given: span recording is armed, the
+    body's spans (planner store lookups, single-flight waits,
+    evaluations, sweep cells, DES replays) nest under one root span,
+    and on exit the span tree is dumped to ``PATH`` (JSON) plus a
+    Chrome-trace rendering at ``PATH.chrome.json`` for the trace
+    viewer. Without the flag this is a no-op — no ids, no records."""
+    path = getattr(args, "trace_requests", None)
+    if not path:
+        yield
+        return
+    from simumax_tpu.observe.telemetry import (
+        get_tracer,
+        span_tree,
+        write_chrome_trace,
+    )
+
+    tracer = get_tracer()
+    was_enabled = tracer.enabled
+    tracer.configure(enabled=True)
+    trace_id = None
+    try:
+        with tracer.trace(name) as trace_id:
+            yield
+    finally:
+        # dump inside the finally: a command that raises mid-run is
+        # exactly the one whose span tree is wanted, and the drain
+        # must happen regardless or the recorded spans leak into the
+        # next _traced command in this process
+        tracer.configure(enabled=was_enabled)
+        spans = tracer.drain()
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump({"trace_id": trace_id, "command": name,
+                           "spans": span_tree(spans)}, f, indent=1,
+                          default=str)
+            chrome = path + ".chrome.json"
+            write_chrome_trace(spans, chrome)
+        except OSError as exc:  # never mask the command's own error
+            _log().warning(
+                f"[trace] could not write {path}: {exc}",
+                event="trace_requests_error", path=path,
+            )
+        else:
+            _log().info(
+                f"[trace] {len(spans)} spans (trace {trace_id}) -> "
+                f"{path} (+ {chrome})",
+                event="trace_requests", spans=len(spans),
+                trace_id=trace_id, path=path,
+            )
+
+
 def cmd_list(args):
     from simumax_tpu.core.config import list_configs
 
@@ -193,6 +247,11 @@ def _make_planner(args):
 
 
 def cmd_perf(args):
+    with _traced(args, "perf"):
+        return _cmd_perf(args)
+
+
+def _cmd_perf(args):
     # artifact-producing runs (--save/--simulate/--graph) need the
     # built PerfLLM; everything else is a pure function of the configs
     # and routes through the planner so one-shot CLI calls populate
@@ -295,7 +354,7 @@ def cmd_search(args):
     from simumax_tpu.core.records import Diagnostics
 
     diag = Diagnostics(strict=args.strict)
-    with _diagnosed(diag, args):
+    with _traced(args, "search"), _diagnosed(diag, args):
         _run_search(args, diag)
 
 
@@ -975,16 +1034,27 @@ def cmd_serve(args):
         enabled=_cache_enabled(args),
         max_bytes=max_bytes,
     )
-    srv = make_server(planner, args.host, args.port)
+    trace_log = None
+    if args.trace_requests:
+        # per-request span trees: one JSON line per served request,
+        # appended for the server's lifetime
+        from simumax_tpu.observe.telemetry import get_tracer
+
+        os.makedirs(args.trace_requests, exist_ok=True)
+        trace_log = os.path.join(args.trace_requests, "requests.jsonl")
+        get_tracer().configure(enabled=True)
+    srv = make_server(planner, args.host, args.port,
+                      trace_log=trace_log)
     host, port = srv.server_address[:2]
     cache_desc = (
         planner.store.root if planner.enabled else "disabled"
     )
     _log().info(
         f"[serve] planning service on http://{host}:{port} "
-        f"(cache: {cache_desc}) — GET /healthz /stats, "
+        f"(cache: {cache_desc}) — GET /healthz /stats /metrics, "
         f"POST /v1/estimate /v1/explain /v1/search /v1/faults "
-        f"/v1/simulate",
+        f"/v1/simulate"
+        + (f"; request traces -> {trace_log}" if trace_log else ""),
         event="serve_start", host=host, port=port, cache=cache_desc,
     )
     serve_forever(srv)
@@ -1114,6 +1184,25 @@ def main(argv=None):
                  "quarantined failure",
         )
 
+    def _add_trace_args(parser, serve: bool = False):
+        if serve:
+            parser.add_argument(
+                "--trace-requests", metavar="DIR",
+                help="record telemetry spans for every served request "
+                     "and append each request's span tree as one JSON "
+                     "line to DIR/requests.jsonl (trace ids match the "
+                     "X-SimuMax-Trace response headers)",
+            )
+        else:
+            parser.add_argument(
+                "--trace-requests", metavar="PATH",
+                help="record telemetry spans (store lookups, "
+                     "single-flight waits, evaluations, sweep cells, "
+                     "DES replays) for this run and dump the span "
+                     "tree to PATH plus a Chrome trace to "
+                     "PATH.chrome.json",
+            )
+
     pp = sub.add_parser("perf", help="estimate one configuration")
     pp.add_argument("--model", required=True)
     pp.add_argument("--strategy", required=True)
@@ -1152,6 +1241,7 @@ def main(argv=None):
     _add_diag_args(pp)
     _add_log_args(pp)
     _add_cache_args(pp)
+    _add_trace_args(pp)
     pp.set_defaults(fn=cmd_perf)
 
     pe = sub.add_parser(
@@ -1341,6 +1431,7 @@ def main(argv=None):
     _add_diag_args(ps)
     _add_log_args(ps)
     _add_cache_args(ps)
+    _add_trace_args(ps)
     ps.set_defaults(fn=cmd_search)
 
     pc = sub.add_parser(
@@ -1441,6 +1532,7 @@ def main(argv=None):
     )
     _add_cache_args(psv)
     _add_log_args(psv)
+    _add_trace_args(psv, serve=True)
     psv.set_defaults(fn=cmd_serve)
 
     pca = sub.add_parser(

@@ -436,8 +436,10 @@ class Diagnostics:
         self._eff_misses: Dict[str, set] = {}
         #: free-form numeric counters (sweep cell accounting: total /
         #: pruned / evaluated / replayed / quarantined cells, worker
-        #: count, pool restarts, ...) — reported, never a violation
-        self.counters: Dict[str, float] = {}
+        #: count, pool restarts, ...) — reported, never a violation;
+        #: writes mirror into the ``diag_counter`` registry gauge so
+        #: a running sweep is observable from ``GET /metrics``
+        self.counters: Dict[str, float] = _MirroredCounters()
 
     @classmethod
     def active(cls) -> Optional["Diagnostics"]:
@@ -671,6 +673,24 @@ class Diagnostics:
         if self.miss_count:
             out.append(f"{self.miss_count} efficiency-table miss(es)")
         return out
+
+
+class _MirroredCounters(dict):
+    """The free-form ``Diagnostics.counters`` dict, with every numeric
+    write mirrored into the process-wide metrics registry as a
+    ``diag_counter{name=...}`` gauge (``observe/telemetry.py``) — so
+    sweep cell accounting is scrapeable from ``GET /metrics`` while a
+    long sweep runs. Mirroring is observe-only: the dict (and every
+    payload built from it) is byte-identical to a plain dict."""
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        if isinstance(value, (int, float)) and not isinstance(
+                value, bool):
+            from simumax_tpu.observe.telemetry import get_registry
+
+            get_registry().gauge("diag_counter",
+                                 name=str(key)).set(value)
 
 
 @dataclass

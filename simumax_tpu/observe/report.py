@@ -11,7 +11,11 @@ Two output modes, switched by the CLI's ``--log-json`` flag:
 * **json** (``--log-json``): one JSON object per line with ``ts``
   (epoch seconds), ``level``, ``run_id``, ``msg``, plus any structured
   fields the call site attached — machine-ingestable run logs that
-  merge/attribute across processes via the run identity.
+  merge/attribute across processes via the run identity. Lines emitted
+  inside an active telemetry trace (a served HTTP request, a
+  ``--trace-requests`` command) additionally carry ``trace_id`` /
+  ``span_id``, so run logs cross-reference span trees and the
+  ``X-SimuMax-Trace`` response header.
 
 ``--log-level`` filters: a call below the threshold emits nothing in
 either mode. ``debug`` lines only appear with ``--log-level debug``.
@@ -26,6 +30,7 @@ import uuid
 from typing import Any, Optional, TextIO
 
 from simumax_tpu.core.errors import ConfigError
+from simumax_tpu.observe.telemetry import current_ids as telemetry_ids
 
 LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
 
@@ -75,6 +80,9 @@ class Reporter:
                 "run_id": self.run_id,
                 "msg": msg,
             }
+            ids = telemetry_ids()
+            if ids is not None:
+                record["trace_id"], record["span_id"] = ids
             record.update(fields)
             out.write(json.dumps(record, default=str) + "\n")
         else:

@@ -146,6 +146,18 @@ def _evaluate_cell_guarded(cell: SweepCell, env: _Env, cache,
                            diagnostics) -> tuple:
     """Evaluate one cell under the per-candidate deadline. Never raises:
     returns (status, row, err_dict, exception)."""
+    from simumax_tpu.observe.telemetry import get_tracer
+
+    # observe-only span (no-op outside a traced request/command): one
+    # per evaluated cell, tagged with the engine that scored it
+    with get_tracer().span("evaluate_cell", cell=cell.key,
+                           engine=env.engine):
+        return _evaluate_cell_guarded_inner(cell, env, cache,
+                                            diagnostics)
+
+
+def _evaluate_cell_guarded_inner(cell: SweepCell, env: _Env, cache,
+                                 diagnostics) -> tuple:
     # late import: executor is imported by searcher at module load
     from simumax_tpu.search import searcher as _searcher
 

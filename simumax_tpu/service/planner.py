@@ -227,10 +227,18 @@ class Planner:
     def __init__(self, store: Optional[ContentStore] = None,
                  cache_dir: Optional[str] = None,
                  max_bytes: Optional[int] = None,
-                 enabled: bool = True):
+                 enabled: bool = True,
+                 registry=None):
+        from simumax_tpu.observe.telemetry import get_registry
+
+        #: metrics registry this planner (and the store it builds)
+        #: mirrors its counters into — the ``/metrics`` plane; the
+        #: per-instance dict below stays the ``stats()`` source
+        self.registry = registry or get_registry()
         if store is None and enabled:
             kwargs = {} if max_bytes is None else {"max_bytes": max_bytes}
-            store = ContentStore(cache_dir, **kwargs)
+            store = ContentStore(cache_dir, registry=self.registry,
+                                 **kwargs)
         self.store = store if enabled else None
         self.enabled = enabled and self.store is not None
         self._lock = threading.Lock()
@@ -245,6 +253,7 @@ class Planner:
     def _count(self, name: str, n: int = 1):
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+        self.registry.counter("planner_ops_total", op=name).inc(n)
 
     def _cached(self, namespace: str, identity: dict,
                 compute: Callable[[], Any],
@@ -256,16 +265,22 @@ class Planner:
         the stored bytes verbatim (no parse + re-dump), and the store
         serialization is the same function as the fresh-evaluation
         serialization, so the bytes are identical either way."""
+        from simumax_tpu.observe.telemetry import get_tracer
         from simumax_tpu.service.store import canonical_bytes
 
+        tracer = get_tracer()
         key = content_key(identity)
         if not self.enabled:
             self._count("evaluations")
-            payload = normalized(compute())
+            with tracer.span("evaluate", namespace=namespace,
+                             key=key[:16]):
+                payload = normalized(compute())
             return (canonical_bytes(payload) if raw else payload), \
                 False, key
-        got = self.store.get_bytes(namespace, key) if raw \
-            else self.store.get(namespace, key)
+        with tracer.span("store_lookup", namespace=namespace,
+                         key=key[:16]):
+            got = self.store.get_bytes(namespace, key) if raw \
+                else self.store.get(namespace, key)
         if got is not None:
             self._count("hits")
             return got, True, key
@@ -278,7 +293,9 @@ class Planner:
                 self._inflight[flight_key] = flight
         if not leader:
             self._count("singleflight_waits")
-            flight.event.wait()
+            with tracer.span("singleflight_wait", namespace=namespace,
+                             key=key[:16]):
+                flight.event.wait()
             if flight.error is not None:
                 raise flight.error
             result = flight.result
@@ -287,7 +304,9 @@ class Planner:
         try:
             self._count("misses")
             self._count("evaluations")
-            payload = normalized(compute())
+            with tracer.span("evaluate", namespace=namespace,
+                             key=key[:16]):
+                payload = normalized(compute())
             try:
                 # best-effort: an unwritable cache dir (read-only HOME,
                 # full disk) must not fail a query that evaluated fine
@@ -409,12 +428,15 @@ class Planner:
         system = self._loader.load("system", system)
 
         def compute(path=save_path):
+            from simumax_tpu.observe.telemetry import get_tracer
             from simumax_tpu.perf import PerfLLM
 
             perf = PerfLLM().configure(strategy, model, system)
             perf.run_estimate()
-            result = perf.simulate(path, granularity=granularity,
-                                   **kwargs)
+            with get_tracer().span("des_replay",
+                                   granularity=granularity):
+                result = perf.simulate(path, granularity=granularity,
+                                       **kwargs)
             result.pop("critical_path", None)
             return result
 
@@ -504,15 +526,18 @@ class Planner:
             load_batched_profiles(store, model, system,
                                   key=profiles_key)
         self._count("evaluations")
-        rows = search_best_parallel_strategy(
-            base, model, system, global_batch_size,
-            tp_list=tuple(tp_list), pp_list=tuple(pp_list),
-            ep_list=tuple(ep_list), cp_list=tuple(cp_list),
-            zero_list=tuple(zero_list), topk=topk,
-            csv_path=csv_path, journal_path=journal_path,
-            diagnostics=diag, jobs=jobs, engine=engine,
-            verify_topk=verify_topk, store=store, on_cell=on_cell,
-        )
+        from simumax_tpu.observe.telemetry import get_tracer
+
+        with get_tracer().span("sweep", engine=engine):
+            rows = search_best_parallel_strategy(
+                base, model, system, global_batch_size,
+                tp_list=tuple(tp_list), pp_list=tuple(pp_list),
+                ep_list=tuple(ep_list), cp_list=tuple(cp_list),
+                zero_list=tuple(zero_list), topk=topk,
+                csv_path=csv_path, journal_path=journal_path,
+                diagnostics=diag, jobs=jobs, engine=engine,
+                verify_topk=verify_topk, store=store, on_cell=on_cell,
+            )
         if engine == "batched":
             save_batched_profiles(store, model, system,
                                   key=profiles_key)

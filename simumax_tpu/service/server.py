@@ -17,6 +17,10 @@ dicts):
                       percentiles per endpoint, planner hit/miss/
                       single-flight counters, store size + eviction
                       counters
+``GET /metrics``      the same counters (plus everything else the
+                      process registered: DES gauges, diagnostics
+                      counters) in Prometheus text exposition format
+                      (``observe/telemetry.py``)
 ``POST /v1/estimate`` full analytical estimate (``Planner.estimate``)
 ``POST /v1/explain``  cost-attribution ledger + per-op rows
 ``POST /v1/search``   strategy sweep; ``"stream": true`` switches the
@@ -29,8 +33,10 @@ dicts):
 ====================  =====================================================
 
 Every response carries ``X-SimuMax-Cache: hit|miss`` (+ the
-content-addressed key in ``X-SimuMax-Key``); the *body* is the
-canonical payload either way. Config-family errors return 400 with
+content-addressed key in ``X-SimuMax-Key``) and an ``X-SimuMax-Trace``
+request-trace id (``observe/telemetry.py`` — the same id the request's
+spans and ``--log-json`` lines carry); the *body* is the canonical
+payload either way. Config-family errors return 400 with
 ``{"error": ...}``; unexpected failures 500. Request logging goes
 through the shared Reporter at debug level (``serve --log-level
 debug``).
@@ -41,11 +47,19 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from simumax_tpu.core.errors import ConfigError
+from simumax_tpu.observe.telemetry import (
+    Histogram,
+    MetricsRegistry,
+    PROMETHEUS_CONTENT_TYPE,
+    get_registry,
+    get_tracer,
+    render_prometheus,
+    span_tree,
+)
 from simumax_tpu.service.planner import Planner
 
 
@@ -70,41 +84,78 @@ def percentile(sorted_vals, q: float) -> float:
 
 
 class _ServiceStats:
-    """Thread-safe request/latency accounting behind ``/stats``."""
+    """Thread-safe request/latency accounting behind ``/stats``,
+    registry-backed (``observe/telemetry.py``).
 
-    def __init__(self, window: int = 8192):
+    Per-endpoint latency lives in bounded-reservoir histograms, so a
+    ``/stats`` (or ``/metrics``) snapshot sorts O(reservoir) samples —
+    never the full request stream, and never inside the lock
+    :meth:`record` takes. Request/error counts keep a per-instance
+    dict (the ``/stats`` schema, exactly as before) and mirror into
+    the shared registry for the Prometheus exposition."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
+        self.registry = registry or get_registry()
         self.started = time.time()
         self.requests: Dict[str, int] = {}
         self.errors = 0
-        self._lat: Dict[str, deque] = {}
-        self._window = window
+        #: per-instance latency histograms (one server's /stats must
+        #: not see another's traffic, so these are standalone
+        #: instruments, not registry lookups)
+        self._lat: Dict[str, Histogram] = {}
+        #: cached registry handles per endpoint — record() runs on
+        #: every request, so resolve each instrument (label-key build
+        #: + the process-wide registry lock) once, not per call
+        self._mirror: Dict[str, tuple] = {}
 
     def record(self, endpoint: str, elapsed_s: float, error: bool):
         with self._lock:
             self.requests[endpoint] = self.requests.get(endpoint, 0) + 1
             if error:
                 self.errors += 1
-            lat = self._lat.setdefault(
-                endpoint, deque(maxlen=self._window)
-            )
-            lat.append(elapsed_s)
+            lat = self._lat.get(endpoint)
+            if lat is None:
+                lat = self._lat[endpoint] = Histogram(
+                    "http_request_seconds", {"endpoint": endpoint}
+                )
+            mirror = self._mirror.get(endpoint)
+            if mirror is None:
+                mirror = self._mirror[endpoint] = (
+                    self.registry.counter(
+                        "http_requests_total", endpoint=endpoint
+                    ),
+                    self.registry.histogram(
+                        "http_request_seconds", endpoint=endpoint
+                    ),
+                )
+        lat.observe(elapsed_s)
+        # registry mirror: the scrapeable view of the same accounting
+        requests_total, request_seconds = mirror
+        requests_total.inc()
+        if error:
+            # errors are rare — resolved on demand so the counter only
+            # appears in /metrics once an error actually happened
+            self.registry.counter(
+                "http_errors_total", endpoint=endpoint
+            ).inc()
+        request_seconds.observe(elapsed_s)
 
     def snapshot(self) -> dict:
         with self._lock:
             requests = dict(self.requests)
             errors = self.errors
-            lat = {k: sorted(v) for k, v in self._lat.items()}
+            lat = dict(self._lat)
         uptime = time.time() - self.started
         total = sum(requests.values())
-        latency = {
-            k: {
-                "count": len(v),
-                "p50_ms": round(percentile(v, 0.50) * 1e3, 3),
-                "p99_ms": round(percentile(v, 0.99) * 1e3, 3),
+        latency = {}
+        for k, h in lat.items():
+            d = h.to_dict()  # one locked reservoir sort per endpoint
+            latency[k] = {
+                "count": d["count"],
+                "p50_ms": round(d["p50"] * 1e3, 3),
+                "p99_ms": round(d["p99"] * 1e3, 3),
             }
-            for k, v in lat.items()
-        }
         return {
             "uptime_s": round(uptime, 3),
             "requests": requests,
@@ -116,15 +167,40 @@ class _ServiceStats:
 
 
 class PlannerHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer carrying the shared planner + stats."""
+    """ThreadingHTTPServer carrying the shared planner + stats +
+    metrics registry (``GET /metrics`` renders it)."""
 
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, addr, planner: Planner):
+    def __init__(self, addr, planner: Planner,
+                 registry: Optional[MetricsRegistry] = None,
+                 trace_log: Optional[str] = None):
         super().__init__(addr, _Handler)
         self.planner = planner
-        self.stats = _ServiceStats()
+        self.registry = registry or planner.registry
+        self.stats = _ServiceStats(self.registry)
+        #: ``serve --trace-requests DIR``: finished request span trees
+        #: append to ``<DIR>/requests.jsonl`` (one JSON line each)
+        self.trace_log = trace_log
+        self._trace_log_lock = threading.Lock()
+
+    def write_trace(self, trace_id: str, endpoint: str):
+        """Append the finished request's span tree to the trace log
+        (no-op unless ``--trace-requests`` armed the tracer)."""
+        if not self.trace_log:
+            return
+        spans = get_tracer().pop_trace(trace_id)
+        if not spans:
+            return
+        line = json.dumps({
+            "trace_id": trace_id,
+            "endpoint": endpoint,
+            "spans": span_tree(spans),
+        }, default=str)
+        with self._trace_log_lock:
+            with open(self.trace_log, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -148,6 +224,13 @@ class _Handler(BaseHTTPRequestHandler):
             raise ConfigError("request body must be a JSON object")
         return data
 
+    def _send_trace_header(self):
+        """Stamp the active request trace id (every response path —
+        JSON, /metrics, streams — goes through this one helper)."""
+        trace_id = get_tracer().current_trace_id()
+        if trace_id:
+            self.send_header("X-SimuMax-Trace", trace_id)
+
     def _send_json(self, code: int, payload: Any,
                    meta: Optional[dict] = None):
         body = payload if isinstance(payload, bytes) \
@@ -155,6 +238,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        self._send_trace_header()
         if meta:
             self.send_header("X-SimuMax-Cache", meta.get("cache", ""))
             if meta.get("key"):
@@ -173,60 +257,100 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_error_json(self, code: int, message: str):
         self._send_json(code, {"error": message})
 
+    def _send_metrics(self):
+        body = render_prometheus(self.server.registry).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self._send_trace_header()
+        self.end_headers()
+        self.wfile.write(body)
+
+    #: the served routes — the only values the ``endpoint`` metric
+    #: label may take. Anything else (crawlers, port scanners, typo'd
+    #: clients) records as "other": the label is otherwise
+    #: client-controlled, and the registry never evicts, so unique
+    #: paths would mint unbounded instruments and /metrics series
+    KNOWN_ENDPOINTS = frozenset({
+        "/healthz", "/stats", "/metrics",
+        "/v1/estimate", "/v1/explain", "/v1/faults",
+        "/v1/simulate", "/v1/search",
+    })
+
+    def _metric_endpoint(self, endpoint: str) -> str:
+        return endpoint if endpoint in self.KNOWN_ENDPOINTS else "other"
+
     # -- GET ---------------------------------------------------------------
     def do_GET(self):  # noqa: N802 (http.server API)
         t0 = time.perf_counter()
+        endpoint = self.path.split("?")[0]
         err = False
-        try:
-            if self.path == "/healthz":
-                self._send_json(200, {
-                    "status": "ok",
-                    "uptime_s": round(
-                        time.time() - self.server.stats.started, 3),
-                })
-            elif self.path == "/stats":
-                snap = self.server.stats.snapshot()
-                snap.update(self.server.planner.stats())
-                self._send_json(200, snap)
-            else:
+        tracer = get_tracer()
+        with tracer.trace(f"GET {endpoint}", endpoint=endpoint) as tid:
+            try:
+                if self.path == "/healthz":
+                    self._send_json(200, {
+                        "status": "ok",
+                        "uptime_s": round(
+                            time.time() - self.server.stats.started, 3),
+                    })
+                elif self.path == "/stats":
+                    snap = self.server.stats.snapshot()
+                    snap.update(self.server.planner.stats())
+                    self._send_json(200, snap)
+                elif self.path == "/metrics":
+                    self._send_metrics()
+                else:
+                    err = True
+                    self._send_error_json(
+                        404, f"unknown path {self.path}")
+            except BrokenPipeError:
                 err = True
-                self._send_error_json(404, f"unknown path {self.path}")
-        except BrokenPipeError:
-            err = True
-        finally:
-            self.server.stats.record(
-                self.path.split("?")[0], time.perf_counter() - t0, err
-            )
+            finally:
+                self.server.stats.record(
+                    self._metric_endpoint(endpoint),
+                    time.perf_counter() - t0, err,
+                )
+        self.server.write_trace(tid, endpoint)
 
     # -- POST --------------------------------------------------------------
     def do_POST(self):  # noqa: N802
         t0 = time.perf_counter()
         endpoint = self.path.split("?")[0]
         err = False
-        try:
+        tracer = get_tracer()
+        with tracer.trace(f"POST {endpoint}", endpoint=endpoint) as tid:
             try:
-                q = self._body()
-            except (ValueError, json.JSONDecodeError) as exc:
-                err = True
-                self._send_error_json(400, f"bad request body: {exc}")
-                return
-            try:
-                self._dispatch(endpoint, q)
-                # a streamed search that failed mid-body could only
-                # report the error as an NDJSON line; count it here
-                err = err or getattr(self, "_stream_error", False)
-            except BrokenPipeError:
-                err = True
-            except Exception as exc:
-                err = True
-                code = 400 if self._is_config_error(exc) else 500
-                self._send_error_json(
-                    code, f"{type(exc).__name__}: {exc}"
+                q = None
+                try:
+                    q = self._body()
+                except (ValueError, json.JSONDecodeError) as exc:
+                    err = True
+                    self._send_error_json(
+                        400, f"bad request body: {exc}")
+                if q is not None:
+                    try:
+                        self._dispatch(endpoint, q)
+                        # a streamed search that failed mid-body could
+                        # only report the error as an NDJSON line;
+                        # count it here
+                        err = err or getattr(
+                            self, "_stream_error", False)
+                    except BrokenPipeError:
+                        err = True
+                    except Exception as exc:
+                        err = True
+                        code = 400 if self._is_config_error(exc) \
+                            else 500
+                        self._send_error_json(
+                            code, f"{type(exc).__name__}: {exc}"
+                        )
+            finally:
+                self.server.stats.record(
+                    self._metric_endpoint(endpoint),
+                    time.perf_counter() - t0, err,
                 )
-        finally:
-            self.server.stats.record(
-                endpoint, time.perf_counter() - t0, err
-            )
+        self.server.write_trace(tid, endpoint)
 
     @staticmethod
     def _is_config_error(exc: Exception) -> bool:
@@ -314,6 +438,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        self._send_trace_header()
         self.end_headers()
 
         def chunk(obj):
@@ -345,10 +470,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(planner: Optional[Planner] = None,
                 host: str = "127.0.0.1",
-                port: int = 8642) -> PlannerHTTPServer:
+                port: int = 8642,
+                registry: Optional[MetricsRegistry] = None,
+                trace_log: Optional[str] = None) -> PlannerHTTPServer:
     """Build (but do not start) the server; ``port=0`` binds an
-    ephemeral port (``server.server_address[1]`` has the real one)."""
-    return PlannerHTTPServer((host, port), planner or Planner())
+    ephemeral port (``server.server_address[1]`` has the real one).
+    ``registry`` defaults to the planner's (itself the process-wide
+    one unless the planner was built with an isolated registry);
+    ``trace_log`` arms per-request span-tree logging (the ``serve
+    --trace-requests`` artifact)."""
+    return PlannerHTTPServer((host, port), planner or Planner(),
+                             registry=registry, trace_log=trace_log)
 
 
 def serve_forever(server: PlannerHTTPServer):

@@ -129,9 +129,16 @@ class ContentStore:
     each other with identical content."""
 
     def __init__(self, root: Optional[str] = None,
-                 max_bytes: int = DEFAULT_MAX_BYTES):
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 registry=None):
+        from simumax_tpu.observe.telemetry import get_registry
+
         self.root = os.path.abspath(root or default_cache_dir())
         self.max_bytes = int(max_bytes)
+        #: metrics registry the per-instance counters mirror into
+        #: (``store_ops_total{op=...}`` — the scrapeable view; the
+        #: dict below stays the per-instance ``stats()`` source)
+        self.registry = registry or get_registry()
         self._lock = threading.Lock()
         #: separate lock for the eviction/size bookkeeping: an eviction
         #: pass walks and deletes files, and must never stall the
@@ -156,6 +163,7 @@ class ContentStore:
     def _count(self, name: str, n: int = 1):
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+        self.registry.counter("store_ops_total", op=name).inc(n)
 
     # -- entry I/O ---------------------------------------------------------
     @staticmethod

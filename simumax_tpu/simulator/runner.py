@@ -136,11 +136,20 @@ def run_simulation(
     ``stream_trace`` only the bounded skeleton is retained, so the
     streamed trace is not annotated (the report still is).
 
-    ``progress_every`` emits a debug-level Reporter heartbeat every N
-    served engine events (events/s, virtual clock, blocked-rank count)
-    so pod-scale runs are observable mid-flight; 0 disables. Default
-    output is byte-identical (debug lines are suppressed at the
-    default log level).
+    ``progress_every`` drives the progress heartbeat every N served
+    engine events: the ``des_events_served`` / ``des_blocked_ranks`` /
+    ``des_clock_seconds`` registry gauges (``observe/telemetry.py`` —
+    scrapeable from ``GET /metrics`` while the run is in flight) are
+    always updated, and a debug-level Reporter line (events/s, virtual
+    clock, blocked-rank count) is additionally emitted at ``--log-level
+    debug``; 0 disables both. Default stdout is byte-identical (debug
+    lines are suppressed at the default log level; gauges are
+    observe-only). The gauges are process-wide and unlabelled —
+    deliberately, so a long-lived server never accumulates per-run
+    label cardinality — which makes them last-writer-wins: concurrent
+    ``/v1/simulate`` runs interleave their heartbeats, so treat them
+    as "a simulation is alive and progressing", not as a per-run
+    series (per-run numbers live in the request's span tree).
 
     ``event_delays`` ({(engine rank, per-rank emit index): extra
     seconds}) perturbs single events at service time — the
@@ -205,29 +214,39 @@ def run_simulation(
     progress = None
     if progress_every:
         from simumax_tpu.observe.report import LEVELS, get_reporter
+        from simumax_tpu.observe.telemetry import get_registry
 
         _rep = get_reporter()
-        if _rep.threshold > LEVELS["debug"]:
-            # heartbeat lines would be dropped by the reporter anyway:
-            # don't add per-served-event counter work to the engine's
-            # hottest loop for output nobody sees
-            progress_every = 0
-        else:
-            def progress(served, events, clock_s, blocked_ranks,
-                         elapsed_s):
-                # rate in emitted trace events/s — the same unit as
-                # num_events and bench_simulate's events/s metric (a
-                # served request emits 0-2 trace events)
-                rate = events / elapsed_s if elapsed_s else 0.0
-                _rep.debug(
-                    f"[simulate] {events} events emitted "
-                    f"({rate:,.0f} ev/s), clock "
-                    f"{clock_s * 1e3:.1f} ms, {blocked_ranks} ranks "
-                    f"blocked",
-                    event="sim_progress", served=served, events=events,
-                    clock_ms=clock_s * 1e3,
-                    blocked_ranks=blocked_ranks, events_per_sec=rate,
-                )
+        # registry gauges are updated at every heartbeat regardless of
+        # log level (a long pod-scale run stays observable from
+        # ``GET /metrics`` while it runs); the debug *line* is still
+        # emitted only when the reporter would show it
+        _emit_lines = _rep.threshold <= LEVELS["debug"]
+        _reg = get_registry()
+        _g_events = _reg.gauge("des_events_served")
+        _g_blocked = _reg.gauge("des_blocked_ranks")
+        _g_clock = _reg.gauge("des_clock_seconds")
+
+        def progress(served, events, clock_s, blocked_ranks,
+                     elapsed_s):
+            _g_events.set(events)
+            _g_blocked.set(blocked_ranks)
+            _g_clock.set(clock_s)
+            if not _emit_lines:
+                return
+            # rate in emitted trace events/s — the same unit as
+            # num_events and bench_simulate's events/s metric (a
+            # served request emits 0-2 trace events)
+            rate = events / elapsed_s if elapsed_s else 0.0
+            _rep.debug(
+                f"[simulate] {events} events emitted "
+                f"({rate:,.0f} ev/s), clock "
+                f"{clock_s * 1e3:.1f} ms, {blocked_ranks} ranks "
+                f"blocked",
+                event="sim_progress", served=served, events=events,
+                clock_ms=clock_s * 1e3,
+                blocked_ranks=blocked_ranks, events_per_sec=rate,
+            )
 
     engine_kw = dict(
         dep_recorder=rec,

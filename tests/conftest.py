@@ -35,6 +35,10 @@ def _isolated_service_cache(tmp_path, monkeypatch):
     docs/service.md) at a per-test temp dir: tests never read or write
     the developer's ~/.cache/simumax-tpu, and no cached result can leak
     between tests (results are bit-identical either way — this is
-    hygiene, not correctness)."""
+    hygiene, not correctness). The bench-history sentinel
+    (tools/bench_history.py) is disabled the same way: smoke runs of
+    the bench scripts must not append noise points to the committed
+    results/history.jsonl trajectory."""
     monkeypatch.setenv("SIMUMAX_TPU_CACHE_DIR",
                        str(tmp_path / "service-cache"))
+    monkeypatch.setenv("SIMUMAX_BENCH_HISTORY", "0")

@@ -36,7 +36,8 @@ from tools.staticcheck import noqa as noqa_mod  # noqa: E402
 from tools.staticcheck.checkers import REGISTRY  # noqa: E402
 from tools import lint as lint_mod  # noqa: E402
 
-ALL_IDS = {"SIM001", "SIM002", "SIM003", "SIM004", "SIM005", "SIM006"}
+ALL_IDS = {"SIM001", "SIM002", "SIM003", "SIM004", "SIM005", "SIM006",
+           "SIM007"}
 
 
 # --------------------------------------------------------------------------
@@ -73,6 +74,7 @@ REAL_FILES = (
     "simumax_tpu/search/searcher.py",
     "simumax_tpu/service/planner.py",
     "simumax_tpu/service/store.py",
+    "simumax_tpu/observe/telemetry.py",
 )
 
 
@@ -717,6 +719,99 @@ class TestSIM006Fixture:
         assert "'fwd'" in report.findings[0].message
 
 
+SIM007_TELEMETRY = """\
+METRICS = {
+    "good_total": {"type": "counter", "help": "A documented counter."},
+    "good_gauge": {"type": "gauge", "help": "A documented gauge."},
+}
+"""
+
+
+class TestSIM007Fixture:
+    def _run(self, tmp_path, body, telemetry=SIM007_TELEMETRY):
+        write_tree(tmp_path, {
+            "simumax_tpu/observe/telemetry.py": telemetry,
+            "simumax_tpu/service/mod.py": body,
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM007"],
+                     root=str(tmp_path))
+        return report.findings
+
+    def test_catalogued_literal_is_clean(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def f(registry, n):\n"
+            "    registry.counter('good_total', op='hits').inc(n)\n"
+            "    registry.gauge('good_gauge').set(n)\n",
+        )
+        assert found == []
+
+    def test_unknown_name_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def f(self, n):\n"
+            "    self.registry.counter('rogue_total').inc(n)\n",
+        )
+        assert len(found) == 1
+        assert "rogue_total" in found[0].message
+        assert found[0].rule == "unknown"
+
+    def test_dynamic_name_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def f(name):\n"
+            "    from x import get_registry\n"
+            "    get_registry().gauge('x_' + name).set(1)\n",
+        )
+        assert len(found) == 1
+        assert found[0].rule == "non-literal"
+
+    def test_non_registry_receiver_is_clean(self, tmp_path):
+        # collections.Counter / an unrelated .histogram() method must
+        # not be mistaken for the metrics registry
+        found = self._run(
+            tmp_path,
+            "def f(stats, collections):\n"
+            "    c = collections.Counter('abc')\n"
+            "    stats.histogram('whatever')\n"
+            "    return c\n",
+        )
+        assert found == []
+
+    def test_undocumented_catalogue_entry_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def f():\n    pass\n",
+            telemetry=(
+                "METRICS = {\n"
+                '    "bare_total": {"type": "counter", "help": ""},\n'
+                "}\n"
+            ),
+        )
+        assert len(found) == 1
+        assert found[0].rule == "undocumented"
+        assert "bare_total" in found[0].message
+
+    def test_missing_catalogue_fires(self, tmp_path):
+        found = self._run(
+            tmp_path,
+            "def f():\n    pass\n",
+            telemetry="METRICS = build()\n",
+        )
+        assert len(found) == 1
+        assert found[0].rule == "catalogue"
+
+    def test_tree_without_telemetry_is_out_of_scope(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/mod.py":
+                "def f(registry):\n"
+                "    registry.counter('rogue_total').inc()\n",
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM007"],
+                     root=str(tmp_path))
+        assert report.findings == []
+
+
 # --------------------------------------------------------------------------
 # seeded-mutation drift tests on copies of the real tree
 # --------------------------------------------------------------------------
@@ -888,6 +983,31 @@ class TestSeededMutations:
         report, ids = self._run(real_tree)
         assert ids == ["SIM006"], [f.render() for f in report.findings]
         assert any("'broadcast'" in f.message for f in report.findings)
+
+    def test_sim007_rogue_metric_name(self, real_tree):
+        # the exact drift SIM007 exists to catch: a store counter
+        # renamed (or minted) outside the telemetry.METRICS catalogue
+        patch_file(
+            real_tree, "simumax_tpu/service/store.py",
+            'self.registry.counter("store_ops_total", op=name)',
+            'self.registry.counter("store_opz_total", op=name)',
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM007"], [f.render() for f in report.findings]
+        assert any("store_opz_total" in f.message
+                   for f in report.findings)
+
+    def test_sim007_undocumented_catalogue_entry(self, real_tree):
+        patch_file(
+            real_tree, "simumax_tpu/observe/telemetry.py",
+            '"help": "Span records dropped because a trace exceeded '
+            'the "\n                "tracer\'s per-trace buffer '
+            'bound.",',
+            '"help": "",',
+        )
+        report, ids = self._run(real_tree)
+        assert ids == ["SIM007"], [f.render() for f in report.findings]
+        assert any(f.rule == "undocumented" for f in report.findings)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,787 @@
+"""Unified-telemetry tests (ISSUE 12): the metrics registry (catalogue
+enforcement, bounded-reservoir histograms, thread-safety under an
+8-thread hammer), Prometheus text-format conformance of ``GET
+/metrics`` plus its counter agreement with ``/stats``, trace-id
+propagation across planner -> store -> executor and onto the
+``X-SimuMax-Trace`` header / Reporter JSON lines / ``--trace-requests``
+artifacts, telemetry-on == telemetry-off payload bit-identity, and the
+bench-history regression sentinel (``tools/bench_history.py``)."""
+
+import io
+import json
+import re
+import threading
+
+import pytest
+
+from simumax_tpu.core.errors import ConfigError
+from simumax_tpu.observe import telemetry
+from simumax_tpu.observe.telemetry import (
+    METRICS,
+    Histogram,
+    MetricsRegistry,
+    Tracer,
+    chrome_trace,
+    get_registry,
+    get_tracer,
+    render_prometheus,
+    span_tree,
+)
+
+MODEL, STRAT, SYS = "llama3-8b", "tp1_pp2_dp4_mbs1", "tpu_v5e_256"
+
+
+@pytest.fixture()
+def tracer():
+    """The process-wide tracer, armed for the test and fully reset
+    afterwards (span recording off, buffers drained)."""
+    t = get_tracer()
+    t.configure(enabled=True)
+    try:
+        yield t
+    finally:
+        t.configure(enabled=False)
+        t.drain()
+
+
+# --------------------------------------------------------------------------
+# Registry + instruments
+# --------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_returns_same_instrument(self):
+        reg = MetricsRegistry()
+        a = reg.counter("store_ops_total", op="hits")
+        b = reg.counter("store_ops_total", op="hits")
+        assert a is b
+        c = reg.counter("store_ops_total", op="misses")
+        assert c is not a
+
+    def test_unknown_name_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ConfigError, match="SIM007"):
+            reg.counter("made_up_total")
+
+    def test_type_mismatch_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ConfigError, match="declared as a counter"):
+            reg.gauge("store_ops_total")
+
+    def test_catalogue_is_documented(self):
+        # the runtime half of SIM007: every declared metric has a
+        # legal type and non-empty help (the # HELP source)
+        for name, spec in METRICS.items():
+            assert spec["type"] in ("counter", "gauge", "histogram"), name
+            assert spec["help"].strip(), name
+
+    def test_snapshot_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("store_ops_total", op="hits").inc(3)
+        reg.gauge("des_events_served").set(7)
+        reg.histogram("http_request_seconds",
+                      endpoint="/x").observe(0.25)
+        snap = reg.snapshot()
+        assert snap["store_ops_total"] == [
+            {"labels": {"op": "hits"}, "value": 3.0}
+        ]
+        assert snap["des_events_served"][0]["value"] == 7.0
+        h = snap["http_request_seconds"][0]
+        assert h["labels"] == {"endpoint": "/x"}
+        assert h["count"] == 1 and h["sum"] == 0.25
+        assert h["p50"] == 0.25
+        json.dumps(snap)  # JSON-safe
+
+    def test_hammer_8_threads_exact_totals(self):
+        """8 threads x 1000 iterations on shared instruments: counts
+        and sums stay exact (no lost updates), the reservoir stays
+        bounded, and the snapshot is deterministic given the totals."""
+        reg = MetricsRegistry()
+        n_threads, iters = 8, 1000
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            c = reg.counter("store_ops_total", op="hits")
+            g = reg.gauge("des_events_served")
+            h = reg.histogram("http_request_seconds", endpoint="/e")
+            for i in range(iters):
+                c.inc()
+                g.set(i)
+                h.observe(1.0)
+
+        threads = [threading.Thread(target=work)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counter("store_ops_total",
+                           op="hits").value == n_threads * iters
+        h = reg.histogram("http_request_seconds", endpoint="/e")
+        d = h.to_dict()
+        assert d["count"] == n_threads * iters
+        assert d["sum"] == float(n_threads * iters)
+        assert d["min"] == d["max"] == d["p50"] == d["p99"] == 1.0
+        assert d["reservoir_size"] <= telemetry.DEFAULT_RESERVOIR
+
+
+class TestHistogramReservoir:
+    def test_exact_stats_bounded_reservoir(self):
+        h = Histogram("http_request_seconds", {}, reservoir=64)
+        n = 10_000
+        for i in range(n):
+            h.observe(float(i))
+        d = h.to_dict()
+        assert d["count"] == n
+        assert d["sum"] == float(sum(range(n)))
+        assert d["min"] == 0.0 and d["max"] == float(n - 1)
+        assert d["reservoir_size"] <= 64
+
+    def test_quantiles_from_systematic_subsample(self):
+        # a uniform ramp: stride decimation keeps a uniform subsample,
+        # so nearest-rank quantiles land near the true ones
+        h = Histogram("http_request_seconds", {}, reservoir=128)
+        n = 8192
+        for i in range(n):
+            h.observe(float(i))
+        assert h.quantile(0.5) == pytest.approx(n / 2, rel=0.15)
+        assert h.quantile(0.99) == pytest.approx(0.99 * n, rel=0.15)
+        assert h.quantile(0.0) <= h.quantile(0.5) <= h.quantile(1.0)
+
+    def test_deterministic_in_observation_order(self):
+        a = Histogram("http_request_seconds", {}, reservoir=32)
+        b = Histogram("http_request_seconds", {}, reservoir=32)
+        for i in range(5000):
+            a.observe(float(i % 97))
+            b.observe(float(i % 97))
+        assert a.to_dict() == b.to_dict()
+
+    def test_empty_histogram(self):
+        h = Histogram("http_request_seconds", {})
+        assert h.quantile(0.5) == 0.0
+        d = h.to_dict()
+        assert d["count"] == 0 and d["p99"] == 0.0
+
+    def test_reservoir_bound_validated(self):
+        with pytest.raises(ConfigError):
+            Histogram("http_request_seconds", {}, reservoir=1)
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition
+# --------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})?"
+    r" (?P<value>-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?"
+    r"|Inf|NaN))$"
+)
+_LABEL_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\["\\n])*"$'
+)
+
+
+def parse_prometheus(text: str):
+    """Strict parse of the text exposition format (v0.0.4): returns
+    ``{family: {"type": ..., "help": ..., "samples": [(name, labels,
+    value), ...]}}``; raises AssertionError on any malformed line,
+    undeclared sample, or samples interleaved across families."""
+    families = {}
+    current = None
+    for line in text.splitlines():
+        assert line == line.strip() and line, f"malformed line: {line!r}"
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"type": None, "help": help_text,
+                              "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, ptype = rest.partition(" ")
+            assert name == current, "TYPE must follow its HELP"
+            assert ptype in ("counter", "gauge", "summary",
+                             "histogram", "untyped"), ptype
+            families[name]["type"] = ptype
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        sample_name = m.group("name")
+        family = sample_name
+        for suffix in ("_sum", "_count"):
+            if family.endswith(suffix) and family[: -len(suffix)] \
+                    in families:
+                family = family[: -len(suffix)]
+        assert family == current, (
+            f"sample {sample_name!r} outside its family block"
+        )
+        labels = {}
+        if m.group("labels"):
+            for pair in m.group("labels").split(","):
+                assert _LABEL_RE.match(pair), f"bad label: {pair!r}"
+                k, _, v = pair.partition("=")
+                labels[k] = v[1:-1]
+        families[family]["samples"].append(
+            (sample_name, labels, float(m.group("value")))
+        )
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"{name}: HELP without TYPE"
+        assert fam["samples"], f"{name}: family with no samples"
+    return families
+
+
+class TestPrometheusRender:
+    def test_conformant_and_complete(self):
+        reg = MetricsRegistry()
+        reg.counter("store_ops_total", op="hits").inc(5)
+        reg.counter("store_ops_total", op="misses").inc(2)
+        reg.gauge("des_clock_seconds").set(1.25)
+        h = reg.histogram("http_request_seconds", endpoint="/v1/x")
+        for v in (0.01, 0.02, 0.03):
+            h.observe(v)
+        families = parse_prometheus(render_prometheus(reg))
+        assert families["store_ops_total"]["type"] == "counter"
+        assert sorted(
+            (lbl["op"], v) for _n, lbl, v
+            in families["store_ops_total"]["samples"]
+        ) == [("hits", 5.0), ("misses", 2.0)]
+        assert families["des_clock_seconds"]["samples"] == [
+            ("des_clock_seconds", {}, 1.25)
+        ]
+        # histogram renders as a summary: quantiles + _sum + _count
+        fam = families["http_request_seconds"]
+        assert fam["type"] == "summary"
+        names = [n for n, _l, _v in fam["samples"]]
+        assert "http_request_seconds_sum" in names
+        assert "http_request_seconds_count" in names
+        quantiles = {
+            lbl["quantile"]: v for n, lbl, v in fam["samples"]
+            if "quantile" in lbl
+        }
+        assert set(quantiles) == {"0.5", "0.9", "0.99"}
+        # help text comes straight from the catalogue
+        assert fam["help"] == METRICS["http_request_seconds"]["help"]
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("store_ops_total", op='we"ird\\op').inc()
+        text = render_prometheus(reg)
+        assert r'op="we\"ird\\op"' in text
+        parse_prometheus(text)
+
+    def test_empty_registry_renders_empty(self):
+        assert render_prometheus(MetricsRegistry()) == ""
+
+
+# --------------------------------------------------------------------------
+# Server: /metrics, /stats agreement, X-SimuMax-Trace
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served(tmp_path):
+    import http.client
+
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.server import make_server
+
+    planner = Planner(cache_dir=str(tmp_path / "store"),
+                      registry=MetricsRegistry())
+    srv = make_server(planner, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def req(method, path, body=None):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.server_address[1], timeout=300)
+        conn.request(method, path,
+                     json.dumps(body) if body is not None else None,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        headers = dict(resp.getheaders())
+        conn.close()
+        return resp.status, headers, data
+
+    yield srv, req
+    srv.shutdown()
+    srv.server_close()
+
+
+EST = {"model": MODEL, "strategy": STRAT, "system": SYS}
+
+
+class TestServerMetrics:
+    def test_metrics_conformant_and_agrees_with_stats(self, served):
+        srv, req = served
+        st, _h, _d = req("POST", "/v1/estimate", EST)
+        assert st == 200
+        st, _h, _d = req("POST", "/v1/estimate", EST)
+        assert st == 200
+        st, _h, d = req("GET", "/nope")
+        assert st == 404
+        st, _h, d = req("GET", "/stats")
+        assert st == 200
+        stats = json.loads(d)
+        st, h, d = req("GET", "/metrics")
+        assert st == 200
+        assert h["Content-Type"].startswith("text/plain")
+        families = parse_prometheus(d.decode("utf-8"))
+
+        def sample(family, **labels):
+            for name, lbl, v in families[family]["samples"]:
+                if name == family and lbl == labels:
+                    return v
+            raise AssertionError(
+                f"no {family}{labels} in {families.get(family)}")
+
+        # /stats and /metrics describe the same traffic
+        assert sample("http_requests_total",
+                      endpoint="/v1/estimate") == \
+            stats["requests"]["/v1/estimate"] == 2
+        # unknown paths are client-controlled: they fold into one
+        # fixed "other" label so arbitrary URLs can't mint unbounded
+        # registry instruments / Prometheus series
+        assert sample("http_errors_total", endpoint="other") == 1.0
+        assert stats["requests"]["other"] == 1
+        assert sample(
+            "http_requests_total", endpoint="/v1/estimate"
+        ) == stats["latency"]["/v1/estimate"]["count"]
+        # planner + store counters agree too (1 miss, 1 hit)
+        assert sample("planner_ops_total", op="hits") == \
+            stats["planner"]["hits"] == 1
+        assert sample("planner_ops_total", op="misses") == \
+            stats["planner"]["misses"] == 1
+        assert sample("store_ops_total", op="hits") == \
+            stats["store"]["counters"]["hits"]
+
+    def test_stats_schema_unchanged(self, served):
+        # the /stats response contract bench_service.py scrapes: same
+        # keys, same latency sub-schema as the pre-registry deque days
+        srv, req = served
+        req("POST", "/v1/estimate", EST)
+        _st, _h, d = req("GET", "/stats")
+        stats = json.loads(d)
+        assert set(stats) == {"uptime_s", "requests", "requests_total",
+                              "qps", "errors", "latency", "enabled",
+                              "planner", "store"}
+        lat = stats["latency"]["/v1/estimate"]
+        assert set(lat) == {"count", "p50_ms", "p99_ms"}
+
+    def test_trace_header_on_every_response(self, served):
+        srv, req = served
+        ids = set()
+        for method, path, body in (
+            ("GET", "/healthz", None),
+            ("GET", "/metrics", None),
+            ("POST", "/v1/estimate", EST),
+        ):
+            _st, h, _d = req(method, path, body)
+            assert re.fullmatch(r"[0-9a-f]{16}",
+                                h["X-SimuMax-Trace"]), h
+            ids.add(h["X-SimuMax-Trace"])
+        assert len(ids) == 3  # one fresh trace per request
+
+    def test_trace_requests_log_matches_header(self, served, tmp_path):
+        srv, req = served
+        srv.trace_log = str(tmp_path / "requests.jsonl")
+        get_tracer().configure(enabled=True)
+        try:
+            _st, h, _d = req("POST", "/v1/estimate", EST)
+        finally:
+            get_tracer().configure(enabled=False)
+        # the handler appends the span tree *after* sending the
+        # response: wait for the line to land
+        import os
+        import time
+
+        deadline = time.monotonic() + 10.0
+        lines = []
+        while time.monotonic() < deadline:
+            if os.path.isfile(srv.trace_log):
+                with open(srv.trace_log, encoding="utf-8") as f:
+                    lines = [json.loads(ln) for ln in f if ln.strip()]
+                if lines:
+                    break
+            time.sleep(0.02)
+        get_tracer().drain()
+        assert len(lines) == 1
+        entry = lines[0]
+        assert entry["trace_id"] == h["X-SimuMax-Trace"]
+        assert entry["endpoint"] == "/v1/estimate"
+        (root,) = entry["spans"]
+        assert root["name"] == "POST /v1/estimate"
+        child_names = {c["name"] for c in root["children"]}
+        assert "store_lookup" in child_names
+
+
+# --------------------------------------------------------------------------
+# Trace propagation + parity
+# --------------------------------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_planner_store_executor_one_trace(self, tracer, tmp_path):
+        """One traced sweep: the spans recorded by the planner facade
+        (sweep), the store path (store_lookup/evaluate), and the
+        executor (evaluate_cell) all carry the root's trace id."""
+        from simumax_tpu.service.planner import Planner
+
+        planner = Planner(cache_dir=str(tmp_path / "store"))
+        with tracer.trace("test_root") as tid:
+            planner.estimate(MODEL, STRAT, SYS)
+            planner.search(MODEL, "tpu_v5p_256", global_batch_size=32,
+                           world=32, tp_list=(1,), pp_list=(1,),
+                           zero_list=(1,), topk=1)
+        spans = tracer.drain()
+        names = {s.name for s in spans}
+        assert {"test_root", "store_lookup", "evaluate", "sweep",
+                "evaluate_cell"} <= names, names
+        assert {s.trace_id for s in spans} == {tid}
+        # nesting: every non-root span has a parent in the same trace
+        by_id = {s.span_id: s for s in spans}
+        for s in spans:
+            if s.name != "test_root":
+                assert s.parent_id in by_id or any(
+                    p.span_id == s.parent_id for p in spans
+                ), s.name
+
+    def test_span_no_op_outside_trace(self, tracer):
+        with tracer.span("orphan") as sid:
+            assert sid is None
+        assert tracer.drain() == []
+
+    def test_reporter_json_lines_carry_ids(self, tracer):
+        from simumax_tpu.observe.report import (
+            configure_reporter,
+            get_reporter,
+        )
+
+        buf = io.StringIO()
+        configure_reporter(level="info", json_lines=True, stream=buf)
+        try:
+            with tracer.trace("root") as tid:
+                get_reporter().info("inside", event="x")
+            get_reporter().info("outside", event="y")
+        finally:
+            configure_reporter(level="info", json_lines=False)
+            get_reporter().stream = None
+        inside, outside = [json.loads(ln)
+                           for ln in buf.getvalue().splitlines()]
+        assert inside["trace_id"] == tid and inside["span_id"]
+        assert "trace_id" not in outside
+
+    def test_payloads_bit_identical_tracing_on_vs_off(self, tracer):
+        from simumax_tpu.service.planner import Planner
+        from simumax_tpu.service.store import canonical_bytes
+
+        off = Planner(enabled=False)
+        with tracer.trace("traced"):
+            traced = canonical_bytes(off.estimate(MODEL, STRAT, SYS))
+        tracer.configure(enabled=False)
+        plain = canonical_bytes(off.estimate(MODEL, STRAT, SYS))
+        assert traced == plain
+
+    def test_span_tree_and_chrome_trace_export(self, tracer):
+        with tracer.trace("root"):
+            with tracer.span("a"):
+                with tracer.span("b"):
+                    pass
+            with tracer.span("c"):
+                pass
+        spans = tracer.drain()
+        (root,) = span_tree(spans)
+        assert root["name"] == "root"
+        assert [c["name"] for c in root["children"]] == ["a", "c"]
+        assert root["children"][0]["children"][0]["name"] == "b"
+        trace = chrome_trace(spans)
+        from tests.test_trace_validity import check_chrome_trace
+
+        check_chrome_trace(trace)
+        x = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in x} == {"root", "a", "b", "c"}
+        assert all("trace_id" in e["args"] for e in x)
+
+
+class TestTracerBounds:
+    def test_span_cap_drops_and_counts(self):
+        reg = MetricsRegistry()
+        t = Tracer(max_spans_per_trace=2, registry=reg)
+        t.enabled = True
+        with t.trace("root"):
+            for i in range(5):
+                with t.span(f"s{i}"):
+                    pass
+        spans = t.drain()
+        assert len(spans) == 2
+        # 3 dropped children + the root (buffer already full)
+        assert reg.counter("trace_spans_dropped_total").value == 4
+
+    def test_trace_eviction_oldest_first(self):
+        t = Tracer(max_traces=2)
+        t.enabled = True
+        tids = []
+        for i in range(3):
+            with t.trace(f"t{i}") as tid:
+                tids.append(tid)
+        assert t.pop_trace(tids[0]) == []
+        assert [s.name for s in t.drain()] == ["t1", "t2"]
+
+
+# --------------------------------------------------------------------------
+# Registry-backed surfaces: Diagnostics counters, DES gauges, CLI
+# --------------------------------------------------------------------------
+
+
+class TestRegistryBackedSurfaces:
+    def test_diagnostics_counters_mirror_to_gauge(self):
+        from simumax_tpu.core.records import Diagnostics
+
+        diag = Diagnostics()
+        diag.counters["sweep_cells_total"] = 42
+        assert get_registry().gauge(
+            "diag_counter", name="sweep_cells_total").value == 42.0
+        diag.counters["sweep_cells_total"] = 43
+        assert get_registry().gauge(
+            "diag_counter", name="sweep_cells_total").value == 43.0
+        # observe-only: the dict itself is a plain dict to consumers
+        assert dict(diag.counters) == {"sweep_cells_total": 43}
+
+    def test_des_heartbeat_gauges(self):
+        from simumax_tpu.core.config import (
+            get_model_config,
+            get_strategy_config,
+        )
+        from simumax_tpu.perf import PerfLLM
+
+        st = get_strategy_config(STRAT)
+        m = get_model_config(MODEL)
+        m.layer_num = 4
+        p = PerfLLM().configure(st, m, SYS)
+        p.run_estimate()
+        reg = get_registry()
+        reg.gauge("des_events_served").set(0)
+        reg.gauge("des_clock_seconds").set(0)
+        # default log level: heartbeat lines suppressed, gauges still
+        # update (the satellite contract)
+        p.simulate(None, track_memory=False, progress_every=200)
+        assert reg.gauge("des_events_served").value > 0
+        assert reg.gauge("des_clock_seconds").value > 0
+
+    def test_cli_trace_requests_artifacts(self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        out = tmp_path / "trace.json"
+        # default cache routing (conftest isolates the store): the
+        # planner path is the one that annotates spans
+        rc = main([
+            "perf", "--model", MODEL, "--strategy", STRAT,
+            "--system", SYS, "--trace-requests", str(out),
+        ])
+        capsys.readouterr()
+        assert not rc
+        data = json.loads(out.read_text())
+        assert data["command"] == "perf"
+        assert data["trace_id"] and data["spans"]
+        (root,) = data["spans"]
+        assert root["name"] == "perf"
+        assert root["children"], "perf spans did not nest under root"
+        chrome = json.loads((tmp_path / "trace.json.chrome.json")
+                            .read_text())
+        from tests.test_trace_validity import check_chrome_trace
+
+        check_chrome_trace(chrome)
+        # the tracer must be disarmed after the command (a later
+        # command in the same process must not keep recording)
+        assert not get_tracer().enabled
+
+
+# --------------------------------------------------------------------------
+# Bench-history regression sentinel
+# --------------------------------------------------------------------------
+
+
+from tools import bench_history  # noqa: E402
+
+
+def _hist(tmp_path):
+    return str(tmp_path / "history.jsonl")
+
+
+def _record_series(path, values, metric="qps", unit="q/s",
+                   machine="m1", **extra):
+    for v in values:
+        res = {"metric": metric, "value": v, "unit": unit}
+        res.update(extra)
+        assert bench_history.record(
+            res, path=path, machine=machine, commit="abc") == path
+
+
+class TestBenchHistory:
+    def test_no_regression_passes(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [100, 102, 98, 101, 99, 100])
+        (v,) = bench_history.check(path=path, machine="m1")
+        assert v["ok"] and v["baseline"] == pytest.approx(100.0)
+        assert v["n_baseline"] == 5
+        assert v["direction"] == "higher_is_better"
+
+    def test_throughput_regression_fails(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [100, 102, 98, 101, 99, 60])
+        (v,) = bench_history.check(path=path, machine="m1")
+        assert not v["ok"]
+        assert v["change"] == pytest.approx((60 - 100.0) / 100.0)
+
+    def test_tolerance_is_respected(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [100, 100, 100, 80])
+        (v,) = bench_history.check(path=path, machine="m1",
+                                   tolerance=0.3)
+        assert v["ok"]
+        (v,) = bench_history.check(path=path, machine="m1",
+                                   tolerance=0.1)
+        assert not v["ok"]
+
+    def test_error_metric_regresses_upward(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [8.0, 8.5, 8.2, 20.0],
+                       metric="prediction error", unit="%")
+        (v,) = bench_history.check(path=path, machine="m1")
+        assert v["direction"] == "lower_is_better" and not v["ok"]
+        # and an improvement passes
+        _record_series(path, [2.0], metric="prediction error",
+                       unit="%")
+        (v,) = bench_history.check(path=path, machine="m1")
+        assert v["ok"]
+
+    def test_first_point_has_no_baseline(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [5.0])
+        (v,) = bench_history.check(path=path, machine="m1")
+        assert v["ok"] and v["baseline"] is None
+
+    def test_variants_are_separate_series(self, tmp_path):
+        # a batched wide-grid sweep must never become the baseline of
+        # a scalar standard-grid one: same metric, different series
+        path = _hist(tmp_path)
+        _record_series(path, [100, 100, 100], metric="cells/s",
+                       engine="batched", grid="wide")
+        _record_series(path, [8.0], metric="cells/s", grid="standard")
+        verdicts = bench_history.check(path=path, machine="m1")
+        assert len(verdicts) == 2
+        assert all(v["ok"] for v in verdicts)
+        assert {v["variant"] for v in verdicts} == {
+            "engine=batched,grid=wide", "grid=standard"}
+
+    def test_critical_path_runs_are_a_separate_series(self, tmp_path):
+        # CI runs bench_simulate twice per build (plain, then
+        # --critical-path); the critpath run is legitimately up to 50%
+        # slower, so it must never share a baseline with the plain run
+        path = _hist(tmp_path)
+        _record_series(path, [100, 100, 100], metric="events/s",
+                       mode="reduced")
+        _record_series(path, [60.0], metric="events/s",
+                       mode="reduced", critical_path=True)
+        verdicts = bench_history.check(path=path, machine="m1")
+        assert len(verdicts) == 2
+        assert all(v["ok"] for v in verdicts)
+        assert {v["variant"] for v in verdicts} == {
+            "mode=reduced", "mode=reduced,critical_path=True"}
+
+    def test_machine_scoping(self, tmp_path):
+        # a slower machine's numbers never regress a faster machine's
+        path = _hist(tmp_path)
+        _record_series(path, [100, 100, 100], machine="fast")
+        _record_series(path, [10], machine="slow")
+        (v,) = bench_history.check(path=path, machine="slow")
+        assert v["ok"] and v["baseline"] is None
+        # --any-machine deliberately conflates them
+        (v,) = bench_history.check(path=path, any_machine=True)
+        assert not v["ok"]
+
+    def test_window_bounds_baseline(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [1000, 1000, 100, 100, 100, 100])
+        (v,) = bench_history.check(path=path, machine="m1", window=3)
+        assert v["ok"] and v["baseline"] == 100
+
+    def test_env_disable_and_non_numeric_skipped(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv(bench_history.HISTORY_ENV, "0")
+        assert bench_history.record({"metric": "x", "value": 1}) is None
+        monkeypatch.setenv(bench_history.HISTORY_ENV,
+                           _hist(tmp_path))
+        assert bench_history.record(
+            {"metric": "x", "value": "skipped"}) is None
+        assert bench_history.record({"metric": "x", "value": 1}) \
+            == _hist(tmp_path)
+        assert len(bench_history.load()) == 1
+
+    def test_entries_carry_provenance(self, tmp_path):
+        path = _hist(tmp_path)
+        bench_history.record({"metric": "x", "value": 1.5}, path=path)
+        (entry,) = bench_history.load(path)
+        assert entry["machine"] == bench_history.machine_fingerprint()
+        assert entry["python"] and entry["ts"]
+        assert entry["result"] == {"metric": "x", "value": 1.5}
+
+    def test_machine_env_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(bench_history.MACHINE_ENV, "ci")
+        assert bench_history.machine_fingerprint() == "ci"
+        path = _hist(tmp_path)
+        bench_history.record({"metric": "x", "value": 1.0}, path=path)
+        (entry,) = bench_history.load(path)
+        assert entry["machine"] == "ci"
+
+    def test_torn_line_is_skipped(self, tmp_path):
+        path = _hist(tmp_path)
+        _record_series(path, [1.0, 2.0])
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"metric": "x", "val')  # torn concurrent append
+        assert len(bench_history.load(path)) == 2
+
+    def test_cli_append_and_check_exit_codes(self, tmp_path, capsys):
+        path = _hist(tmp_path)
+        src = tmp_path / "one.json"
+        for v in (100, 101, 99, 100, 100):
+            src.write_text(json.dumps(
+                {"metric": "qps", "value": v, "unit": "q/s"}))
+            assert bench_history.main(
+                ["--history", path, "append", "--file", str(src),
+                 "--machine", "ci"]) == 0
+        capsys.readouterr()
+        assert bench_history.main(
+            ["--history", path, "check", "--machine", "ci"]) == 0
+        ok = json.loads(capsys.readouterr().out)
+        assert ok["ok"] and ok["verdicts"][0]["baseline"] == 100
+        src.write_text(json.dumps(
+            {"metric": "qps", "value": 10, "unit": "q/s"}))
+        assert bench_history.main(
+            ["--history", path, "append", "--file", str(src),
+             "--machine", "ci"]) == 0
+        capsys.readouterr()
+        assert bench_history.main(
+            ["--history", path, "check", "--machine", "ci"]) == 1
+        bad = json.loads(capsys.readouterr().out)
+        assert not bad["ok"]
+
+    def test_bench_scripts_record_automatically(self, tmp_path,
+                                                monkeypatch):
+        # the conftest autouse fixture disables recording for every
+        # test; pointing the env at a temp file re-enables it and the
+        # bench entrypoint appends exactly one provenance-stamped line
+        path = _hist(tmp_path)
+        monkeypatch.setenv(bench_history.HISTORY_ENV, path)
+        import bench_simulate
+
+        rc = bench_simulate.main(
+            ["--world", "32", "--mbc", "2", "--repeats", "1"])
+        assert rc == 0
+        (entry,) = bench_history.load(path)
+        assert entry["metric"] == "simulate_events_per_sec"
+        assert entry["variant"]
+        assert entry["machine"] == bench_history.machine_fingerprint()

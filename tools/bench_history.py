@@ -1,0 +1,336 @@
+"""Bench-history regression sentinel.
+
+Every ``bench_*.py`` script prints exactly one JSON result line; until
+now those lines lived in five disconnected ``results/*.json`` snapshots
+that only ever held the *latest* point. This tool gives CI a
+trajectory instead of a point gate:
+
+* :func:`record` appends a bench result to ``results/history.jsonl``
+  stamped with provenance — machine fingerprint, git commit, python —
+  so numbers from different machines/commits never get conflated. All
+  four bench scripts call it automatically after printing their line
+  (best-effort: a read-only checkout or missing git never fails a
+  bench). ``SIMUMAX_BENCH_HISTORY`` overrides the path; ``0`` (or
+  empty) disables recording.
+* :func:`check` computes a **rolling baseline** (median of the last
+  ``window`` prior entries for the same metric on the same machine)
+  and flags the newest entry when it regresses beyond a per-metric
+  tolerance. Direction-aware: throughput metrics (q/s, cells/s,
+  events/s) regress downward, error metrics (``unit == "%"``) regress
+  upward.
+
+CLI::
+
+    python tools/bench_history.py append --file results/bench_last.json
+    echo '{"metric": ..., "value": ...}' | python tools/bench_history.py append
+    python tools/bench_history.py check [--metric M] [--window 5]
+        [--tolerance 0.3] [--machine ID | --any-machine]
+    python tools/bench_history.py show [--metric M]
+
+``check`` exits 1 on any regression, 0 otherwise (a metric with no
+prior same-machine entries has no baseline and passes with
+``baseline: null`` — the first point of a trajectory cannot regress).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import statistics
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the unified trajectory file (one JSON object per line)
+DEFAULT_HISTORY = os.path.join(REPO_ROOT, "results", "history.jsonl")
+
+#: environment override: a path, or "0"/"" to disable recording
+HISTORY_ENV = "SIMUMAX_BENCH_HISTORY"
+
+#: environment override for the machine fingerprint — CI runners get
+#: random hostnames, so the workflow pins this to a stable id ("ci")
+#: and entries from successive runs form one comparable series
+MACHINE_ENV = "SIMUMAX_BENCH_MACHINE"
+
+#: default fraction a metric may move (in its bad direction) from the
+#: rolling baseline before check() flags it — deliberately wide, like
+#: the CI bench gates: the sentinel catches order-of-magnitude cliffs
+#: and steady erosion, not few-percent machine noise
+DEFAULT_TOLERANCE = 0.3
+
+#: per-metric tolerance overrides
+TOLERANCES: Dict[str, float] = {}
+
+#: metrics where a LOWER value is better (everything else: higher is
+#: better). The unit heuristic below extends this: "%" metrics are
+#: error rates.
+LOWER_IS_BETTER = {
+    "calibrated step-time prediction error (llama-0.5B, 1 chip)",
+}
+
+#: result keys that change what a metric measures (the same keys each
+#: bench's own --baseline gate refuses to compare across): entries are
+#: bucketed into one series per (metric, variant), so a batched wide-
+#: grid sweep never becomes the baseline of a scalar standard-grid one
+VARIANT_KEYS = ("engine", "grid", "mode", "granularity", "world",
+                "mbc", "queries", "overlap", "threads", "trace",
+                "critical_path")
+
+
+def variant_of(result: Dict[str, Any]) -> str:
+    parts = [
+        f"{k}={result[k]}" for k in VARIANT_KEYS if k in result
+    ]
+    return ",".join(parts)
+
+
+def machine_fingerprint() -> str:
+    """Stable-ish identity of the measuring machine: hostname plus the
+    hardware coordinates that dominate bench numbers.
+    ``SIMUMAX_BENCH_MACHINE`` overrides (ephemeral CI runners pin it
+    to a stable id so their entries form one series)."""
+    env = os.environ.get(MACHINE_ENV)
+    if env:
+        return env
+    return (
+        f"{platform.node() or 'unknown'}"
+        f"/{platform.machine() or '?'}x{os.cpu_count() or 0}"
+    )
+
+
+def git_commit() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout.strip() or None
+
+
+def history_path(path: Optional[str] = None) -> Optional[str]:
+    """Resolve the history file path; None = recording disabled."""
+    if path:
+        return path
+    env = os.environ.get(HISTORY_ENV)
+    if env is not None:
+        if env in ("", "0"):
+            return None
+        return env
+    return DEFAULT_HISTORY
+
+
+def record(result: Dict[str, Any], path: Optional[str] = None,
+           machine: Optional[str] = None,
+           commit: Optional[str] = None) -> Optional[str]:
+    """Append one bench result line with provenance; returns the path
+    written, or None when recording is disabled / the result carries
+    no numeric value (a degraded bench must not poison the baseline).
+    Never raises: the sentinel is an observer, not a gate, at record
+    time."""
+    dest = history_path(path)
+    if dest is None:
+        return None
+    value = result.get("value")
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return None
+    entry = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metric": result.get("metric", "unknown"),
+        "variant": variant_of(result),
+        "value": value,
+        "unit": result.get("unit", ""),
+        "machine": machine or machine_fingerprint(),
+        "commit": commit if commit is not None else git_commit(),
+        "python": platform.python_version(),
+        "result": result,
+    }
+    try:
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry, default=str) + "\n")
+    except OSError:
+        return None
+    return dest
+
+
+def record_safely(result: Dict[str, Any]) -> Optional[str]:
+    """The bench-script entry point: :func:`record`, but guaranteed
+    never to raise for any reason (the sentinel must not fail a bench
+    that just printed a good result). All four ``bench_*.py`` scripts
+    call this after printing their JSON line."""
+    try:
+        return record(result)
+    except Exception:
+        return None
+
+
+def load(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All history entries in append order; unparseable lines are
+    skipped (a torn concurrent append must not wedge the sentinel)."""
+    dest = history_path(path)
+    if dest is None or not os.path.isfile(dest):
+        return []
+    out = []
+    with open(dest, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(entry, dict) and isinstance(
+                    entry.get("value"), (int, float)):
+                out.append(entry)
+    return out
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    return metric in LOWER_IS_BETTER or unit == "%"
+
+
+def rolling_baseline(values: List[float]) -> Optional[float]:
+    """Median of the prior points — robust to one outlier run."""
+    if not values:
+        return None
+    return float(statistics.median(values))
+
+
+def check(path: Optional[str] = None, metric: Optional[str] = None,
+          window: int = 5, tolerance: Optional[float] = None,
+          machine: Optional[str] = None,
+          any_machine: bool = False) -> List[Dict[str, Any]]:
+    """Judge the newest entry of each metric against its rolling
+    baseline. Returns one verdict dict per judged metric:
+    ``{metric, value, baseline, n_baseline, tolerance, direction,
+    change, ok}``. ``baseline=None`` (fewer than one prior
+    same-machine entry) is always ok — a trajectory's first point."""
+    entries = load(path)
+    if not any_machine:
+        scope = machine or machine_fingerprint()
+        entries = [e for e in entries if e.get("machine") == scope]
+    by_series: Dict[tuple, List[Dict[str, Any]]] = {}
+    for e in entries:
+        variant = e.get("variant")
+        if variant is None:
+            variant = variant_of(e.get("result") or {})
+        by_series.setdefault((e["metric"], variant), []).append(e)
+    verdicts = []
+    for name, variant in sorted(by_series):
+        if metric is not None and name != metric:
+            continue
+        series = by_series[(name, variant)]
+        latest = series[-1]
+        prior = [float(e["value"]) for e in series[:-1]][-window:]
+        base = rolling_baseline(prior)
+        tol = tolerance if tolerance is not None else \
+            TOLERANCES.get(name, DEFAULT_TOLERANCE)
+        lower = lower_is_better(name, latest.get("unit", ""))
+        value = float(latest["value"])
+        if base is None:
+            ok, change = True, None
+        elif base == 0:
+            ok = (value <= 0) if lower else (value >= 0)
+            change = None
+        else:
+            change = (value - base) / abs(base)
+            ok = change <= tol if lower else change >= -tol
+        verdicts.append({
+            "metric": name,
+            "variant": variant,
+            "value": value,
+            "unit": latest.get("unit", ""),
+            "baseline": base,
+            "n_baseline": len(prior),
+            "tolerance": tol,
+            "direction": "lower_is_better" if lower
+            else "higher_is_better",
+            "change": change,
+            "ok": ok,
+        })
+    if metric is not None and not verdicts:
+        verdicts.append({
+            "metric": metric, "variant": "", "value": None, "unit": "",
+            "baseline": None, "n_baseline": 0, "tolerance": 0.0,
+            "direction": "", "change": None, "ok": True,
+            "note": "no history entries for this metric/machine",
+        })
+    return verdicts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", metavar="PATH",
+                    help=f"history file (default results/history.jsonl;"
+                         f" ${HISTORY_ENV} overrides)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pa = sub.add_parser("append", help="append one bench JSON line "
+                                       "(from --file or stdin)")
+    pa.add_argument("--file", metavar="JSON",
+                    help="result file (default: read one JSON object "
+                         "from stdin)")
+    pa.add_argument("--machine", help="override the machine "
+                                      "fingerprint (e.g. 'ci')")
+
+    pc = sub.add_parser("check", help="regression-check the newest "
+                                      "entry per metric")
+    pc.add_argument("--metric", help="check only this metric")
+    pc.add_argument("--window", type=int, default=5,
+                    help="rolling-baseline width (default 5)")
+    pc.add_argument("--tolerance", type=float,
+                    help=f"override the per-metric tolerance "
+                         f"(default {DEFAULT_TOLERANCE})")
+    pc.add_argument("--machine", help="baseline scope (default: this "
+                                      "machine's fingerprint)")
+    pc.add_argument("--any-machine", action="store_true",
+                    help="compare across machines (wide-tolerance CI "
+                         "mode)")
+
+    ps = sub.add_parser("show", help="print history entries")
+    ps.add_argument("--metric", help="filter to one metric")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "append":
+        if args.file:
+            with open(args.file, "r", encoding="utf-8") as f:
+                result = json.load(f)
+        else:
+            result = json.loads(sys.stdin.read())
+        dest = record(result, path=args.history, machine=args.machine)
+        if dest is None:
+            print(json.dumps({"recorded": False,
+                              "reason": "disabled or non-numeric"}))
+            return 0
+        print(json.dumps({"recorded": True, "path": dest,
+                          "metric": result.get("metric")}))
+        return 0
+    if args.cmd == "check":
+        verdicts = check(
+            path=args.history, metric=args.metric,
+            window=args.window, tolerance=args.tolerance,
+            machine=args.machine, any_machine=args.any_machine,
+        )
+        print(json.dumps({"verdicts": verdicts,
+                          "ok": all(v["ok"] for v in verdicts)}))
+        return 0 if all(v["ok"] for v in verdicts) else 1
+    entries = load(args.history)
+    for e in entries:
+        if args.metric and e.get("metric") != args.metric:
+            continue
+        print(json.dumps(e, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

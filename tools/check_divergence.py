@@ -1,0 +1,125 @@
+"""CI gate for the sim-vs-analytical drift signal.
+
+PR 7's ``diverge()`` (``simumax_tpu/observe/critpath.py``) aligns the
+discrete-event simulator's waterfall bucket-by-bucket against the
+analytical ``build_waterfall`` and names the top disagreeing ops.
+Until now it only ran as on-failure forensics; this tool runs it as a
+**live gate** (ROADMAP item 5's calibration-drift detector): a small
+dense / MoE / MLA x pp{1,2} config grid where the two models are known
+to agree, failing if any divergence bucket moves beyond a float-noise
+tolerance of the analytical total — i.e. on any *nonzero* divergence.
+
+A ``compute`` gap points at efficiency-table drift, an
+``exposed_comm`` gap at collective bw/lat terms, a
+``pipeline_bubble`` gap at the schedule model itself; the JSON report
+(``--json``) carries the per-bucket rows and top per-op deltas so a
+red gate is triaged from the artifact.
+
+Usage::
+
+    python tools/check_divergence.py [--tolerance 1e-3] [--json PATH]
+
+Exits 1 when any grid cell diverges, 0 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the alignment grid: (label, model, strategy, pp) — one dense, one
+#: MoE, one MLA family, each at pp 1 and 2, small enough for CI
+GRID = (
+    ("dense/pp1", "llama3-8b", "tp2_pp1_dp4_mbs1", 1),
+    ("dense/pp2", "llama3-8b", "tp1_pp2_dp4_mbs1", 2),
+    ("moe/pp1", "mixtral-8x7b", "ep8_pp1_dp8_mbs1", 1),
+    ("moe/pp2", "mixtral-8x7b", "ep4_pp2_dp4_mbs1", 2),
+    ("mla/pp1", "deepseekv2-lite", "tp2_pp1_dp4_mbs1", 1),
+    ("mla/pp2", "deepseekv2-lite", "tp1_pp2_dp4_mbs1", 2),
+)
+
+#: relative float-noise allowance per bucket: |delta| must stay within
+#: this fraction of the analytical total (the same contract
+#: tests/test_critpath.py::test_divergence_clean_config_aligns pins)
+DEFAULT_TOLERANCE = 1e-3
+
+
+def check_cell(label: str, model: str, strategy: str, pp: int,
+               tolerance: float) -> Dict[str, Any]:
+    from simumax_tpu.core.config import (
+        get_model_config,
+        get_strategy_config,
+    )
+    from simumax_tpu.perf import PerfLLM
+
+    st = get_strategy_config(strategy)
+    m = get_model_config(model)
+    m.layer_num = max(pp * 2, 4)
+    perf = PerfLLM().configure(st, m, "tpu_v5e_256")
+    perf.run_estimate()
+    report = perf.critical_path(None, track_memory=False,
+                                granularity="leaf")
+    div = report["divergence"]
+    total = div["analytical_total_ms"] or 1.0
+    bad = [
+        row for row in div["buckets"]
+        if abs(row["delta_ms"]) > tolerance * total
+    ]
+    return {
+        "cell": label,
+        "model": model,
+        "strategy": strategy,
+        "analytical_total_ms": div["analytical_total_ms"],
+        "simulated_total_ms": div["simulated_total_ms"],
+        "delta_ms": div["delta_ms"],
+        "buckets": div["buckets"],
+        "top_op_deltas": div["top_op_deltas"][:5],
+        "diverged_buckets": [r["bucket"] for r in bad],
+        "ok": not bad,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tolerance", type=float,
+                    default=DEFAULT_TOLERANCE,
+                    help="per-bucket |delta| allowance as a fraction "
+                         "of the analytical total (default "
+                         f"{DEFAULT_TOLERANCE}: float noise only)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full per-cell report here "
+                         "(forensics artifact)")
+    args = ap.parse_args(argv)
+
+    verdicts: List[Dict[str, Any]] = []
+    for label, model, strategy, pp in GRID:
+        v = check_cell(label, model, strategy, pp, args.tolerance)
+        verdicts.append(v)
+        status = "ok" if v["ok"] else (
+            f"DIVERGED {v['diverged_buckets']}"
+        )
+        print(
+            f"[diverge] {label:<10} {model:<16} {strategy:<20} "
+            f"sim {v['simulated_total_ms']:9.3f} ms vs analytical "
+            f"{v['analytical_total_ms']:9.3f} ms "
+            f"({v['delta_ms']:+.3f} ms)  {status}"
+        )
+    ok = all(v["ok"] for v in verdicts)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump({"tolerance": args.tolerance, "ok": ok,
+                       "cells": verdicts}, f, indent=1, default=str)
+    print(f"[diverge] {'OK' if ok else 'FAILED'}: "
+          f"{sum(v['ok'] for v in verdicts)}/{len(verdicts)} cells "
+          f"aligned within {args.tolerance:g} of the analytical total")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

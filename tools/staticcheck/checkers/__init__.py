@@ -12,6 +12,7 @@ from tools.staticcheck.checkers import (
     determinism,
     discipline,
     error_taxonomy,
+    metric_names,
 )
 
 ALL_CHECKERS = (
@@ -21,6 +22,7 @@ ALL_CHECKERS = (
     error_taxonomy.CHECKER,  # SIM004
     discipline.CHECKER,      # SIM005
     collectives.CHECKER,     # SIM006
+    metric_names.CHECKER,    # SIM007
 )
 
 REGISTRY = {c.id: c for c in ALL_CHECKERS}

@@ -1,0 +1,203 @@
+"""SIM007 — metric-name discipline for the telemetry catalogue.
+
+The telemetry plane (``simumax_tpu/observe/telemetry.py``) declares
+every legal metric name in the ``METRICS`` catalogue: name, type, help
+text. The registry enforces this at runtime (unknown names raise), but
+a metric minted on a cold path would only blow up when that path first
+runs — in production, at scrape time. This checker moves the contract
+to CI, the same way SIM001-SIM006 police their invariants:
+
+* every ``<registry>.counter(...)`` / ``.gauge(...)`` /
+  ``.histogram(...)`` call in ``simumax_tpu/`` must pass its metric
+  name as a **string literal** that appears in the catalogue —
+  dynamic names defeat both this checker and the Prometheus contract
+  that names are a closed vocabulary (dynamic dimensions belong in
+  labels);
+* every catalogue entry must be **documented**: a non-empty ``help``
+  and a ``type`` of counter/gauge/histogram (``# HELP`` lines come
+  straight from it);
+* a catalogue that went missing or unparseable is itself a finding —
+  deleting ``METRICS`` must not silently disable the discipline.
+
+Receivers are matched structurally: an attribute call on a name/
+attribute whose identifier is ``reg``/``*registry*`` (``registry``,
+``self.registry``, ``_reg``), or directly on ``get_registry()``.
+The catalogue is read from the project's parsed AST — the checker
+never imports the code under analysis — so it runs identically on
+the real tree and on fixture trees in tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM007"
+
+#: where the catalogue lives
+TELEMETRY_PATH = "simumax_tpu/observe/telemetry.py"
+
+#: the instrument-minting method names
+METHODS = ("counter", "gauge", "histogram")
+
+#: legal catalogue types
+TYPES = ("counter", "gauge", "histogram")
+
+#: the scope the discipline applies to (tests/fixtures mint ad-hoc
+#: names on purpose; the library may not)
+SCOPE = "simumax_tpu/"
+
+
+def _is_registry_receiver(node: ast.AST) -> bool:
+    """Whether an attribute call's receiver is a metrics registry:
+    ``registry.…``, ``self.registry.…``, ``_reg.…``,
+    ``get_registry().…``."""
+    if isinstance(node, ast.Name):
+        ident = node.id
+    elif isinstance(node, ast.Attribute):
+        ident = node.attr
+    elif isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        return name == "get_registry"
+    else:
+        return False
+    ident = ident.lstrip("_").lower()
+    return ident == "reg" or "registry" in ident
+
+
+def parse_catalogue(project: Project):
+    """Extract the METRICS literal from the telemetry module's AST.
+    Returns ``(catalogue, findings)``; ``catalogue`` is ``None`` when
+    the module is absent from the project (fixture trees without a
+    telemetry layer are out of scope), and the findings report a
+    present-but-unparseable catalogue."""
+    pf = project.find(TELEMETRY_PATH)
+    if pf is None or pf.tree is None:
+        return None, []
+    catalogue: Optional[Dict[str, dict]] = None
+    cat_line = 1
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign):
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+        elif isinstance(node, ast.AnnAssign):
+            targets = (
+                [node.target.id]
+                if isinstance(node.target, ast.Name) else []
+            )
+        else:
+            continue
+        if "METRICS" not in targets:
+            continue
+        cat_line = node.lineno
+        if not isinstance(node.value, ast.Dict):
+            break
+        catalogue = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            spec = {}
+            if isinstance(v, ast.Dict):
+                for sk, sv in zip(v.keys, v.values):
+                    if (isinstance(sk, ast.Constant)
+                            and isinstance(sv, ast.Constant)):
+                        spec[sk.value] = sv.value
+            catalogue[k.value] = {
+                "spec": spec, "line": k.lineno,
+            }
+        break
+    if catalogue is None:
+        return None, [Finding(
+            ID, pf.rel, cat_line,
+            "telemetry.METRICS catalogue is missing or not a literal "
+            "dict — the metric-name discipline cannot be checked",
+            rule="catalogue",
+        )]
+    findings = []
+    for name, info in catalogue.items():
+        spec = info["spec"]
+        help_text = spec.get("help")
+        if not (isinstance(help_text, str) and help_text.strip()):
+            findings.append(Finding(
+                ID, pf.rel, info["line"],
+                f"catalogue metric {name!r} is undocumented: declare "
+                f"a non-empty 'help' string (it becomes the Prometheus "
+                f"# HELP line)",
+                rule="undocumented",
+            ))
+        if spec.get("type") not in TYPES:
+            findings.append(Finding(
+                ID, pf.rel, info["line"],
+                f"catalogue metric {name!r} has invalid type "
+                f"{spec.get('type')!r}: expected one of {TYPES}",
+                rule="type",
+            ))
+    return catalogue, findings
+
+
+def scan_calls(pf, catalogue):
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in METHODS):
+            continue
+        if not _is_registry_receiver(func.value):
+            continue
+        if not node.args:
+            # name passed by keyword (or missing): the registry API
+            # takes it positional-only precisely so labels can use
+            # any keyword — a keyword name cannot reach it
+            yield Finding(
+                ID, pf.rel, node.lineno,
+                f"registry.{func.attr}(...) without a positional "
+                f"metric name — pass the catalogue name as the first "
+                f"argument",
+                rule="non-literal",
+            )
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            yield Finding(
+                ID, pf.rel, node.lineno,
+                f"registry.{func.attr}(...) metric name must be a "
+                f"string literal from telemetry.METRICS (dynamic "
+                f"dimensions belong in labels, not names)",
+                rule="non-literal",
+            )
+            continue
+        if arg.value not in catalogue:
+            yield Finding(
+                ID, pf.rel, node.lineno,
+                f"unknown metric name {arg.value!r}: declare it in "
+                f"telemetry.METRICS (with type and help) before use",
+                rule="unknown",
+            )
+
+
+class MetricNamesChecker:
+    id = ID
+    name = "metric-names"
+    doc = ("every registry.counter/gauge/histogram name is a string "
+           "literal declared and documented in telemetry.METRICS")
+
+    def check(self, project: Project):
+        catalogue, findings = parse_catalogue(project)
+        yield from findings
+        if catalogue is None:
+            return
+        for pf in project.under(SCOPE):
+            if pf.tree is not None:
+                yield from scan_calls(pf, catalogue)
+
+
+CHECKER = MetricNamesChecker()
