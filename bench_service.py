@@ -31,6 +31,27 @@ Gates (exit 1 on breach): the warm replay must reach
 2-vCPU runner can halve the warm phase; the recorded baseline
 documents >=10x on a quiet machine); ``--baseline`` additionally gates
 absolute warm qps like the other two benches.
+
+Siege mode (L13)::
+
+    python bench_service.py --siege --workers 4 --admission 32 \
+        --queries 100000 --vs-single results/siege_single.json
+
+replays a Zipf-skewed production-shaped burst instead of the uniform
+one: a fill phase visits a ``--siege-pool``-sized unique pool once
+(cold), then ``--queries`` popularity-skewed draws (``--zipf`` alpha)
+hammer the warm server — the hot head rides the pool's response
+memory cache, exactly like production traffic with a popular working
+set. With ``--admission`` an **overload phase** follows: fresh
+all-cold queries from ``--overload-threads`` clients (default far
+more than the workers can serve) must be load-shed with 429 +
+``Retry-After`` while the p99 of *admitted* requests stays under
+``--max-overload-p99-ms`` and every admitted request gets an answer.
+``--vs-single`` + ``--min-pool-speedup`` gate the siege qps against a
+same-machine single-process (``--workers 0``) siege baseline —
+re-record it on the same box, never compare against another machine's
+number. ``--dump-forensics DIR`` writes the final ``/stats`` and
+``/metrics`` bodies for CI artifact upload.
 """
 
 import argparse
@@ -136,6 +157,46 @@ def build_burst(n_queries: int, overlap: float, seed: int = 0):
     return burst, unique
 
 
+def zipf_burst(unique, n: int, alpha: float, seed: int = 0):
+    """``n`` popularity-skewed draws from the unique pool: ranks are a
+    seeded shuffle of the pool (popularity is independent of build
+    order) and rank ``r`` is drawn with weight ``1/(r+1)^alpha`` — the
+    classic Zipf head/tail shape of production query traffic."""
+    rng = random.Random(seed + 11)
+    order = list(range(len(unique)))
+    rng.shuffle(order)
+    weights = [1.0 / (r + 1) ** alpha for r in range(len(order))]
+    picks = rng.choices(range(len(order)), weights=weights, k=n)
+    return [unique[order[r]] for r in picks]
+
+
+#: overload-phase mbc values — disjoint from MBCS, so every overload
+#: body is a *new* content identity: all-cold traffic that saturates
+#: the workers and forces admission control to act
+OVERLOAD_MBCS = (6, 12, 24)
+
+
+def overload_burst(n: int, seed: int = 0):
+    """``n`` genuinely cold estimate queries (content identities
+    disjoint from the siege pool) for the overload phase."""
+    rng = random.Random(seed + 23)
+    combos = [
+        (m, s, sysn, seq, mbc)
+        for m in MODELS for s in STRATEGIES for sysn in SYSTEMS
+        for seq in SEQ_LENS for mbc in OVERLOAD_MBCS
+    ]
+    rng.shuffle(combos)
+    out = []
+    for m, s, sysn, seq, mbc in combos[:n]:
+        out.append(("/v1/estimate", {
+            "model": m,
+            "strategy": {"name": s, "seq_len": seq,
+                         "micro_batch_num": mbc},
+            "system": sysn,
+        }))
+    return out
+
+
 def resolve_strategy_body(body: dict) -> dict:
     """Expand the compact ``{"name": ..., "seq_len": ...}`` strategy
     spelling into an inline config dict (exercises the server's inline-
@@ -154,11 +215,27 @@ def resolve_strategy_body(body: dict) -> dict:
     return out
 
 
+def serialize_burst(burst):
+    """Pre-serialize every request body ONCE (clients of a production
+    service send ready-made bytes; re-deriving configs per request
+    would bill client-side work to the serving path under test)."""
+    cache = {}
+    out = []
+    for ep, body in burst:
+        key = (ep, json.dumps(body, sort_keys=True))
+        payload = cache.get(key)
+        if payload is None:
+            payload = cache[key] = json.dumps(
+                resolve_strategy_body(body))
+        out.append((ep, payload))
+    return out
+
+
 def replay(port: int, burst, threads: int):
     """Replay the burst with ``threads`` concurrent clients; returns
     (elapsed_s, sorted per-request latencies, error count)."""
     work = queue.Queue()
-    for i, item in enumerate(burst):
+    for i, item in enumerate(serialize_burst(burst)):
         work.put((i, item))
     lat = [0.0] * len(burst)
     errors = [0]
@@ -168,11 +245,10 @@ def replay(port: int, burst, threads: int):
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
         while True:
             try:
-                i, (ep, body) = work.get_nowait()
+                i, (ep, payload) = work.get_nowait()
             except queue.Empty:
                 conn.close()
                 return
-            payload = json.dumps(resolve_strategy_body(body))
             t0 = time.perf_counter()
             try:
                 conn.request("POST", ep, payload,
@@ -198,6 +274,249 @@ def replay(port: int, burst, threads: int):
     for t in ts:
         t.join()
     return time.perf_counter() - t0, sorted(lat), errors[0]
+
+
+def _request_bytes(ep: str, payload: str) -> bytes:
+    """One pre-built HTTP/1.1 request. Siege clients accept gzip like
+    any production HTTP client: large hot responses ride the
+    memcache's cached transport encoding."""
+    body = payload.encode("utf-8")
+    return (b"POST " + ep.encode("ascii") + b" HTTP/1.1\r\n"
+            b"Host: bench\r\nContent-Type: application/json\r\n"
+            b"Accept-Encoding: gzip\r\n"
+            b"Content-Length: " + str(len(body)).encode("ascii")
+            + b"\r\n\r\n" + body)
+
+
+def _read_response(sock, buf: bytes):
+    """Read exactly one Content-Length response; returns
+    (status, remaining buffer)."""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(1 << 16)
+        if not chunk:
+            raise OSError("server closed the connection")
+        buf += chunk
+    head, buf = buf.split(b"\r\n\r\n", 1)
+    status = int(head.split(b" ", 2)[1])
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        if line[:15].lower() == b"content-length:":
+            clen = int(line[15:])
+    while len(buf) < clen:
+        chunk = sock.recv(1 << 16)
+        if not chunk:
+            raise OSError("server closed mid-body")
+        buf += chunk
+    return status, buf[clen:]
+
+
+def _pipelined_worker(port: int, reqs, depth: int, lat, counts):
+    """One siege connection: keeps up to ``depth`` requests in flight
+    (HTTP/1.1 pipelining — the standard siege-harness technique that
+    amortizes per-request syscalls on both sides of the socket) and
+    accounts every response. Appends 2xx latencies to ``lat`` and
+    bumps ``counts`` in place (caller owns synchronization)."""
+    import collections
+    import socket as _socket
+
+    n = len(reqs)
+    sent_at = [0.0] * n
+    i = done = 0
+    inflight = collections.deque()
+    while done < n:
+        try:
+            sock = _socket.create_connection(("127.0.0.1", port),
+                                             timeout=600)
+            sock.setsockopt(_socket.IPPROTO_TCP,
+                            _socket.TCP_NODELAY, 1)
+            buf = b""
+            while done < n:
+                out = bytearray()
+                fresh = []
+                while len(inflight) < depth and i < n:
+                    out += reqs[i]
+                    inflight.append(i)
+                    fresh.append(i)
+                    i += 1
+                if out:
+                    now = time.perf_counter()
+                    for idx in fresh:
+                        sent_at[idx] = now
+                    sock.sendall(out)
+                status, buf = _read_response(sock, buf)
+                idx = inflight.popleft()
+                done += 1
+                if status == 200:
+                    counts["ok"] += 1
+                    lat.append(time.perf_counter() - sent_at[idx])
+                elif status == 429:
+                    counts["shed"] += 1
+                else:
+                    counts["error"] += 1
+        except OSError:
+            # a dropped connection loses its window: every in-flight
+            # request got no answer — that IS an error, counted once,
+            # and the rest of the shard continues on a fresh connection
+            counts["error"] += len(inflight)
+            done += len(inflight)
+            inflight.clear()
+        finally:
+            try:
+                sock.close()
+            except (OSError, UnboundLocalError):
+                pass
+
+
+def _counted_clients(port: int, items, threads: int, depth: int = 1):
+    """``threads`` keep-alive raw-socket connections drain the
+    pre-serialized ``items`` (round-robin shards), each with a
+    ``depth``-deep pipeline; returns (2xx latencies, counts)."""
+    reqs = [_request_bytes(ep, payload) for ep, payload in items]
+    shards = [reqs[i::threads] for i in range(threads)]
+    results = []
+    ts = []
+    for shard in shards:
+        if not shard:
+            continue
+        lat = []
+        counts = {"ok": 0, "shed": 0, "error": 0}
+        results.append((lat, counts))
+        ts.append(threading.Thread(
+            target=_pipelined_worker,
+            args=(port, shard, max(1, depth), lat, counts)))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    lat = []
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    for plat, pcounts in results:
+        lat.extend(plat)
+        for k, v in pcounts.items():
+            counts[k] += v
+    return lat, counts
+
+
+def _client_proc(port, shard, conns, depth, out_q):
+    # forked siege client: fresh sockets, no shared state with the
+    # in-process server — pure bytes/socket work
+    lat, counts = _counted_clients(port, shard, conns, depth=depth)
+    out_q.put((lat, counts))
+
+
+def replay_counted(port: int, burst, threads: int, procs: int = 1,
+                   depth: int = 1):
+    """Siege-phase replay with full status accounting. Returns
+    ``(elapsed_s, sorted 2xx latencies, counts)`` where counts has
+    ``ok`` / ``shed`` (429) / ``error`` — and their sum is
+    ``len(burst)``: every request got an answer (the admission
+    contract: shed fast or served, never dropped or hung).
+
+    With ``procs > 1`` the clients run in that many forked
+    *processes* (``threads`` connections split across them) — siege
+    clients must not share the server's GIL, exactly like the remote
+    clients of a production deployment."""
+    items = serialize_burst(burst)
+    if procs <= 1:
+        t0 = time.perf_counter()
+        lat, counts = _counted_clients(port, items, threads,
+                                       depth=depth)
+        return time.perf_counter() - t0, sorted(lat), counts
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("fork")
+    out_q = ctx.Queue()
+    # round-robin shards keep the hot/cold mix balanced per process
+    shards = [items[i::procs] for i in range(procs)]
+    conns = max(1, threads // procs)
+    ps = [ctx.Process(target=_client_proc,
+                      args=(port, shard, conns, depth, out_q),
+                      daemon=True)
+          for shard in shards if shard]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    lat = []
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    for _ in ps:
+        plat, pcounts = out_q.get()
+        lat.extend(plat)
+        for k, v in pcounts.items():
+            counts[k] += v
+    elapsed = time.perf_counter() - t0
+    for p in ps:
+        p.join()
+    return elapsed, sorted(lat), counts
+
+
+def start_server(args):
+    """Build the bench server exactly like ``cmd_serve`` does:
+    threaded by default, pooled (+ admission) under ``--workers`` /
+    ``--admission``. Returns ``(srv, port, cleanup)``."""
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.server import (
+        AdmissionController,
+        make_server,
+    )
+
+    tmp = None
+    cache_dir = args.cache_dir
+    if not cache_dir:
+        tmp = tempfile.mkdtemp(prefix="simumax-bench-service-")
+        cache_dir = tmp
+    pool = None
+    workers = getattr(args, "workers", 0)
+    if workers:
+        from simumax_tpu.service.pool import WorkerPool
+
+        pool = WorkerPool(cache_dir=cache_dir, workers=workers)
+        planner = Planner(store=pool.store)
+    else:
+        planner = Planner(cache_dir=cache_dir)
+    admission = None
+    backlog = getattr(args, "admission", 0)
+    if backlog:
+        admission = AdmissionController(backlog, pool=pool)
+    srv = make_server(planner, "127.0.0.1", 0, pool=pool,
+                      admission=admission)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+
+    def cleanup():
+        srv.shutdown()
+        srv.server_close()
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    return srv, port, cleanup
+
+
+def dump_forensics(port: int, out_dir: str):
+    """Write the final /stats and /metrics bodies — plus, when
+    ``--trace`` armed the tracer, the retained request span trees as
+    a chrome trace — so a failed CI gate ships its serving-side
+    evidence as artifacts."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "stats.json"), "w") as f:
+        json.dump(get_json(port, "/stats"), f, indent=2, default=str)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/metrics")
+    body = conn.getresponse().read()
+    conn.close()
+    with open(os.path.join(out_dir, "metrics.txt"), "wb") as f:
+        f.write(body)
+    from simumax_tpu.observe.telemetry import (
+        get_tracer,
+        write_chrome_trace,
+    )
+
+    tracer = get_tracer()
+    if tracer.enabled:
+        spans = tracer.drain()
+        if spans:
+            write_chrome_trace(
+                spans, os.path.join(out_dir, "trace.json"))
 
 
 def get_json(port: int, path: str) -> dict:
@@ -269,6 +588,154 @@ def check_parity(port: int, unique, seed: int = 0, samples: int = 4):
     return True, None
 
 
+def run_siege(args) -> int:
+    """The production-shaped siege: fill (cold) -> Zipf siege (warm,
+    the headline metric) -> overload (all-cold hammer vs admission
+    control) -> parity sample. One JSON line, exit 1 on any gate."""
+    srv, port, cleanup = start_server(args)
+    overload = None
+    try:
+        _burst, unique = build_burst(args.siege_pool, 0.0, args.seed)
+        fill_s, _fill_lat, fill_counts = replay_counted(
+            port, unique, args.threads, procs=args.client_procs,
+            depth=args.pipeline)
+        siege = zipf_burst(unique, args.queries, args.zipf, args.seed)
+        siege_s, siege_lat, siege_counts = replay_counted(
+            port, siege, args.threads, procs=args.client_procs,
+            depth=args.pipeline)
+        stats_end = get_json(port, "/stats")
+        if args.admission and args.overload_queries:
+            # depth 1: overload latency/shed semantics are per-request
+            oburst = overload_burst(args.overload_queries, args.seed)
+            overload = replay_counted(port, oburst,
+                                      args.overload_threads,
+                                      procs=args.client_procs)
+        parity_ok, parity_ep = (True, None) if args.skip_parity \
+            else check_parity(port, unique, args.seed)
+        if args.dump_forensics:
+            dump_forensics(port, args.dump_forensics)
+    finally:
+        cleanup()
+
+    qps_siege = len(siege) / siege_s if siege_s else 0.0
+    qps_fill = len(unique) / fill_s if fill_s else 0.0
+    result = {
+        "metric": "service_qps_siege",
+        "value": round(qps_siege, 2),
+        "unit": "q/s",
+        # mode encodes the traffic shape (pool size + skew): history
+        # series with different shapes never baseline each other
+        "mode": f"siege-pool{args.siege_pool}-z{args.zipf}",
+        "queries": len(siege),
+        "threads": args.threads,
+        "client_procs": args.client_procs,
+        "pipeline": args.pipeline,
+        "workers": args.workers,
+        "admission": args.admission,
+        "qps_fill": round(qps_fill, 2),
+        "fill_queries": len(unique),
+        "p50_siege_ms": round(pct(siege_lat, 0.50) * 1e3, 2),
+        "p99_siege_ms": round(pct(siege_lat, 0.99) * 1e3, 2),
+        "fill_elapsed_s": round(fill_s, 3),
+        "siege_elapsed_s": round(siege_s, 3),
+        "errors": fill_counts["error"] + siege_counts["error"],
+        "shed_outside_overload": fill_counts["shed"]
+        + siege_counts["shed"],
+        "parity_ok": parity_ok,
+    }
+    if args.workers:
+        mc = (stats_end.get("pool") or {}).get("memcache") or {}
+        result["memcache_hits"] = mc.get("hits", 0)
+        result["memcache_entries"] = mc.get("entries", 0)
+    ok = True
+    if result["errors"]:
+        result["errors_ok"] = ok = False
+    if result["shed_outside_overload"]:
+        # fill/siege clients never outnumber the admission budget; a
+        # shed here means the bench was misconfigured
+        result["shed_ok"] = ok = False
+    if not parity_ok:
+        result["parity_endpoint"] = parity_ep
+        ok = False
+    if overload is not None:
+        o_s, o_lat, o_counts = overload
+        answered = sum(o_counts.values())
+        o_p99_ms = pct(o_lat, 0.99) * 1e3 if o_lat else 0.0
+        result.update({
+            # the actual burst length: overload_burst caps at its
+            # cold-combo pool, so a large --overload-queries yields
+            # fewer queries than asked
+            "overload_queries": len(oburst),
+            "overload_threads": args.overload_threads,
+            "overload_elapsed_s": round(o_s, 3),
+            "overload_admitted": o_counts["ok"],
+            "overload_shed": o_counts["shed"],
+            "overload_errors": o_counts["error"],
+            "overload_p99_ms": round(o_p99_ms, 2),
+        })
+        # the admission contract, gated: every request answered (none
+        # dropped/hung), real shedding happened, admitted p99 bounded
+        if answered != len(oburst) or o_counts["error"]:
+            result["overload_answered_ok"] = ok = False
+        if not o_counts["shed"]:
+            result["overload_shed_ok"] = ok = False
+        if o_p99_ms > args.max_overload_p99_ms:
+            result["overload_p99_ok"] = ok = False
+    if args.vs_single:
+        with open(args.vs_single) as f:
+            base = json.load(f)
+        if base.get("workers", -1) != 0 \
+                or base.get("metric") != "service_qps_siege":
+            print(json.dumps({
+                "error": f"--vs-single {args.vs_single} is not a "
+                         f"single-process siege baseline (need "
+                         f"workers=0, metric=service_qps_siege); "
+                         f"re-record it on this machine with "
+                         f"--siege --workers 0",
+            }))
+            return 2
+        for key in ("mode", "queries", "threads", "client_procs",
+                    "pipeline"):
+            if base.get(key) != result[key]:
+                print(json.dumps({
+                    "error": f"--vs-single {key} {base.get(key)!r} != "
+                             f"this run's {result[key]!r}; not "
+                             f"comparable — re-record with matching "
+                             f"flags",
+                }))
+                return 2
+        speedup = qps_siege / base["value"] if base["value"] else 0.0
+        result["single_qps"] = base["value"]
+        result["pool_speedup"] = round(speedup, 2)
+        if args.workers and speedup < args.min_pool_speedup:
+            result["pool_speedup_ok"] = ok = False
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if not isinstance(base.get("value"), (int, float)):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field",
+            }))
+            return 2
+        for key in ("mode", "queries", "threads", "workers",
+                    "admission"):
+            if base.get(key, result[key]) != result[key]:
+                print(json.dumps({
+                    "error": f"baseline {key} {base.get(key)!r} != "
+                             f"this run's {result[key]!r}; not "
+                             f"comparable",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression_ok"] = qps_siege >= floor
+        ok = ok and result["regression_ok"]
+    print(json.dumps(result))
+    record_safely(result)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--queries", type=int, default=1000,
@@ -305,26 +772,79 @@ def main(argv=None):
                          "for the whole burst — the telemetry-overhead "
                          "gate runs the bench this way and compares "
                          "against the tracing-off baseline")
+    ap.add_argument("--siege", action="store_true",
+                    help="siege mode: Zipf-skewed replay + overload "
+                         "phase (see the module docstring)")
+    ap.add_argument("--siege-pool", type=int, default=512,
+                    metavar="N",
+                    help="siege unique-pool size (default 512)")
+    ap.add_argument("--zipf", type=float, default=1.1, metavar="A",
+                    help="siege popularity skew: rank r drawn with "
+                         "weight 1/(r+1)^A (default 1.1)")
+    ap.add_argument("--workers", type=int, default=0, metavar="N",
+                    help="siege only: serve through a pool of N "
+                         "planner worker processes (0 = the threaded "
+                         "single-process server)")
+    ap.add_argument("--admission", type=int, default=0,
+                    metavar="BACKLOG",
+                    help="siege only: admission-control backlog "
+                         "budget (0 = admit everything; required for "
+                         "the overload phase)")
+    ap.add_argument("--overload-queries", type=int, default=600,
+                    metavar="N",
+                    help="all-cold queries hammered in the overload "
+                         "phase (default 600; 0 skips the phase)")
+    ap.add_argument("--overload-threads", type=int, default=64,
+                    metavar="N",
+                    help="overload-phase client connections "
+                         "(default 64 — far beyond the worker pool, "
+                         "so shedding must engage)")
+    ap.add_argument("--pipeline", type=int, default=8, metavar="D",
+                    help="siege fill/replay pipeline depth per "
+                         "connection (HTTP/1.1 pipelining, the "
+                         "standard siege-harness technique; the "
+                         "overload phase always runs depth 1)")
+    ap.add_argument("--client-procs", type=int,
+                    default=min(4, os.cpu_count() or 1), metavar="P",
+                    help="siege only: run the replay clients in P "
+                         "forked processes (connections split across "
+                         "them) so client work never shares the "
+                         "server's GIL — like production's remote "
+                         "clients (default min(4, cpus))")
+    ap.add_argument("--max-overload-p99-ms", type=float,
+                    default=10000.0, metavar="MS",
+                    help="overload-phase p99 bound over ADMITTED "
+                         "requests (default 10000 ms; without "
+                         "admission control the queue — and p99 — "
+                         "grows without bound)")
+    ap.add_argument("--vs-single", metavar="JSON",
+                    help="single-process (--workers 0) siege JSON "
+                         "line recorded on THIS machine; gates "
+                         "--min-pool-speedup against it")
+    ap.add_argument("--min-pool-speedup", type=float, default=10.0,
+                    help="min pooled-vs-single siege qps ratio "
+                         "(default 10)")
+    ap.add_argument("--dump-forensics", metavar="DIR",
+                    help="write the final /stats + /metrics bodies "
+                         "to DIR (CI uploads them on gate failure)")
     args = ap.parse_args(argv)
-
-    from simumax_tpu.service.planner import Planner
-    from simumax_tpu.service.server import make_server
 
     if args.trace:
         from simumax_tpu.observe.telemetry import get_tracer
 
         get_tracer().configure(enabled=True)
 
-    tmp = None
-    cache_dir = args.cache_dir
-    if not cache_dir:
-        tmp = tempfile.mkdtemp(prefix="simumax-bench-service-")
-        cache_dir = tmp
-    planner = Planner(cache_dir=cache_dir)
-    srv = make_server(planner, "127.0.0.1", 0)
-    port = srv.server_address[1]
-    thread = threading.Thread(target=srv.serve_forever, daemon=True)
-    thread.start()
+    if args.siege:
+        return run_siege(args)
+    if args.workers or args.admission:
+        print(json.dumps({
+            "error": "--workers/--admission are siege-mode flags; "
+                     "the classic burst keeps PR-9's single-process "
+                     "baseline semantics (add --siege)",
+        }))
+        return 2
+
+    srv, port, cleanup = start_server(args)
     try:
         burst, unique = build_burst(args.queries, args.overlap,
                                     args.seed)
@@ -335,10 +855,7 @@ def main(argv=None):
         parity_ok, parity_ep = (True, None) if args.skip_parity \
             else check_parity(port, unique, args.seed)
     finally:
-        srv.shutdown()
-        srv.server_close()
-        if tmp:
-            shutil.rmtree(tmp, ignore_errors=True)
+        cleanup()
 
     def counters(s):
         return s["store"]["counters"]

@@ -1024,15 +1024,14 @@ def cmd_straggler(args):
 
 def cmd_serve(args):
     from simumax_tpu.service.planner import Planner
-    from simumax_tpu.service.server import make_server, serve_forever
+    from simumax_tpu.service.server import (
+        AdmissionController,
+        make_server,
+        serve_forever,
+    )
 
     max_bytes = (
         args.cache_max_mb * 1024 * 1024 if args.cache_max_mb else None
-    )
-    planner = Planner(
-        cache_dir=args.cache_dir,
-        enabled=_cache_enabled(args),
-        max_bytes=max_bytes,
     )
     trace_log = None
     if args.trace_requests:
@@ -1043,19 +1042,69 @@ def cmd_serve(args):
         os.makedirs(args.trace_requests, exist_ok=True)
         trace_log = os.path.join(args.trace_requests, "requests.jsonl")
         get_tracer().configure(enabled=True)
+    enabled = _cache_enabled(args)
+    pool = None
+    if args.workers:
+        from simumax_tpu.service.pool import WorkerPool
+
+        pool = WorkerPool(
+            cache_dir=args.cache_dir, enabled=enabled,
+            workers=args.workers, max_bytes=max_bytes,
+            request_timeout=args.request_timeout or None,
+            trace=bool(args.trace_requests),
+        )
+        # the in-process planner still serves streaming sweeps and
+        # /stats; it shares the pool's single-writer store (same
+        # process), so parent and workers see one cache
+        planner = Planner(store=pool.store, enabled=enabled)
+    else:
+        planner = Planner(
+            cache_dir=args.cache_dir, enabled=enabled,
+            max_bytes=max_bytes,
+        )
+    warmer = None
+    if args.warm:
+        from simumax_tpu.service.warmer import (
+            Warmer,
+            pool_runner,
+            warm_cells,
+        )
+
+        runner = (
+            pool_runner(pool, max_cells=args.warm_cells)
+            if pool is not None else
+            lambda spec: warm_cells(planner, spec,
+                                    max_cells=args.warm_cells)
+        )
+        warmer = Warmer(
+            runner, store=pool.store if pool is not None
+            else planner.store,
+            max_jobs=args.warm, max_cells=args.warm_cells,
+        )
+    admission = AdmissionController(args.admission, pool=pool) \
+        if args.admission else None
     srv = make_server(planner, args.host, args.port,
-                      trace_log=trace_log)
+                      trace_log=trace_log, pool=pool,
+                      admission=admission, warmer=warmer)
     host, port = srv.server_address[:2]
     cache_desc = (
         planner.store.root if planner.enabled else "disabled"
     )
+    mode_desc = (
+        f"pool of {pool.workers} workers" if pool else "threaded"
+    )
     _log().info(
         f"[serve] planning service on http://{host}:{port} "
-        f"(cache: {cache_desc}) — GET /healthz /stats /metrics, "
-        f"POST /v1/estimate /v1/explain /v1/search /v1/faults "
-        f"/v1/simulate"
+        f"({mode_desc}; cache: {cache_desc}) — GET /healthz /stats "
+        f"/metrics, POST /v1/estimate /v1/explain /v1/search "
+        f"/v1/faults /v1/simulate"
+        + (f"; admission backlog {args.admission}" if admission
+           else "")
+        + (f"; warm queue {args.warm}" if warmer else "")
         + (f"; request traces -> {trace_log}" if trace_log else ""),
         event="serve_start", host=host, port=port, cache=cache_desc,
+        workers=args.workers, admission=args.admission,
+        warm=args.warm,
     )
     serve_forever(srv)
 
@@ -1529,6 +1578,40 @@ def main(argv=None):
         "--cache-max-mb", type=int, default=0, metavar="MB",
         help="store size budget in MiB (default: the store's 512 MiB "
              "default; LRU-evicted beyond it)",
+    )
+    psv.add_argument(
+        "--workers", type=int, default=0, metavar="N",
+        help="serve through a pool of N planner worker processes "
+             "(read-only store replicas + a single parent-side "
+             "writer, request coalescing, response memory cache, "
+             "worker respawn/retry — docs/service.md 'Production "
+             "deployment'). Default 0: the in-process threaded "
+             "server",
+    )
+    psv.add_argument(
+        "--warm", type=int, default=0, metavar="JOBS",
+        help="speculatively precompute the neighbor sweep cells of "
+             "each served search (one index step per swept axis) in a "
+             "background warmer with a JOBS-deep bounded queue. "
+             "Default 0: off",
+    )
+    psv.add_argument(
+        "--warm-cells", type=int, default=64, metavar="N",
+        help="max neighbor cells one warm job may evaluate "
+             "(default 64)",
+    )
+    psv.add_argument(
+        "--admission", type=int, default=0, metavar="BACKLOG",
+        help="admission control: shed requests with 429 + Retry-After "
+             "once the queued+in-flight backlog reaches BACKLOG "
+             "(scaled per X-SimuMax-Priority class: low sheds at "
+             "0.5x, high at 1.5x). Default 0: admit everything",
+    )
+    psv.add_argument(
+        "--request-timeout", type=float, default=0, metavar="SEC",
+        help="pooled mode: per-request SIGALRM deadline on the worker "
+             "(plus the 5x+30s hard kill backstop). Default 0: no "
+             "deadline",
     )
     _add_cache_args(psv)
     _add_log_args(psv)

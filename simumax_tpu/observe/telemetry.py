@@ -111,6 +111,70 @@ METRICS: Dict[str, Dict[str, str]] = {
         "help": "Span records dropped because a trace exceeded the "
                 "tracer's per-trace buffer bound.",
     },
+    "pool_workers": {
+        "type": "gauge",
+        "help": "Live planner worker processes in the serving pool.",
+    },
+    "pool_queue_depth": {
+        "type": "gauge",
+        "help": "Requests queued in the worker pool awaiting a "
+                "worker, by priority class.",
+    },
+    "pool_requests_total": {
+        "type": "counter",
+        "help": "Requests executed by pool workers, by outcome "
+                "(ok/error/timeout).",
+    },
+    "pool_queue_wait_seconds": {
+        "type": "histogram",
+        "help": "Wall time a pooled request spent between submission "
+                "and a worker picking it up.",
+    },
+    "pool_worker_restarts_total": {
+        "type": "counter",
+        "help": "Worker processes respawned after dying or being "
+                "killed by the request hard-deadline.",
+    },
+    "pool_retries_total": {
+        "type": "counter",
+        "help": "Requests retried on another worker after their "
+                "assigned worker died mid-query.",
+    },
+    "pool_coalesced_total": {
+        "type": "counter",
+        "help": "Requests coalesced onto an identical in-flight "
+                "request instead of dispatching to a worker.",
+    },
+    "pool_memcache_hits_total": {
+        "type": "counter",
+        "help": "Requests served from the in-memory response cache "
+                "(dependency-validated canonical bytes).",
+    },
+    "pool_memcache_entries": {
+        "type": "gauge",
+        "help": "Entries currently held by the in-memory response "
+                "cache.",
+    },
+    "coalesce_cells_total": {
+        "type": "counter",
+        "help": "Sweep-cell flight-table events, by role "
+                "(leader/follower/abandoned).",
+    },
+    "warmer_jobs_total": {
+        "type": "counter",
+        "help": "Speculative cache-warming jobs, by outcome (warmed/"
+                "duplicate/dropped/skipped_headroom/error).",
+    },
+    "warmer_cells_total": {
+        "type": "counter",
+        "help": "Neighbor sweep cells precomputed into the store by "
+                "the speculative warmer.",
+    },
+    "admission_rejected_total": {
+        "type": "counter",
+        "help": "Requests shed with 429 by admission control, by "
+                "priority class.",
+    },
 }
 
 #: default bounded-reservoir size for histograms: big enough for stable

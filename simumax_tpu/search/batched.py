@@ -62,7 +62,7 @@ from simumax_tpu.core.config import (
     StrategyConfig,
     SystemConfig,
 )
-from simumax_tpu.core.errors import FeasibilityError
+from simumax_tpu.core.errors import FeasibilityError, SimulationError
 from simumax_tpu.core.module import GemmBase
 from simumax_tpu.models.dense import CoreAttention
 from simumax_tpu.models.moe import GroupLinearBase
@@ -510,6 +510,325 @@ def _jit_fold_1f1b(pp: int, mbc: int):
         _FOLD_JIT_CACHE.clear()
     _FOLD_JIT_CACHE[(pp, mbc)] = fn
     return fn
+
+
+def _jit_fold_interleaved(pp: int, vp: int, mbc: int, group: int):
+    """Build (or fetch) the jitted vmapped interleaved (vp > 1) fold
+    for one (pp, vp, mbc, group) shape — the VPP analog of
+    :func:`_jit_fold_1f1b` (the named L11 follow-on, ROADMAP item 3).
+
+    Every op of the cached flat order is static, so its dependency
+    *index* (which earlier F/B entry it waits on) and its blocking
+    flag are precomputed host-side; the scan body is pure
+    gather/max/add — exactly the float-op sequence of
+    :func:`fold_interleaved`, hence bit-identical under x64 (pinned in
+    tests/test_batched.py). Must be called (traced AND executed)
+    inside ``jax.experimental.enable_x64()``."""
+    key = ("vpp", pp, vp, mbc, group)
+    got = _FOLD_JIT_CACHE.get(key)
+    if got is not None:
+        return got
+    import jax
+    import jax.numpy as jnp
+
+    flat = _flat_interleaved_order(pp, mbc, vp, group)
+    last = pp - 1
+    s_l, k_l, c_l, m_l = [], [], [], []
+    ds_l, dc_l, dm_l, dep_l, blk_l = [], [], [], [], []
+    for s, kind, c, mb in flat:
+        s_l.append(s)
+        k_l.append(kind)
+        c_l.append(c)
+        m_l.append(mb)
+        if kind == 0:
+            if s > 0:
+                dep = (s - 1, c, mb)
+            elif c > 0:
+                dep = (last, c - 1, mb)
+            else:
+                dep = None
+            blk = s < last or c < vp - 1
+        else:
+            if s < last:
+                dep = (s + 1, c, mb)
+            elif c < vp - 1:
+                dep = (0, c + 1, mb)
+            else:
+                dep = None
+            blk = s > 0 or c > 0
+        ds_l.append(dep[0] if dep else 0)
+        dc_l.append(dep[1] if dep else 0)
+        dm_l.append(dep[2] if dep else 0)
+        dep_l.append(1.0 if dep else 0.0)
+        blk_l.append(1.0 if blk else 0.0)
+    ops = (
+        jnp.array(s_l, dtype=jnp.int32),
+        jnp.array(k_l, dtype=jnp.int32),
+        jnp.array(c_l, dtype=jnp.int32),
+        jnp.array(m_l, dtype=jnp.int32),
+        jnp.array(ds_l, dtype=jnp.int32),
+        jnp.array(dc_l, dtype=jnp.int32),
+        jnp.array(dm_l, dtype=jnp.int32),
+        jnp.array(dep_l, dtype=jnp.float64),
+        jnp.array(blk_l, dtype=jnp.float64),
+    )
+
+    def fold_one(fwd, bwd, p2p, blocking):
+        # fwd/bwd: (pp, vp) per-chunk times of ONE candidate
+        F0 = jnp.zeros((pp, vp, mbc), dtype=jnp.float64)
+        B0 = jnp.zeros((pp, vp, mbc), dtype=jnp.float64)
+        clock0 = jnp.zeros((pp,), dtype=jnp.float64)
+
+        def step(carry, op):
+            clock, F, B = carry
+            s, kind, c, mb, ds, dc, dm, hasdep, blk = op
+            cl = clock[s]
+            isF = kind == 0
+            depv = jnp.where(isF, F[ds, dc, dm], B[ds, dc, dm]) + p2p
+            dep = jnp.where(hasdep > 0, depv, cl)
+            start = jnp.maximum(cl, dep)
+            end0 = start + jnp.where(isF, fwd[s, c], bwd[s, c])
+            F = F.at[s, c, mb].set(jnp.where(isF, end0, F[s, c, mb]))
+            B = B.at[s, c, mb].set(jnp.where(isF, B[s, c, mb], end0))
+            clock = clock.at[s].set(end0 + blk * blocking)
+            return (clock, F, B), None
+
+        (clock, _, _), _ = jax.lax.scan(step, (clock0, F0, B0), ops)
+        return jnp.max(clock), clock
+
+    fn = jax.jit(
+        jax.vmap(fold_one, in_axes=(2, 2, 0, 0), out_axes=(0, 1)))
+    if len(_FOLD_JIT_CACHE) > 256:
+        _FOLD_JIT_CACHE.clear()
+    _FOLD_JIT_CACHE[key] = fn
+    return fn
+
+
+# --------------------------------------------------------------------------
+# Fold dispatch: one candidate batch (inline) or a whole sweep's
+# screening batch (FoldBatch)
+# --------------------------------------------------------------------------
+
+#: minimum cross-cell fold-group size for FoldBatch (sweep-wide guided
+#: screening) to dispatch the jitted fold — far below JIT_GROUP_MIN
+#: because one batched dispatch amortizes over every *cell* of the
+#: sweep sharing the schedule shape, not over one family's candidates
+FOLD_BATCH_JIT_MIN = 16
+
+
+class _FoldJob:
+    """One ``score`` call's pipeline-schedule fold: the inputs the
+    fold needs, the (totals, ends) it produces, and the ``finalize``
+    closure that turns them into the score dict."""
+
+    __slots__ = ("pp", "vp", "group", "async_p2p", "mbc_a",
+                 "need_cost", "stage_fwd", "stage_bwd", "chunk_fwd",
+                 "chunk_bwd", "p2p_t", "finalize", "totals", "ends")
+
+    def __init__(self, pp, vp, group, async_p2p, mbc_a, need_cost,
+                 stage_fwd, stage_bwd, chunk_fwd, chunk_bwd, p2p_t):
+        self.pp = pp
+        self.vp = vp
+        self.group = group
+        self.async_p2p = async_p2p
+        self.mbc_a = mbc_a
+        self.need_cost = need_cost
+        self.stage_fwd = stage_fwd
+        self.stage_bwd = stage_bwd
+        self.chunk_fwd = chunk_fwd
+        self.chunk_bwd = chunk_bwd
+        self.p2p_t = p2p_t
+        self.finalize = None
+        self.totals = None
+        self.ends = None
+
+
+def _fold_numpy_one(job: _FoldJob, i: int):
+    """The numpy fold of one candidate — the scalar-parity reference
+    path (exactly the pre-batching per-candidate code)."""
+    pp, vp = job.pp, job.vp
+    if pp == 1:
+        tot = job.mbc_a[i] * (job.stage_fwd[0][i] + job.stage_bwd[0][i])
+        return tot, [tot]
+    if vp > 1:
+        fwds = [[float(job.chunk_fwd[(s, c)][i]) for c in range(vp)]
+                for s in range(pp)]
+        bwds = [[float(job.chunk_bwd[(s, c)][i]) for c in range(vp)]
+                for s in range(pp)]
+        return fold_interleaved(pp, vp, int(job.mbc_a[i]), job.group,
+                                fwds, bwds, job.p2p_t[i],
+                                job.async_p2p)
+    fwds = [job.stage_fwd[s][i] for s in range(pp)]
+    bwds = [job.stage_bwd[s][i] for s in range(pp)]
+    return fold_1f1b(pp, int(job.mbc_a[i]), fwds, bwds, job.p2p_t[i],
+                     job.async_p2p)
+
+
+def _fold_members_jit(members, pp: int, vp: int, mbc: int, group: int):
+    """Fold one shape-group of ``(job, candidate)`` members through
+    the jitted vmapped scan and scatter totals/ends back into each
+    job. Members may span jobs (FoldBatch) or belong to one (inline
+    dispatch); mixed ``pp_comm_async`` is fine — blocking is data.
+    Caller must hold ``jax.experimental.enable_x64()``."""
+    p2p_vec = np.array([float(job.p2p_t[i]) for job, i in members])
+    blocking_vec = np.array([
+        0.0 if job.async_p2p else float(job.p2p_t[i])
+        for job, i in members
+    ])
+    if vp == 1:
+        fn = _jit_fold_1f1b(pp, mbc)
+        fwd_mat = np.stack([
+            np.array([job.stage_fwd[s][i] for job, i in members])
+            for s in range(pp)
+        ])
+        bwd_mat = np.stack([
+            np.array([job.stage_bwd[s][i] for job, i in members])
+            for s in range(pp)
+        ])
+    else:
+        fn = _jit_fold_interleaved(pp, vp, mbc, group)
+        fwd_mat = np.stack([
+            [np.array([float(job.chunk_fwd[(s, c)][i])
+                       for job, i in members]) for c in range(vp)]
+            for s in range(pp)
+        ])
+        bwd_mat = np.stack([
+            [np.array([float(job.chunk_bwd[(s, c)][i])
+                       for job, i in members]) for c in range(vp)]
+            for s in range(pp)
+        ])
+    tot, ends_g = fn(fwd_mat, bwd_mat, p2p_vec, blocking_vec)
+    tot = np.asarray(tot)
+    ends_g = np.asarray(ends_g)
+    for k, (job, i) in enumerate(members):
+        job.totals[i] = tot[k]
+        job.ends[:, i] = ends_g[:, k]
+
+
+def _fold_job(job: _FoldJob, backend: str,
+              jit_min: int = JIT_GROUP_MIN):
+    """Fold one candidate batch inline: candidates sharing a schedule
+    shape ride one vmapped jitted scan when the backend allows
+    (``jax`` always; ``auto`` only for groups big enough to amortize
+    the XLA dispatch), everything else takes the numpy fold. Results
+    are bit-identical either way (x64; pinned in
+    tests/test_batched.py) — both the 1F1B and, since L13, the
+    interleaved (vp > 1) schedule lower to a scan."""
+    ncand = len(job.mbc_a)
+    job.totals = np.empty(ncand)
+    job.ends = np.empty((job.pp, ncand))
+    jit_groups: Dict[int, List[int]] = {}
+    if job.pp > 1 and backend in ("jax", "auto") and jax_available():
+        by_mbc: Dict[int, List[int]] = {}
+        for i in range(ncand):
+            if job.need_cost[i]:
+                by_mbc.setdefault(int(job.mbc_a[i]), []).append(i)
+        for mbc_i, idxs in by_mbc.items():
+            if backend == "jax" or len(idxs) >= jit_min:
+                jit_groups[mbc_i] = idxs
+    folded = set()
+    if jit_groups:
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            for mbc_i, idxs in jit_groups.items():
+                _fold_members_jit([(job, i) for i in idxs], job.pp,
+                                  job.vp, mbc_i, job.group)
+                folded.update(idxs)
+    for i in range(ncand):
+        if i in folded:
+            continue
+        if not job.need_cost[i]:
+            job.totals[i] = math.inf
+            job.ends[:, i] = math.inf
+            continue
+        tot, ends_i = _fold_numpy_one(job, i)
+        job.totals[i] = tot
+        for s in range(job.pp):
+            job.ends[s, i] = ends_i[s]
+
+
+class FoldBatch:
+    """Cross-cell fold batcher for sweep-wide guided screening
+    (``BatchedScorer.screen_cells``).
+
+    Per-cell ``screen_cell`` scores one candidate per call, so a
+    500-cell screen runs 500 Python schedule folds — none big enough
+    for the inline jit dispatch. Here every deferred ``score`` call
+    registers its fold inputs instead; :meth:`run` folds ALL
+    registered candidates grouped by schedule shape — one vmapped
+    jitted call per (pp, vp, mbc, group) across the whole sweep —
+    then each deferred call's finalize produces its score dict.
+    Outputs are bit-identical to the inline per-call fold (same float
+    ops on the same values), so batching the screen can never change
+    a triple (asserted in tests/test_batched.py)."""
+
+    def __init__(self, jit_min: int = FOLD_BATCH_JIT_MIN):
+        self.jit_min = jit_min
+        self._jobs: List[_FoldJob] = []
+        self._ran = False
+        #: shape-group accounting: {(pp, vp, mbc, group): n_members}
+        #: of the groups the last run() dispatched to the jitted fold
+        self.jit_dispatched: Dict[tuple, int] = {}
+
+    def defer(self, job: _FoldJob):
+        """Register one score call's fold; returns the thunk that
+        yields its score dict after :meth:`run`."""
+        self._jobs.append(job)
+
+        def result():
+            if not self._ran:
+                raise SimulationError(
+                    "FoldBatch.run() must be called before reading a "
+                    "deferred score")
+            return job.finalize(job.totals, job.ends)
+
+        return result
+
+    def run(self, backend: str = "auto"):
+        """Execute every registered fold, batched across jobs."""
+        groups: Dict[tuple, list] = {}
+        use_jax = backend in ("jax", "auto") and jax_available()
+        for job in self._jobs:
+            ncand = len(job.mbc_a)
+            job.totals = np.empty(ncand)
+            job.ends = np.empty((job.pp, ncand))
+            for i in range(ncand):
+                if not job.need_cost[i]:
+                    job.totals[i] = math.inf
+                    job.ends[:, i] = math.inf
+                elif use_jax and job.pp > 1:
+                    key = (job.pp, job.vp, int(job.mbc_a[i]),
+                           job.group if job.vp > 1 else 0)
+                    groups.setdefault(key, []).append((job, i))
+                else:
+                    tot, ends_i = _fold_numpy_one(job, i)
+                    job.totals[i] = tot
+                    for s in range(job.pp):
+                        job.ends[s, i] = ends_i[s]
+        jit_groups = {
+            key: members for key, members in groups.items()
+            if backend == "jax" or len(members) >= self.jit_min
+        }
+        for key, members in groups.items():
+            if key in jit_groups:
+                continue
+            for job, i in members:
+                tot, ends_i = _fold_numpy_one(job, i)
+                job.totals[i] = tot
+                for s in range(job.pp):
+                    job.ends[s, i] = ends_i[s]
+        if jit_groups:
+            from jax.experimental import enable_x64
+
+            with enable_x64():
+                for (pp, vp, mbc_i, group), members \
+                        in jit_groups.items():
+                    _fold_members_jit(members, pp, vp, mbc_i, group)
+        self.jit_dispatched = {
+            key: len(members) for key, members in jit_groups.items()
+        }
+        self._ran = True
 
 
 # --------------------------------------------------------------------------
@@ -1741,7 +2060,8 @@ class _Kernel:
     def score(self, mbs: Sequence[int], mbc: Sequence[int],
               nrc: Optional[Sequence[int]] = None,
               cost_margin: Optional[float] = None,
-              backend: str = "auto") -> Optional[dict]:
+              backend: str = "auto",
+              fold_batch: Optional[FoldBatch] = None) -> Optional[dict]:
         """Score a candidate batch: arrays of ``micro_batch_size``,
         ``micro_batch_num``, and (for full-block recompute) the probed
         ``recompute_layer_num`` per candidate. Returns per-candidate
@@ -1754,7 +2074,14 @@ class _Kernel:
         feasibility margin (their ``iter_time`` comes back ``inf`` /
         ``mfu`` 0) — the selection walks never consume the cost of a
         non-fitting candidate. Pass ``None`` for full scoring (the
-        parity tests do)."""
+        parity tests do).
+
+        ``fold_batch`` defers the schedule fold into a sweep-wide
+        :class:`FoldBatch`: instead of the score dict, the call
+        returns a zero-arg thunk that yields it after
+        ``fold_batch.run()`` — the cross-cell batching behind
+        ``BatchedScorer.screen_cells``. (``None`` for a whole-family
+        invalid result is still returned directly.)"""
         if self.invalid is not None:
             return None
         st, m = self.st, self.model
@@ -2017,101 +2344,57 @@ class _Kernel:
             cap_fit = cap - cost_margin * GiB
             need_cost = [bool(max_peak[i] <= cap_fit)
                          for i in range(ncand)]
-        totals = np.empty(ncand)
-        ends = np.empty((pp, ncand))
-        # jax backend: candidates sharing (pp, mbc) ride one vmapped
-        # jitted scan instead of a Python fold each. Results are
-        # bit-identical to the numpy fold (x64; pinned in tests), so
-        # "auto" may mix backends freely — it dispatches to XLA only
-        # when the group is big enough to amortize the call overhead.
-        folded = [False] * ncand
-        jit_groups: Dict[int, List[int]] = {}
-        if pp > 1 and vp == 1 and backend in ("jax", "auto") \
-                and jax_available():
-            by_mbc: Dict[int, List[int]] = {}
-            for i in range(ncand):
-                if need_cost[i]:
-                    by_mbc.setdefault(int(mbc_a[i]), []).append(i)
-            for mbc_i, idxs in by_mbc.items():
-                if backend == "jax" or len(idxs) >= JIT_GROUP_MIN:
-                    jit_groups[mbc_i] = idxs
-        if jit_groups:
-            from jax.experimental import enable_x64
+        # the schedule fold — the only sequential recurrence left —
+        # rides a _FoldJob: inline it dispatches right here (jax
+        # backend: candidates sharing a schedule shape ride one
+        # vmapped jitted scan — 1F1B and, since L13, the interleaved
+        # vp>1 fold too — bit-identical to the numpy fold; x64,
+        # pinned in tests); deferred (``fold_batch``) the job joins a
+        # sweep-wide cross-cell batch and this call returns a thunk.
+        job = _FoldJob(pp, vp, st.vpp_group_size, st.pp_comm_async,
+                       mbc_a, need_cost, stage_fwd, stage_bwd,
+                       chunk_fwd, chunk_bwd, p2p_t)
 
-            with enable_x64():
-                for mbc_i, idxs in jit_groups.items():
-                    fn = _jit_fold_1f1b(pp, mbc_i)
-                    fwd_mat = np.stack(
-                        [stage_fwd[s][idxs] for s in range(pp)])
-                    bwd_mat = np.stack(
-                        [stage_bwd[s][idxs] for s in range(pp)])
-                    p2p_vec = np.asarray(p2p_t)[idxs]
-                    blocking_vec = p2p_vec if not st.pp_comm_async \
-                        else np.zeros(len(idxs))
-                    tot, ends_g = fn(fwd_mat, bwd_mat, p2p_vec,
-                                     blocking_vec)
-                    totals[idxs] = np.asarray(tot)
-                    ends[:, idxs] = np.asarray(ends_g)
-                    for i in idxs:
-                        folded[i] = True
-        for i in range(ncand):
-            if folded[i]:
-                continue
-            if not need_cost[i]:
-                totals[i] = math.inf
-                ends[:, i] = math.inf
-                continue
-            if pp == 1:
-                tot = mbc_a[i] * (stage_fwd[0][i] + stage_bwd[0][i])
-                totals[i] = tot
-                ends[0, i] = tot
-            elif vp > 1:
-                fwds = [[float(chunk_fwd[(s, c)][i]) for c in range(vp)]
-                        for s in range(pp)]
-                bwds = [[float(chunk_bwd[(s, c)][i]) for c in range(vp)]
-                        for s in range(pp)]
-                tot, ends_i = fold_interleaved(
-                    pp, vp, int(mbc_a[i]), st.vpp_group_size, fwds,
-                    bwds, p2p_t[i], st.pp_comm_async)
-                totals[i] = tot
-                for s in range(pp):
-                    ends[s, i] = ends_i[s]
-            else:
-                fwds = [stage_fwd[s][i] for s in range(pp)]
-                bwds = [stage_bwd[s][i] for s in range(pp)]
-                tot, ends_i = fold_1f1b(pp, int(mbc_a[i]), fwds, bwds,
-                                        p2p_t[i], st.pp_comm_async)
-                totals[i] = tot
-                for s in range(pp):
-                    ends[s, i] = ends_i[s]
-        barrier = np.max(
-            np.stack([ends[s] + dp_rs[s] for s in range(pp)]), axis=0)
-        tail = np.max(
-            np.stack([optim[s] + dp_ag[s] for s in range(pp)]), axis=0)
-        iter_time = (barrier + tail) * self.straggle
+        def finalize(totals, ends):
+            barrier = np.max(
+                np.stack([ends[s] + dp_rs[s] for s in range(pp)]),
+                axis=0)
+            tail = np.max(
+                np.stack([optim[s] + dp_ag[s] for s in range(pp)]),
+                axis=0)
+            iter_time = (barrier + tail) * self.straggle
 
-        tokens = b * mbc_a * st.dp_size * st.seq_len
-        model_flops = self._flops_per_token * tokens
-        per_chip = model_flops / st.world_size / iter_time
-        peak_flops = self.system.accelerator.op["default"].tflops * 1e12
-        # exposed-comm share — guided-search Pareto telemetry (NOT a
-        # scalar-parity surface; see docs/search.md "Guided search")
-        comm_time = np.max(
-            np.stack([mbc_a * stage_net[s] + dp_rs[s] + dp_ag[s]
-                      for s in range(pp)]), axis=0)
-        return {
-            "iter_time": iter_time,
-            "mfu": per_chip / peak_flops,
-            "tgs": tokens / iter_time / st.world_size,
-            "max_peak_bytes": max_peak,
-            "fits_margin_bytes": cap - max_peak,
-            "usable_bytes": cap,
-            "comm_time": comm_time,
-            "comm_fraction": np.where(
-                np.isfinite(iter_time) & (iter_time > 0),
-                comm_time / np.where(iter_time > 0, iter_time, 1.0),
-                0.0),
-        }
+            tokens = b * mbc_a * st.dp_size * st.seq_len
+            model_flops = self._flops_per_token * tokens
+            per_chip = model_flops / st.world_size / iter_time
+            peak_flops = \
+                self.system.accelerator.op["default"].tflops * 1e12
+            # exposed-comm share — guided-search Pareto telemetry
+            # (NOT a scalar-parity surface; see docs/search.md
+            # "Guided search")
+            comm_time = np.max(
+                np.stack([mbc_a * stage_net[s] + dp_rs[s] + dp_ag[s]
+                          for s in range(pp)]), axis=0)
+            return {
+                "iter_time": iter_time,
+                "mfu": per_chip / peak_flops,
+                "tgs": tokens / iter_time / st.world_size,
+                "max_peak_bytes": max_peak,
+                "fits_margin_bytes": cap - max_peak,
+                "usable_bytes": cap,
+                "comm_time": comm_time,
+                "comm_fraction": np.where(
+                    np.isfinite(iter_time) & (iter_time > 0),
+                    comm_time / np.where(iter_time > 0, iter_time,
+                                         1.0),
+                    0.0),
+            }
+
+        job.finalize = finalize
+        if fold_batch is not None:
+            return fold_batch.defer(job)
+        _fold_job(job, backend)
+        return job.finalize(job.totals, job.ends)
 
     def _interleaved_peaks(self, chunk_cache, chunk_peak, stage_model,
                            mbc_a, ncand):
@@ -2279,6 +2562,9 @@ class BatchedScorer:
         #: scoring telemetry (surfaced by bench_sweep --engine batched)
         self.stats = {"score_calls": 0, "max_batch": 0,
                       "candidates_scored": 0}
+        #: {(pp, vp, mbc, group): members} the last
+        #: :meth:`screen_cells` batch dispatched to the jitted fold
+        self.last_screen_jit: Dict[tuple, int] = {}
 
     _KEY_GETTER = None  # operator.attrgetter over the non-batch fields
 
@@ -2569,6 +2855,77 @@ class BatchedScorer:
             "peak_bytes": float(scores["max_peak_bytes"][0]),
             "comm_fraction": float(scores["comm_fraction"][0]),
         }
+
+    def screen_cells(self, items, model: ModelConfig,
+                     global_batch_size: int) -> list:
+        """Sweep-wide batched guided screen (the second L11 follow-on):
+        every cell's one-candidate screen score goes through ONE
+        deferred-fold batch instead of a per-cell :meth:`screen_cell`
+        call — the schedule folds of all cells sharing a (pp, vp, mbc,
+        group) shape ride one vmapped jitted scan across the sweep
+        (:class:`FoldBatch`), so a 500-cell screen dispatches a
+        handful of XLA calls, not 500 Python folds.
+
+        ``items`` is a sequence of ``(strategy, rc_family)``; returns
+        one entry per item: the same ``{iter_time, peak_bytes,
+        comm_fraction}`` triple :meth:`screen_cell` produces, ``None``
+        for an invalid family, or the *exception* screen_cell would
+        have raised (:class:`UnsupportedBatched` / anything else) for
+        the caller to apply its conservative must-evaluate rule. The
+        triples are bit-identical to per-cell screening (same float
+        ops on the same values — asserted on the wide grid in
+        tests/test_batched.py); :attr:`last_screen_jit` records the
+        shape groups the batch dispatched to XLA."""
+        fb = FoldBatch()
+        slots: list = []
+        for st, rc_family in items:
+            try:
+                if st.dp_size < 1 or global_batch_size % st.dp_size:
+                    slots.append((0, None))
+                    continue
+                per_dp = global_batch_size // st.dp_size
+                st_rc = self.family_strategy(st, rc_family)
+                st_rc.micro_batch_size = 1
+                st_rc.micro_batch_num = per_dp
+                st_rc.__post_init__()
+                if st_rc.vp_size > 1 and per_dp % st_rc.vpp_group_size:
+                    slots.append((0, None))
+                    continue
+                kern = self.kernel_for(st_rc)
+                got = kern.score([1], [per_dp], backend=self.backend,
+                                 fold_batch=fb)
+                slots.append((0, None) if got is None else (1, got))
+            except Exception as exc:
+                slots.append((2, exc))
+        # the shared fold is one call for the whole sweep: a failure
+        # inside it must degrade to the per-cell conservative
+        # must-evaluate rule (every pending slot returns the error),
+        # never abort the guided sweep
+        run_err: Optional[Exception] = None
+        try:
+            fb.run(self.backend)
+        except Exception as exc:
+            run_err = exc
+        self.last_screen_jit = dict(fb.jit_dispatched)
+        out: list = []
+        for kind, val in slots:
+            if kind != 1:
+                out.append(val)
+                continue
+            if run_err is not None:
+                out.append(run_err)
+                continue
+            try:
+                scores = val()
+            except Exception as exc:
+                out.append(exc)
+                continue
+            out.append({
+                "iter_time": float(scores["iter_time"][0]),
+                "peak_bytes": float(scores["max_peak_bytes"][0]),
+                "comm_fraction": float(scores["comm_fraction"][0]),
+            })
+        return out
 
     def evaluate_cell(self, st: StrategyConfig, rc_family: str,
                       model: ModelConfig, global_batch_size: int):

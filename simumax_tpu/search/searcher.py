@@ -557,6 +557,44 @@ def search_best_recompute_layer_num(
     return best
 
 
+def sweep_cell_key_fn(base_strategy, model, system, global_batch_size,
+                      engine, simulate=False, project_dualpp=False):
+    """THE definition of a sweep cell's persistent store key
+    (``docs/service.md``): the content-addressed prefix of one sweep
+    family — full resolved model/system content plus every
+    base-strategy field the grid does not override — combined with the
+    cell coordinates. Returns ``cell -> key``. The sweep path and the
+    speculative warmer (``service/warmer.py``) MUST share this one
+    builder, or warmed cells land under keys the sweep never
+    computes."""
+    import dataclasses as _dc
+
+    from simumax_tpu.service.store import code_version, content_key
+
+    overridden = {"tp_size", "cp_size", "ep_size", "pp_size",
+                  "zero_state", "micro_batch_size", "micro_batch_num"}
+    sweep_prefix = content_key({
+        "kind": "sweep_cell",
+        "code_version": code_version(),
+        "engine": engine,
+        "simulate": simulate,
+        "project_dualpp": project_dualpp,
+        "gbs": global_batch_size,
+        "model": model.to_dict(),
+        "system": system.to_dict(),
+        "base_strategy": {
+            f.name: getattr(base_strategy, f.name)
+            for f in _dc.fields(type(base_strategy))
+            if f.name not in overridden
+        },
+    })
+
+    def cell_key(cell, _prefix=sweep_prefix):
+        return content_key({"sweep": _prefix, "cell": cell.key})
+
+    return cell_key
+
+
 def _evaluate_sweep_cell(
     st, rc, model, system, global_batch_size, cache, project_dualpp,
     simulate=False,
@@ -646,6 +684,7 @@ def search_best_parallel_strategy(
     store=None,
     on_cell=None,
     search_mode: str = "grid",
+    cell_flights=None,
 ) -> List[dict]:
     """Full tp x cp x ep x pp sweep (reference
     ``search_best_parallel_strategy`` perf_llm.py:3355-3578): enumerate
@@ -713,7 +752,18 @@ def search_best_parallel_strategy(
 
     ``on_cell(key, status, row)`` fires for every settled cell —
     replayed and store-served cells first, then evaluated cells in
-    completion order (the server's NDJSON row stream)."""
+    completion order (the server's NDJSON row stream).
+
+    ``cell_flights`` (a ``service.coalesce.CellFlightTable``) extends
+    the store layer to *in-flight* cells: a cell another concurrent
+    sweep is already evaluating is not evaluated again — this sweep
+    claims only the unclaimed delta, publishes each claimed cell as it
+    settles (same checkpoint as the store write), and afterwards waits
+    for the cells it followed, falling back to evaluating any the
+    leader abandoned. Served-by-leader cells are counted
+    ``sweep_cells_coalesced``; the returned rows are bit-identical
+    either way. Grid mode only (guided sweeps skip claiming — their
+    selection may never evaluate a claimed cell)."""
     cache = BoundedCache() if cache is None else cache
     diagnostics = diagnostics if diagnostics is not None else Diagnostics()
     if engine not in ("scalar", "batched"):
@@ -807,36 +857,24 @@ def search_best_parallel_strategy(
     # overlapping grid hits cell-for-cell
     cell_key_fn = None
     if store is not None:
-        import dataclasses as _dc
-
-        from simumax_tpu.service.store import code_version, content_key
-
-        overridden = {"tp_size", "cp_size", "ep_size", "pp_size",
-                      "zero_state", "micro_batch_size",
-                      "micro_batch_num"}
-        sweep_prefix = content_key({
-            "kind": "sweep_cell",
-            "code_version": code_version(),
-            "engine": engine,
-            "simulate": simulate,
-            "project_dualpp": project_dualpp,
-            "gbs": global_batch_size,
-            "model": model.to_dict(),
-            "system": system.to_dict(),
-            "base_strategy": {
-                f.name: getattr(base_strategy, f.name)
-                for f in _dc.fields(type(base_strategy))
-                if f.name not in overridden
-            },
-        })
-
-        def cell_key_fn(cell, _prefix=sweep_prefix):
-            return content_key({"sweep": _prefix, "cell": cell.key})
+        cell_key_fn = sweep_cell_key_fn(
+            base_strategy, model, system, global_batch_size, engine,
+            simulate=simulate, project_dualpp=project_dualpp)
 
     rows: List[dict] = []
     quarantine: List[dict] = []
     replayed: Dict[int, dict] = {}
     cached: Dict[int, dict] = {}
+    #: in-flight coalescing state (grid mode with a flight table):
+    #: cells this sweep leads (idx -> store key, published as each
+    #: settles) and cells it follows (idx -> (flight, cell))
+    flights = cell_flights if (cell_flights is not None
+                               and cell_key_fn is not None
+                               and search_mode == "grid") else None
+    owned: Dict[int, str] = {}
+    published: set = set()
+    following: Dict[int, tuple] = {}
+    coalesced: Dict[int, dict] = {}
     to_run = []
     for cell in cells:
         prior = resumed.get(cell.key)
@@ -849,7 +887,8 @@ def search_best_parallel_strategy(
             replayed[cell.idx] = prior
             continue
         if cell_key_fn is not None:
-            entry = store.get("sweep", cell_key_fn(cell))
+            ckey = cell_key_fn(cell)
+            entry = store.get("sweep", ckey)
             # only settled verdicts are served; "error" outcomes are
             # transient (timeouts, crashed workers) and never persisted
             # — serving one forever would quarantine an evaluable cell
@@ -858,6 +897,21 @@ def search_best_parallel_strategy(
                     and entry.get("status") in ("ok", "empty"):
                 cached[cell.idx] = entry
                 continue
+            if flights is not None:
+                flight, leader = flights.claim(ckey)
+                if not leader:
+                    following[cell.idx] = (flight, cell)
+                    continue
+                # close the miss->claim race: the previous leader may
+                # have stored + released between our miss and our
+                # claim — re-check once before committing to evaluate
+                entry = store.get("sweep", ckey)
+                if isinstance(entry, dict) \
+                        and entry.get("status") in ("ok", "empty"):
+                    flights.publish(ckey, entry)
+                    cached[cell.idx] = entry
+                    continue
+                owned[cell.idx] = ckey
         to_run.append(cell)
     diagnostics.count("sweep_cells_total",
                       len(cells) + len(pruned_rows) + len(deduped_rows))
@@ -901,6 +955,19 @@ def search_best_parallel_strategy(
                             f"{outcome.cell.key} to the planner cache: "
                             f"{exc}",
                         )
+                # publish the settled cell to any concurrent sweep
+                # following it — AFTER the store write, so a sweep
+                # arriving post-publish finds it in the store. Error
+                # outcomes publish too (a follower's own evaluation
+                # would fail the same way) but are never persisted.
+                okey = owned.get(outcome.cell.idx)
+                if flights is not None and okey is not None:
+                    published.add(outcome.cell.idx)
+                    flights.publish(okey, {
+                        "status": outcome.status,
+                        "row": outcome.row,
+                        "error": outcome.error,
+                    })
                 if on_cell is not None:
                     on_cell(outcome.cell.key, outcome.status,
                             outcome.row)
@@ -969,7 +1036,45 @@ def search_best_parallel_strategy(
                                   len(outcomes))
             else:
                 outcomes = run_cells(to_run, **run_kwargs)
+            if following:
+                # collect the cells concurrent sweeps were already
+                # evaluating. Leaders publish as they settle and
+                # abandon unpublished claims on the way out (their own
+                # finally), so these waits always terminate; a cell
+                # whose leader abandoned it is evaluated here.
+                abandoned = []
+                for idx in sorted(following):
+                    flight, fcell = following[idx]
+                    outcome = flights.wait(flight)
+                    if outcome is None:
+                        abandoned.append(fcell)
+                        continue
+                    coalesced[idx] = outcome
+                    if outcome.get("status") == "error":
+                        err = outcome.get("error") or {}
+                        diagnostics.error(
+                            "quarantine",
+                            err.get("error_msg") or "coalesced failure",
+                            candidate=fcell.key, phase="search",
+                            exception=err.get("error_type", ""),
+                            coalesced=True,
+                        )
+                    if on_cell is not None:
+                        on_cell(fcell.key, outcome.get("status"),
+                                outcome.get("row"))
+                diagnostics.count("sweep_cells_coalesced",
+                                  len(coalesced))
+                if abandoned:
+                    diagnostics.count("sweep_cells_evaluated",
+                                      len(abandoned))
+                    outcomes.update(run_cells(abandoned, **run_kwargs))
     finally:
+        if flights is not None:
+            # a sweep that dies mid-run must wake its followers: any
+            # claim it never published is abandoned (they re-evaluate)
+            for idx, okey in owned.items():
+                if idx not in published:
+                    flights.abandon(okey)
         if journal:
             journal.close()
     # merge outcomes back in deterministic grid order so ranking and
@@ -980,6 +1085,11 @@ def search_best_parallel_strategy(
         prior = replayed.get(cell.idx)
         if prior is None and cell.idx in cached:
             prior = cached[cell.idx]
+            from_store = True
+        if prior is None and cell.idx in coalesced:
+            # served by a concurrent sweep's in-flight evaluation:
+            # same record shape as a store hit, same merge semantics
+            prior = coalesced[cell.idx]
             from_store = True
         if prior is not None:
             status, row = prior["status"], prior.get("row")
@@ -1071,27 +1181,31 @@ def _run_guided(cells, to_run, replayed, cached, base_strategy, model,
     cell_strategies: Dict[int, object] = {}
     must = set()
     for cell in to_run:
-        st_c = make_cell_strategy(base_strategy, cell.tp, cell.cp,
-                                  cell.ep, cell.pp, cell.zero)
-        cell_strategies[cell.idx] = st_c
-        try:
-            tri = scorer.screen_cell(st_c, cell.rc, model,
-                                     global_batch_size)
-        except UnsupportedBatched:
+        cell_strategies[cell.idx] = make_cell_strategy(
+            base_strategy, cell.tp, cell.cp, cell.ep, cell.pp,
+            cell.zero)
+    # one sweep-wide batched screen: every cell's fold rides a shared
+    # FoldBatch (cells sharing a schedule shape share one vmapped
+    # jitted call), with triples bit-identical to per-cell
+    # screen_cell — see docs/search.md "Guided search"
+    results = scorer.screen_cells(
+        [(cell_strategies[c.idx], c.rc) for c in to_run],
+        model, global_batch_size)
+    for cell, res in zip(to_run, results):
+        if isinstance(res, UnsupportedBatched):
             must.add(cell.idx)  # unscreenable: evaluate unconditionally
-            continue
-        except Exception as exc:
+        elif isinstance(res, Exception):
             # conservative: ANY screen failure (incl. a FeasibilityError
             # the prune layer should have caught) must not skip the
             # cell — evaluating it reproduces grid mode's verdict
             # (quarantined error row) instead of silently dropping it
             diagnostics.warn(
                 "search",
-                f"guided screen failed for {cell.key}: {exc}",
+                f"guided screen failed for {cell.key}: {res}",
             )
             must.add(cell.idx)
-            continue
-        screens[cell.idx] = tri
+        else:
+            screens[cell.idx] = res
     diagnostics.count("sweep_cells_screened", len(screens) + len(must))
     valid = {i: t for i, t in screens.items() if t is not None}
     frontier = pareto_frontier({

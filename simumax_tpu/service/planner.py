@@ -57,7 +57,13 @@ class ConfigLoader:
         self._paths: Dict[Tuple[str, str], str] = {}
         self._data: Dict[Tuple[str, float, int], dict] = {}
 
-    def load(self, kind: str, value):
+    def load(self, kind: str, value, deps=None):
+        """Resolve one config. ``deps`` (a list) collects the
+        ``(path, mtime_ns, size)`` stamp of every config *file* the
+        resolution read — the freshness dependencies a response cache
+        keyed on the raw request body must validate (inline dicts and
+        config objects carry their content in the request itself, so
+        they add no dependency)."""
         import copy
         import json
         import os
@@ -97,6 +103,8 @@ class ConfigLoader:
                 with self._lock:
                     self._paths[(kind, value)] = path
         st = os.stat(path)
+        if deps is not None:
+            deps.append((path, st.st_mtime_ns, st.st_size))
         ck = (path, st.st_mtime_ns, st.st_size)
         with self._lock:
             data = self._data.get(ck)
@@ -248,6 +256,12 @@ class Planner:
             "singleflight_waits": 0,
         }
         self._loader = ConfigLoader()
+        #: in-flight sweep-cell coalescing across this planner's
+        #: concurrent sweeps (service/coalesce.py): overlapping grids
+        #: share cells that are being evaluated, not just stored ones
+        from simumax_tpu.service.coalesce import CellFlightTable
+
+        self.cell_flights = CellFlightTable(registry=self.registry)
 
     # -- plumbing ----------------------------------------------------------
     def _count(self, name: str, n: int = 1):
@@ -332,9 +346,10 @@ class Planner:
         block) plus efficiency coverage, realized collective
         bandwidths, and — for eligible even-pp layouts — the DualPipe
         projection."""
-        model = self._loader.load("model", model)
-        strategy = self._loader.load("strategy", strategy)
-        system = self._loader.load("system", system)
+        deps: list = []
+        model = self._loader.load("model", model, deps=deps)
+        strategy = self._loader.load("strategy", strategy, deps=deps)
+        system = self._loader.load("system", system, deps=deps)
         identity = query_identity("estimate", model=model,
                                   strategy=strategy, system=system)
 
@@ -361,7 +376,7 @@ class Planner:
                                          raw=raw)
         if with_meta:
             return payload, {"cache": "hit" if hit else "miss",
-                             "key": key}
+                             "key": key, "deps": deps}
         return payload
 
     def explain(self, model, strategy, system, with_meta: bool = False,
@@ -370,9 +385,10 @@ class Planner:
         ledger dict (``observe/ledger.py`` schema, the ``diff`` input
         format) plus the aggregated per-op rows the top-N table
         renders from."""
-        model = self._loader.load("model", model)
-        strategy = self._loader.load("strategy", strategy)
-        system = self._loader.load("system", system)
+        deps: list = []
+        model = self._loader.load("model", model, deps=deps)
+        strategy = self._loader.load("strategy", strategy, deps=deps)
+        system = self._loader.load("system", system, deps=deps)
         identity = query_identity("explain", model=model,
                                   strategy=strategy, system=system)
 
@@ -388,7 +404,7 @@ class Planner:
                                          raw=raw)
         if with_meta:
             return payload, {"cache": "hit" if hit else "miss",
-                             "key": key}
+                             "key": key, "deps": deps}
         return payload
 
     def batch_split(self, model, strategy, system, global_batch_size: int,
@@ -423,9 +439,10 @@ class Planner:
         """Discrete-event replay summary. Cached (namespace ``des``)
         only when no artifact directory is requested — artifact files
         live outside the store."""
-        model = self._loader.load("model", model)
-        strategy = self._loader.load("strategy", strategy)
-        system = self._loader.load("system", system)
+        deps: list = []
+        model = self._loader.load("model", model, deps=deps)
+        strategy = self._loader.load("strategy", strategy, deps=deps)
+        system = self._loader.load("system", system, deps=deps)
 
         def compute(path=save_path):
             from simumax_tpu.observe.telemetry import get_tracer
@@ -458,6 +475,7 @@ class Planner:
                                              raw=raw)
             meta = {"cache": "hit" if hit else "miss", "key": key}
         if with_meta:
+            meta["deps"] = deps
             return payload, meta
         return payload
 
@@ -467,9 +485,10 @@ class Planner:
                raw: bool = False):
         """Seeded Monte-Carlo goodput analysis (deterministic in the
         seed, hence cacheable; namespace ``des``)."""
-        model = self._loader.load("model", model)
-        strategy = self._loader.load("strategy", strategy)
-        system = self._loader.load("system", system)
+        deps: list = []
+        model = self._loader.load("model", model, deps=deps)
+        strategy = self._loader.load("strategy", strategy, deps=deps)
+        system = self._loader.load("system", system, deps=deps)
         identity = query_identity(
             "faults", model=model, strategy=strategy, system=system,
             monte_carlo=monte_carlo, seed=seed,
@@ -490,7 +509,7 @@ class Planner:
                                          raw=raw)
         if with_meta:
             return payload, {"cache": "hit" if hit else "miss",
-                             "key": key}
+                             "key": key, "deps": deps}
         return payload
 
     def search(self, model, system, global_batch_size: int,
@@ -510,9 +529,10 @@ class Planner:
         from simumax_tpu.core.records import Diagnostics
         from simumax_tpu.search import search_best_parallel_strategy
 
-        model = self._loader.load("model", model)
-        system = self._loader.load("system", system)
-        base = self._loader.load("strategy", base_strategy)
+        deps: list = []
+        model = self._loader.load("model", model, deps=deps)
+        system = self._loader.load("system", system, deps=deps)
+        base = self._loader.load("strategy", base_strategy, deps=deps)
         if world:
             base.world_size = world
         if seq_len:
@@ -537,6 +557,8 @@ class Planner:
                 csv_path=csv_path, journal_path=journal_path,
                 diagnostics=diag, jobs=jobs, engine=engine,
                 verify_topk=verify_topk, store=store, on_cell=on_cell,
+                cell_flights=self.cell_flights if store is not None
+                else None,
             )
         if engine == "batched":
             save_batched_profiles(store, model, system,
@@ -559,15 +581,20 @@ class Planner:
         })
         cached = int(c.get("sweep_cells_cached", 0))
         evaluated = int(c.get("sweep_cells_evaluated", 0))
+        coalesced = int(c.get("sweep_cells_coalesced", 0))
         self._count("hits", cached)
         self._count("misses", evaluated)
+        if coalesced:
+            self._count("cells_coalesced", coalesced)
         if with_meta:
-            hit = evaluated == 0 and cached > 0
+            hit = evaluated == 0 and (cached > 0 or coalesced > 0)
             return payload, {
                 "cache": "hit" if hit else "miss", "key": "",
                 "cells_cached": cached, "cells_evaluated": evaluated,
+                "cells_coalesced": coalesced,
                 "cells_replayed": int(
                     c.get("sweep_cells_replayed", 0)),
+                "deps": deps,
             }
         return payload
 
@@ -577,4 +604,5 @@ class Planner:
             counters = dict(self.counters)
         out = {"enabled": self.enabled, "planner": counters}
         out["store"] = self.store.stats() if self.store else None
+        out["coalesce"] = self.cell_flights.stats()
         return out

@@ -40,11 +40,25 @@ payload either way. Config-family errors return 400 with
 ``{"error": ...}``; unexpected failures 500. Request logging goes
 through the shared Reporter at debug level (``serve --log-level
 debug``).
+
+Production serving (L13, docs/service.md "Production deployment"):
+``serve --workers N`` dispatches non-streaming queries to a
+multi-process worker pool (``service/pool.py``: read-only store
+replicas, a single parent-side writer, request coalescing, a
+dependency-validated response memory cache, worker respawn + retry);
+``--admission N`` sheds excess load with 429 + ``Retry-After``
+(:class:`AdmissionController`, per-priority budgets via the
+``X-SimuMax-Priority`` header); ``--warm N`` precomputes the neighbor
+sweep cells clients statistically ask for next
+(``service/warmer.py``). All three default to off — the threaded PR-9
+server — and every served byte stays bit-identical across modes.
 """
 
 from __future__ import annotations
 
 import json
+import math
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -61,6 +75,13 @@ from simumax_tpu.observe.telemetry import (
     span_tree,
 )
 from simumax_tpu.service.planner import Planner
+
+#: admission-control load budget per priority class, as a fraction of
+#: ``--admission N``: low traffic is shed first (half the budget),
+#: high-priority clients ride out 1.5x the nominal backlog before a
+#: 429 — so under overload the classes degrade in order instead of
+#: collapsing together
+PRIORITY_HEADROOM = {"high": 1.5, "normal": 1.0, "low": 0.5}
 
 
 def response_bytes(payload: Any) -> bytes:
@@ -166,24 +187,118 @@ class _ServiceStats:
         }
 
 
+class AdmissionController:
+    """Bounded-load admission control (``serve --admission N``).
+
+    Every ``/v1/*`` request passes :meth:`try_admit` before any work
+    happens: when the current load (the pool's queued + in-flight
+    backlog, or this controller's own in-flight count in threaded
+    mode) has reached the request's per-priority budget
+    (``N x PRIORITY_HEADROOM[priority]``), the request is shed with a
+    429 and a ``Retry-After`` estimate instead of queuing unboundedly
+    — p99 of *admitted* requests stays bounded under overload. An
+    admitted request is never dropped: admission happens exactly once,
+    before dispatch, and everything admitted runs to an answer."""
+
+    def __init__(self, max_backlog: int, pool=None, registry=None):
+        self.max_backlog = int(max_backlog)
+        self.pool = pool
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.counters: Dict[str, int] = {
+            "admitted": 0, "rejected": 0,
+        }
+
+    def load(self) -> int:
+        if self.pool is not None:
+            return self.pool.backlog()
+        with self._lock:
+            return self._inflight
+
+    def retry_after_s(self) -> int:
+        """Whole seconds a shed client should wait — the pool's
+        EWMA-based wait estimate, or a queue-depth guess in threaded
+        mode. Always >= 1 (a 0 invites an immediate retry storm)."""
+        if self.pool is not None:
+            wait = self.pool.estimated_wait_s()
+        else:
+            wait = 0.05 * self.load()
+        return max(1, int(math.ceil(wait)))
+
+    def try_admit(self, priority: str) -> bool:
+        limit = self.max_backlog * PRIORITY_HEADROOM.get(priority, 1.0)
+        # check-and-increment under ONE lock hold: a burst racing at
+        # the limit must not all read the same pre-increment load and
+        # overshoot the backlog bound (pooled load is the pool's own
+        # backlog — serialized here, though submission lag keeps it
+        # an estimate)
+        with self._lock:
+            load = (self.pool.backlog() if self.pool is not None
+                    else self._inflight)
+            if load >= limit:
+                self.counters["rejected"] += 1
+                key = f"rejected_{priority}"
+                self.counters[key] = self.counters.get(key, 0) + 1
+                admitted = False
+            else:
+                self.counters["admitted"] += 1
+                self._inflight += 1
+                admitted = True
+        if not admitted:
+            self.registry.counter("admission_rejected_total",
+                                  priority=priority).inc()
+        return admitted
+
+    def release(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def stats(self) -> dict:
+        load = self.load()
+        with self._lock:
+            return dict(self.counters, max_backlog=self.max_backlog,
+                        load=load)
+
+
 class PlannerHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer carrying the shared planner + stats +
     metrics registry (``GET /metrics`` renders it)."""
 
     daemon_threads = True
     allow_reuse_address = True
+    #: small responses leave in one segment, not a Nagle-delayed two
+    disable_nagle_algorithm = True
 
     def __init__(self, addr, planner: Planner,
                  registry: Optional[MetricsRegistry] = None,
-                 trace_log: Optional[str] = None):
+                 trace_log: Optional[str] = None,
+                 pool=None, admission: Optional[AdmissionController]
+                 = None, warmer=None):
         super().__init__(addr, _Handler)
         self.planner = planner
         self.registry = registry or planner.registry
         self.stats = _ServiceStats(self.registry)
+        #: ``serve --workers N``: the multi-process serving pool
+        #: (service/pool.py); non-streaming ``/v1/*`` queries dispatch
+        #: to its workers, streaming sweeps stay on this process's
+        #: planner (which shares the pool's single-writer store)
+        self.pool = pool
+        #: ``serve --admission N``: load-shedding front door
+        self.admission = admission
+        #: ``serve --warm N``: speculative neighbor-cell warmer
+        self.warmer = warmer
         #: ``serve --trace-requests DIR``: finished request span trees
         #: append to ``<DIR>/requests.jsonl`` (one JSON line each)
         self.trace_log = trace_log
         self._trace_log_lock = threading.Lock()
+
+    def server_close(self):
+        super().server_close()
+        if self.warmer is not None:
+            self.warmer.close()
+        if self.pool is not None:
+            self.pool.close()
 
     def write_trace(self, trace_id: str, endpoint: str):
         """Append the finished request's span tree to the trace log
@@ -203,9 +318,21 @@ class PlannerHTTPServer(ThreadingHTTPServer):
                 f.write(line + "\n")
 
 
+class _FastHeaders(dict):
+    """Case-insensitive str header view built by the fast lane's lean
+    parser (every handler path only ever calls ``.get``)."""
+
+    def get(self, name, default=None):
+        return super().get(name.lower(), default)
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "simumax-tpu-planner/1"
+    #: buffer the response writer: status line + headers + body leave
+    #: in ONE sendall (handle_one_request flushes after each request;
+    #: the NDJSON stream flushes per chunk below)
+    wbufsize = -1
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through the Reporter
@@ -217,8 +344,11 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     def _body(self) -> dict:
-        length = int(self.headers.get("Content-Length") or 0)
-        raw = self.rfile.read(length) if length else b"{}"
+        raw = getattr(self, "_raw_body", None)
+        if raw is None:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            self._raw_body = raw
         data = json.loads(raw.decode("utf-8") or "{}")
         if not isinstance(data, dict):
             raise ConfigError("request body must be a JSON object")
@@ -239,18 +369,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self._send_trace_header()
+        if meta and meta.get("content_encoding"):
+            # transport encoding of a memcache hit the client opted
+            # into (Accept-Encoding: gzip) — the canonical identity
+            # stays the uncompressed bytes
+            self.send_header("Content-Encoding",
+                             meta["content_encoding"])
         if meta:
             self.send_header("X-SimuMax-Cache", meta.get("cache", ""))
             if meta.get("key"):
                 self.send_header("X-SimuMax-Key", meta["key"])
+            if meta.get("served"):
+                # how the bytes were produced (memory / coalesced) —
+                # serving-dependent, so a header, never the body
+                self.send_header("X-SimuMax-Served", meta["served"])
             if "cells_cached" in meta:
                 # serving-dependent sweep accounting rides headers so
                 # the body stays bit-identical warm vs cold
-                self.send_header(
-                    "X-SimuMax-Cells",
-                    f"cached={meta['cells_cached']} "
-                    f"evaluated={meta['cells_evaluated']}",
-                )
+                cells = (f"cached={meta['cells_cached']} "
+                         f"evaluated={meta['cells_evaluated']}")
+                if meta.get("cells_coalesced"):
+                    cells += f" coalesced={meta['cells_coalesced']}"
+                self.send_header("X-SimuMax-Cells", cells)
         self.end_headers()
         self.wfile.write(body)
 
@@ -295,9 +435,7 @@ class _Handler(BaseHTTPRequestHandler):
                             time.time() - self.server.stats.started, 3),
                     })
                 elif self.path == "/stats":
-                    snap = self.server.stats.snapshot()
-                    snap.update(self.server.planner.stats())
-                    self._send_json(200, snap)
+                    self._send_json(200, self._stats_snapshot())
                 elif self.path == "/metrics":
                     self._send_metrics()
                 else:
@@ -313,12 +451,314 @@ class _Handler(BaseHTTPRequestHandler):
                 )
         self.server.write_trace(tid, endpoint)
 
+    def _stats_snapshot(self) -> dict:
+        """The ``/stats`` body. The PR-9 schema (requests / latency /
+        planner / store) is preserved exactly; pooled serving,
+        admission control, and the warmer append NEW keys only, so
+        existing scrapers keep working under ``--workers``."""
+        srv = self.server
+        snap = srv.stats.snapshot()
+        if srv.pool is not None:
+            pooled = srv.pool.planner_stats()
+            # the parent planner still serves streaming sweeps: its
+            # counters fold into the worker aggregate so /stats keeps
+            # counting every evaluation this service performed
+            parent = srv.planner.stats()
+            merged = dict(pooled["planner"])
+            for name, value in parent["planner"].items():
+                merged[name] = merged.get(name, 0) + value
+            pooled["planner"] = merged
+            snap.update(pooled)
+            snap["coalesce"] = parent.get("coalesce", {})
+            snap["pool"] = srv.pool.stats()
+        else:
+            snap.update(srv.planner.stats())
+        if srv.admission is not None:
+            snap["admission"] = srv.admission.stats()
+        if srv.warmer is not None:
+            snap["warmer"] = srv.warmer.stats()
+        return snap
+
+    def _accepts_gzip(self) -> bool:
+        return "gzip" in (self.headers.get("Accept-Encoding") or "")
+
+    def _priority(self) -> str:
+        """Per-client priority class of this request — the
+        ``X-SimuMax-Priority`` header (``high`` / ``normal`` /
+        ``low``), defaulting to ``normal``."""
+        p = (self.headers.get("X-SimuMax-Priority") or "normal").lower()
+        return p if p in PRIORITY_HEADROOM else "normal"
+
+    #: endpoints eligible for the raw-body memcache fast path: the
+    #: exact request bytes of a hot repeat map straight to the cached
+    #: response, skipping the JSON parse and canonicalization. Search
+    #: stays off it (a parsed body is needed for the stream check and
+    #: the warm offer).
+    FAST_PATH_ENDPOINTS = ("/v1/estimate", "/v1/explain",
+                           "/v1/faults", "/v1/simulate")
+
+    # -- the pooled serving fast lane --------------------------------------
+    # Part of the --workers serving rebuild: siege-level traffic is
+    # pipelined POSTs of small JSON bodies, and the stdlib
+    # readline-per-header parser + send_response machinery + a flush
+    # syscall per response costs more than the whole lookup. The lane
+    # parses that one shape with a lean loop and batches response
+    # flushes across a pipeline burst; EVERYTHING else (GETs, odd
+    # versions, huge request lines) falls back to the stdlib parser
+    # mid-connection. A threaded server (pool=None) never enters it.
+
+    def handle_one_request(self):  # noqa: A003 (stdlib override)
+        if self.server.pool is None:
+            return super().handle_one_request()
+        try:
+            self.raw_requestline = self.rfile.readline(65537)
+            if len(self.raw_requestline) > 65536:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414)
+                return
+            if not self.raw_requestline:
+                self.close_connection = True
+                return
+            if self._fast_lane():
+                return
+            # unusual request: the stdlib parser takes over from the
+            # already-read request line (stdlib handle_one_request
+            # tail, verbatim semantics)
+            if not self.parse_request():
+                return
+            mname = "do_" + self.command
+            if not hasattr(self, mname):
+                self.send_error(
+                    501, f"Unsupported method ({self.command})")
+                return
+            getattr(self, mname)()
+            self.wfile.flush()
+        except (TimeoutError, socket.timeout) as exc:
+            self.log_error("Request timed out: %r", exc)
+            self.close_connection = True
+
+    def _fast_lane(self) -> bool:
+        """Serve one pipelined ``POST /v1/...`` leanly; returns False
+        (with only the request line consumed) when this request needs
+        the stdlib parser instead."""
+        line = self.raw_requestline
+        if not (line.startswith(b"POST /v1/")
+                and line.endswith(b" HTTP/1.1\r\n")):
+            return False
+        try:
+            requestline = line.decode("ascii").rstrip("\r\n")
+        except UnicodeDecodeError:
+            return False  # the stdlib parser answers the 400
+        # requestline/command/request_version must be set BEFORE any
+        # send_error below: its log_request reads them
+        self.requestline = requestline
+        self.command, path, self.request_version = \
+            requestline.split(" ", 2)
+        self.path = path
+        headers = _FastHeaders()
+        while True:
+            h = self.rfile.readline(65537)
+            if h in (b"\r\n", b"\n", b""):
+                break
+            key, sep, value = h.partition(b":")
+            if not sep:
+                self.send_error(400, "malformed header line")
+                return True
+            try:
+                headers[key.decode("ascii").lower()] = \
+                    value.decode("latin-1").strip()
+            except UnicodeDecodeError:
+                self.send_error(400, "malformed header name")
+                return True
+        self.headers = headers
+        self.close_connection = \
+            (headers.get("connection") or "").lower() == "close"
+        if headers.get("expect", "").lower() == "100-continue":
+            self.wfile.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            self.wfile.flush()
+        t0 = time.perf_counter()
+        endpoint = path.split("?")[0]
+        adm = self.server.admission
+        admitted = False
+        if adm is not None:
+            if not adm.try_admit(self._priority()):
+                self._fast_shed(endpoint, adm, t0)
+                return True
+            admitted = True
+        length = int(headers.get("content-length") or 0)
+        self._raw_body = self.rfile.read(length) if length else b"{}"
+        pool = self.server.pool
+        got = None
+        if pool.memcache is not None \
+                and endpoint in self.FAST_PATH_ENDPOINTS:
+            got = pool.memcache.get_raw(
+                endpoint, self._raw_body, gzip_ok=self._accepts_gzip())
+        if got is not None:
+            err = False
+            try:
+                self._fast_respond(200, got[0], got[1])
+            except BrokenPipeError:
+                err = True
+            finally:
+                if admitted:
+                    adm.release()
+                self.server.stats.record(
+                    self._metric_endpoint(endpoint),
+                    time.perf_counter() - t0, err,
+                )
+            return True
+        # miss / search / streaming: the full machinery (which skips
+        # re-admission — this request is already in)
+        self._pre_admitted = admitted
+        self._delegated = True
+        try:
+            self.do_POST()
+        finally:
+            self._pre_admitted = False
+            self._delegated = False
+        self.wfile.flush()
+        return True
+
+    def _fast_shed(self, endpoint: str, adm, t0: float):
+        """The lean 429: drain the unread body (keep-alive hygiene,
+        as in do_POST) and answer with Retry-After."""
+        length = int(self.headers.get("content-length") or 0)
+        if 0 < length <= 1 << 20:
+            self.rfile.read(length)
+        elif length:
+            self.close_connection = True
+        body = response_bytes({
+            "error": "overloaded: request shed by admission control; "
+                     "retry after the indicated delay",
+        })
+        out = bytearray(b"HTTP/1.1 429 Too Many Requests\r\n"
+                        b"Content-Type: application/json\r\n")
+        out += b"Content-Length: %d\r\n" % len(body)
+        out += b"Retry-After: %d\r\n" % adm.retry_after_s()
+        if self.close_connection:
+            out += b"Connection: close\r\n"
+        out += b"\r\n" + body
+        try:
+            self.wfile.write(bytes(out))
+            self._maybe_flush()
+        except BrokenPipeError:
+            pass
+        self.server.stats.record(self._metric_endpoint(endpoint),
+                                 time.perf_counter() - t0, True)
+
+    def _fast_respond(self, code: int, payload: bytes, meta: dict):
+        out = bytearray(b"HTTP/1.1 %d OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        % code)
+        out += b"Content-Length: %d\r\n" % len(payload)
+        if meta.get("content_encoding"):
+            out += b"Content-Encoding: gzip\r\n"
+        cache = meta.get("cache")
+        if cache:
+            out += b"X-SimuMax-Cache: %s\r\n" % cache.encode("ascii")
+        if meta.get("key"):
+            out += b"X-SimuMax-Key: %s\r\n" \
+                % str(meta["key"]).encode("ascii")
+        if meta.get("served"):
+            out += b"X-SimuMax-Served: %s\r\n" \
+                % meta["served"].encode("ascii")
+        if self.close_connection:
+            out += b"Connection: close\r\n"
+        out += b"\r\n" + payload
+        self.wfile.write(bytes(out))
+        self._maybe_flush()
+
+    def _maybe_flush(self):
+        """Flush the buffered response writer. (A select-based "defer
+        while more pipelined requests are queued" variant measured
+        SLOWER here: the zero-timeout poll costs a syscall per
+        response and pipelining clients refill their window after
+        reading, so the poll almost never says readable.)"""
+        self.wfile.flush()
+
     # -- POST --------------------------------------------------------------
     def do_POST(self):  # noqa: N802
         t0 = time.perf_counter()
         endpoint = self.path.split("?")[0]
         err = False
         tracer = get_tracer()
+        adm = self.server.admission
+        admitted = None
+        delegated = getattr(self, "_delegated", False)
+        if not delegated:
+            self._raw_body = None
+        if getattr(self, "_pre_admitted", False):
+            # the fast lane admitted this request before delegating;
+            # this path releases it (admission happens exactly once)
+            admitted = True
+        elif not delegated and adm is not None \
+                and endpoint.startswith("/v1/"):
+            # admission happens before the body is even read: a shed
+            # request costs the server a load check and a 429, nothing
+            # else. An admitted request is released in the finally —
+            # it always runs to an answer.
+            admitted = adm.try_admit(self._priority())
+            if not admitted:
+                # keep-alive hygiene: the unread request body would be
+                # parsed as the NEXT request line on this connection.
+                # Drain small bodies (they're already in the socket
+                # buffer); drop the connection for oversized ones
+                # rather than read them under overload.
+                length = int(self.headers.get("Content-Length") or 0)
+                if 0 < length <= 1 << 20:
+                    self.rfile.read(length)
+                elif length:
+                    self.close_connection = True
+                retry = adm.retry_after_s()
+                body = response_bytes({
+                    "error": "overloaded: request shed by admission "
+                             "control; retry after the indicated "
+                             "delay",
+                })
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Retry-After", str(retry))
+                if self.close_connection:
+                    self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+                self.server.stats.record(
+                    self._metric_endpoint(endpoint),
+                    time.perf_counter() - t0, True,
+                )
+                return
+        pool = self.server.pool
+        if pool is not None and pool.memcache is not None \
+                and endpoint in self.FAST_PATH_ENDPOINTS \
+                and not delegated:
+            length = int(self.headers.get("Content-Length") or 0)
+            self._raw_body = self.rfile.read(length) if length \
+                else b"{}"
+            got = pool.memcache.get_raw(endpoint, self._raw_body,
+                                        gzip_ok=self._accepts_gzip())
+            if got is not None:
+                payload, meta = got
+                try:
+                    with tracer.trace(f"POST {endpoint}",
+                                      endpoint=endpoint) as tid:
+                        self._send_json(200, payload, meta)
+                except BrokenPipeError:
+                    err = True
+                finally:
+                    if admitted:
+                        adm.release()
+                    self.server.stats.record(
+                        self._metric_endpoint(endpoint),
+                        time.perf_counter() - t0, err,
+                    )
+                self.server.write_trace(tid, endpoint)
+                return
         with tracer.trace(f"POST {endpoint}", endpoint=endpoint) as tid:
             try:
                 q = None
@@ -332,10 +772,15 @@ class _Handler(BaseHTTPRequestHandler):
                     try:
                         self._dispatch(endpoint, q)
                         # a streamed search that failed mid-body could
-                        # only report the error as an NDJSON line;
-                        # count it here
-                        err = err or getattr(
-                            self, "_stream_error", False)
+                        # only report the error as an NDJSON line, and
+                        # a pooled 400/500 comes back as a status, not
+                        # an exception; count both (popped so the flag
+                        # never leaks into the next keep-alive request)
+                        err = err \
+                            or self.__dict__.pop("_stream_error",
+                                                 False) \
+                            or self.__dict__.pop("_dispatch_error",
+                                                 False)
                     except BrokenPipeError:
                         err = True
                     except Exception as exc:
@@ -346,6 +791,8 @@ class _Handler(BaseHTTPRequestHandler):
                             code, f"{type(exc).__name__}: {exc}"
                         )
             finally:
+                if admitted:
+                    adm.release()
                 self.server.stats.record(
                     self._metric_endpoint(endpoint),
                     time.perf_counter() - t0, err,
@@ -367,6 +814,32 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, endpoint: str, q: dict):
         planner = self.server.planner
+        pool = self.server.pool
+        if pool is not None and endpoint in self.KNOWN_ENDPOINTS \
+                and endpoint.startswith("/v1/") \
+                and not (endpoint == "/v1/search" and q.get("stream")):
+            # pooled serving: memory cache -> single-flight -> worker.
+            # Streaming sweeps stay on this process's planner (the
+            # NDJSON cell stream needs the in-process on_cell hook),
+            # which shares the pool's single-writer store.
+            tracer = get_tracer()
+            trace_ids = tracer.current_ids() if tracer.enabled else None
+            status, payload, meta = pool.serve(
+                endpoint, q, priority=self._priority(),
+                trace_ids=trace_ids,
+                raw=self._raw_body
+                if endpoint in self.FAST_PATH_ENDPOINTS else None,
+                accept_gzip=self._accepts_gzip(),
+            )
+            if status >= 400:
+                # counted by do_POST: the threaded path raises and is
+                # recorded as an error — the pooled path must match
+                self._dispatch_error = True
+            self._send_json(status, payload,
+                            meta if status == 200 else None)
+            if endpoint == "/v1/search" and status == 200:
+                self._offer_warm(q)
+            return
         if endpoint == "/v1/estimate":
             # raw=True: a hit streams the stored canonical bytes
             # without a parse + re-dump (same bytes either way)
@@ -404,35 +877,26 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_error_json(404, f"unknown path {endpoint}")
 
-    def _search_kwargs(self, q: dict) -> dict:
-        def ints(v, default):
-            if v is None:
-                return default
-            if isinstance(v, str):
-                return tuple(int(x) for x in v.split(","))
-            return tuple(int(x) for x in v)
+    def _offer_warm(self, q: dict):
+        """Queue the served sweep's neighbor-warming job (non-blocking
+        best-effort; a full queue drops, never delays the response)."""
+        warmer = self.server.warmer
+        if warmer is not None:
+            warmer.offer(q)
 
-        return dict(
-            model=q["model"], system=q["system"],
-            global_batch_size=int(q["gbs"]),
-            base_strategy=q.get("base_strategy", "tp1_pp1_dp8_mbs1"),
-            world=int(q.get("world") or 0),
-            seq_len=int(q.get("seq_len") or 0),
-            tp_list=ints(q.get("tp"), (1, 2, 4, 8)),
-            pp_list=ints(q.get("pp"), (1, 2, 4)),
-            ep_list=ints(q.get("ep"), (1,)),
-            cp_list=ints(q.get("cp"), (1,)),
-            zero_list=ints(q.get("zero"), (1,)),
-            topk=int(q.get("topk") or 5),
-            engine=q.get("engine", "scalar"),
-            verify_topk=q.get("verify_topk"),
-        )
+    def _search_kwargs(self, q: dict) -> dict:
+        # the one /v1/search body parser, shared with the pool workers
+        # and the warmer's neighbor derivation (service/pool.py)
+        from simumax_tpu.service.pool import search_kwargs
+
+        return search_kwargs(q)
 
     def _search(self, planner: Planner, q: dict):
         kwargs = self._search_kwargs(q)
         if not q.get("stream"):
             payload, meta = planner.search(**kwargs, with_meta=True)
             self._send_json(200, payload, meta)
+            self._offer_warm(q)
             return
         # chunked NDJSON: one line per settled cell, then the result
         self.send_response(200)
@@ -461,7 +925,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "cache": meta["cache"],
                 "cells_cached": meta["cells_cached"],
                 "cells_evaluated": meta["cells_evaluated"],
+                "cells_coalesced": meta.get("cells_coalesced", 0),
             }})
+            self._offer_warm(q)
         except Exception as exc:
             self._stream_error = True
             chunk({"error": f"{type(exc).__name__}: {exc}"})
@@ -472,15 +938,24 @@ def make_server(planner: Optional[Planner] = None,
                 host: str = "127.0.0.1",
                 port: int = 8642,
                 registry: Optional[MetricsRegistry] = None,
-                trace_log: Optional[str] = None) -> PlannerHTTPServer:
+                trace_log: Optional[str] = None,
+                pool=None,
+                admission: Optional[AdmissionController] = None,
+                warmer=None) -> PlannerHTTPServer:
     """Build (but do not start) the server; ``port=0`` binds an
     ephemeral port (``server.server_address[1]`` has the real one).
     ``registry`` defaults to the planner's (itself the process-wide
     one unless the planner was built with an isolated registry);
     ``trace_log`` arms per-request span-tree logging (the ``serve
-    --trace-requests`` artifact)."""
+    --trace-requests`` artifact). ``pool`` / ``admission`` /
+    ``warmer`` are the production-serving attachments
+    (``service/pool.py`` / ``service/warmer.py``, docs/service.md
+    "Production deployment"); all default to off, which is exactly
+    the PR-9 threaded server."""
     return PlannerHTTPServer((host, port), planner or Planner(),
-                             registry=registry, trace_log=trace_log)
+                             registry=registry, trace_log=trace_log,
+                             pool=pool, admission=admission,
+                             warmer=warmer)
 
 
 def serve_forever(server: PlannerHTTPServer):

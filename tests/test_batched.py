@@ -28,6 +28,7 @@ from simumax_tpu.search.batched import (
     fold_interleaved,
     jax_available,
 )
+from simumax_tpu.search.prune import enumerate_cells, make_cell_strategy
 
 
 def _rel_close(a, b, tol=1e-9):
@@ -379,6 +380,53 @@ class TestFoldInterleaved:
             assert got_total == want_total
             assert got_ends == want_ends
 
+    @pytest.mark.skipif(not jax_available(),
+                        reason="jax not importable")
+    def test_jit_fold_matches_numpy_fold_fuzz(self):
+        # the L13 satellite pin: the jitted vmapped interleaved scan
+        # (_jit_fold_interleaved) is bit-identical to the numpy
+        # fold_interleaved under x64 — same float ops, same order
+        import numpy as np
+        from jax.experimental import enable_x64
+
+        from simumax_tpu.search.batched import _jit_fold_interleaved
+
+        rng = random.Random(8642)
+        with enable_x64():
+            for _ in range(12):
+                pp = rng.choice([2, 3, 4])
+                vp = rng.choice([2, 3])
+                group = pp * rng.choice([1, 2])
+                mbc = group * rng.randint(1, 4)
+                n = rng.randint(1, 6)  # candidates sharing the shape
+                fn = _jit_fold_interleaved(pp, vp, mbc, group)
+                fwd = [[[rng.uniform(0.01, 5.0) for _ in range(n)]
+                        for _ in range(vp)] for _ in range(pp)]
+                bwd = [[[rng.uniform(0.01, 5.0) for _ in range(n)]
+                        for _ in range(vp)] for _ in range(pp)]
+                p2p = [rng.uniform(0.0, 2.0) for _ in range(n)]
+                asy = [rng.random() < 0.5 for _ in range(n)]
+                tot, ends = fn(
+                    np.asarray(fwd, dtype=np.float64),
+                    np.asarray(bwd, dtype=np.float64),
+                    np.asarray(p2p, dtype=np.float64),
+                    np.asarray([0.0 if a else p for p, a
+                                in zip(p2p, asy)], dtype=np.float64),
+                )
+                tot = np.asarray(tot)
+                ends = np.asarray(ends)
+                for k in range(n):
+                    want_total, want_ends = fold_interleaved(
+                        pp, vp, mbc, group,
+                        [[fwd[s][c][k] for c in range(vp)]
+                         for s in range(pp)],
+                        [[bwd[s][c][k] for c in range(vp)]
+                         for s in range(pp)],
+                        p2p[k], asy[k])
+                    assert float(tot[k]) == want_total
+                    assert [float(ends[s, k]) for s in range(pp)] \
+                        == want_ends
+
 
 # --------------------------------------------------------------------------
 # JIT backend: jax fold == numpy fold, bit for bit
@@ -425,6 +473,33 @@ class TestJitBackend:
         b = kern.score(mbs, mbc, nrc=nrc, backend="auto")
         for key in ("iter_time", "mfu", "max_peak_bytes"):
             assert np.array_equal(a[key], b[key]), key
+
+    def test_jit_interleaved_schedule_bit_identical(self):
+        # vp > 1 candidates take the _jit_fold_interleaved scan under
+        # backend="jax"; scores must match the numpy fold bit for bit
+        import numpy as np
+
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        for spec in (
+            dict(pp_size=2, interleaving_size=2),
+            dict(pp_size=2, interleaving_size=4),
+            dict(pp_size=4, tp_size=2, interleaving_size=2),
+            dict(pp_size=2, interleaving_size=2,
+                 pp_comm_async=False),
+        ):
+            st = _base(16, **spec)
+            kern = BatchedScorer(model, system).kernel_for(st)
+            # mbc must stay a multiple of the vpp group size
+            g = st.vpp_group_size
+            mbc = [g, 2 * g, 4 * g, 2 * g]
+            mbs = [1] * len(mbc)
+            a = kern.score(mbs, mbc, backend="numpy")
+            b = kern.score(mbs, mbc, backend="jax")
+            assert a is not None and b is not None, spec
+            for key in ("iter_time", "mfu", "max_peak_bytes",
+                        "fits_margin_bytes"):
+                assert np.array_equal(a[key], b[key]), (spec, key)
 
     def test_blocking_p2p_and_margin_paths(self):
         import numpy as np
@@ -652,6 +727,46 @@ class TestGuidedSearch:
         n_guided = diag_u.counters["sweep_cells_evaluated"]
         assert n_guided < n_grid
         assert diag_u.counters.get("sweep_cells_guided_skipped")
+
+    def test_screen_cells_matches_per_cell_on_wide_grid(self):
+        # the L13 satellite pin: the sweep-wide batched screen
+        # (screen_cells, one shared FoldBatch) returns triples
+        # bit-identical to per-cell screen_cell across the wide grid,
+        # including None (invalid family) and exception slots
+        model = get_model_config("llama3-8b")
+        system = get_system_config("tpu_v5p_256")
+        base = _base(64)
+        # backend="jax" jits every shape group (auto would take the
+        # numpy fold below FOLD_BATCH_JIT_MIN members) — parity must
+        # hold on the jitted path, which is the one guided serving uses
+        scorer = BatchedScorer(
+            model, system,
+            backend="jax" if jax_available() else "auto")
+        cells, _pruned, _deduped = enumerate_cells(
+            base, model, system, 64,
+            (1, 2, 4, 8), (1,), (1,), (1, 2, 4, 8), (0, 1, 2, 3),
+            ("none", "selective", "full_block"), prune=True,
+        )
+        assert len(cells) >= 48  # genuinely wide
+        items = [(make_cell_strategy(base, c.tp, c.cp, c.ep, c.pp,
+                                     c.zero), c.rc) for c in cells]
+        batched = scorer.screen_cells(items, model, 64)
+        assert len(batched) == len(items)
+        screened = 0
+        for (st, rc), got in zip(items, batched):
+            try:
+                want = scorer.screen_cell(st, rc, model, 64)
+            except Exception as exc:
+                assert isinstance(got, Exception), (st, rc, got)
+                assert type(got) is type(exc)
+                continue
+            assert got == want, (st, rc)  # exact triples, None incl.
+            if want is not None:
+                screened += 1
+        assert screened >= len(items) // 2
+        if jax_available():
+            # the batch really dispatched shape groups to XLA
+            assert scorer.last_screen_jit
 
     def test_guided_seeded_small_grids(self):
         model = get_model_config("llama2-tiny")

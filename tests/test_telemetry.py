@@ -359,15 +359,17 @@ class TestServerMetrics:
             stats["store"]["counters"]["hits"]
 
     def test_stats_schema_unchanged(self, served):
-        # the /stats response contract bench_service.py scrapes: same
-        # keys, same latency sub-schema as the pre-registry deque days
+        # the /stats response contract bench_service.py scrapes: every
+        # pre-registry key survives with the same latency sub-schema;
+        # "coalesce" (cell-flight sharing) is an additive key, and the
+        # pool/admission/warmer keys only appear under their flags
         srv, req = served
         req("POST", "/v1/estimate", EST)
         _st, _h, d = req("GET", "/stats")
         stats = json.loads(d)
         assert set(stats) == {"uptime_s", "requests", "requests_total",
                               "qps", "errors", "latency", "enabled",
-                              "planner", "store"}
+                              "planner", "store", "coalesce"}
         lat = stats["latency"]["/v1/estimate"]
         assert set(lat) == {"count", "p50_ms", "p99_ms"}
 

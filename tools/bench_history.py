@@ -79,7 +79,8 @@ LOWER_IS_BETTER = {
 #: grid sweep never becomes the baseline of a scalar standard-grid one
 VARIANT_KEYS = ("engine", "grid", "mode", "granularity", "world",
                 "mbc", "queries", "overlap", "threads", "trace",
-                "critical_path")
+                "critical_path", "workers", "admission",
+                "client_procs", "pipeline")
 
 
 def variant_of(result: Dict[str, Any]) -> str:
