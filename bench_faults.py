@@ -1,0 +1,232 @@
+"""Monte-Carlo fault-replay micro-benchmark: scenarios/sec of
+``analyze_faults`` on the pod-scale reference config (no TPU required —
+the workload is the incremental replay engine itself).
+
+Measures the ISSUE-14 perf stack end to end: the slack-gated
+short-circuit, the symmetry-canonicalized + horizon-clamped step cache,
+recorded-stream replay with healthy-prefix forks
+(``simulator/faults.py``), and the process-parallel Monte-Carlo
+(``--jobs``).
+
+Prints exactly ONE JSON line::
+
+    {"metric": "faults_scenarios_per_sec", "value": ..., "unit":
+     "scenarios/s", "world": ..., "n_scenarios": ..., "horizon": ...,
+     "jobs": ..., "elapsed_s": ..., "exact_elapsed_s": ...,
+     "speedup": ..., "bit_identical": true, "step_cache_hit_rate": ...,
+     "shortcircuit_rate": ..., "sims": ..., "prefix_forks": ...}
+
+``value`` counts scenarios per second of the *incremental* run
+(``n_scenarios`` base predictions + the full checkpoint-interval grid);
+``speedup`` is the same-run, same-machine ratio against the exact
+(``incremental=False``) path, and ``bit_identical`` asserts the two
+analyses compare equal — the correctness oracle of the gate.
+
+Usage::
+
+    python bench_faults.py                      # exact + incremental
+    python bench_faults.py --jobs 4             # process-parallel MC
+    python bench_faults.py --skip-exact         # incremental only
+    python bench_faults.py \
+        --baseline results/bench_faults_baseline.json \
+        --max-regression 0.7 --min-speedup 4 \
+        --min-pre-pr-speedup 10   # gates (exit 1 on breach)
+
+The recorded baseline (``results/bench_faults_baseline.json``) also
+carries ``pre_pr_scenarios_per_sec`` — the same workload measured on
+the pre-incremental implementation (the seed commit's
+``analyze_faults``) on the recording machine. ``--min-pre-pr-speedup``
+gates the incremental throughput against that recorded number times
+the shared wide CI margin, so a revert to per-step brute-force replay
+fails the build even on a slower runner.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+    get_system_config,
+)
+from simumax_tpu.perf import PerfLLM
+from simumax_tpu.simulator.faults import ReplayContext
+
+
+def build_perf(world: int, mbc: int):
+    """The bench_simulate.py pod config at goodput scale: tp4 x pp4 x
+    dp(world/16) of a layer-trimmed llama3-8b on as many v5e slices as
+    the world needs."""
+    st = get_strategy_config("tp1_pp2_dp4_mbs1")
+    st.tp_size = 4
+    st.pp_size = 4
+    st.world_size = world
+    st.micro_batch_num = mbc
+    st.__post_init__()
+    model = get_model_config("llama3-8b")
+    model.layer_num = 8
+    system = get_system_config("tpu_v5e_256")
+    system.num_slices = max(1, -(-world // system.chips_per_slice))
+    perf = PerfLLM()
+    perf.configure(st, model, system)
+    perf.run_estimate()
+    return perf
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=512,
+                    help="global ranks in the simulated pod "
+                         "(default 512)")
+    ap.add_argument("--scenarios", type=int, default=32,
+                    help="Monte-Carlo scenarios (default 32)")
+    ap.add_argument("--horizon", type=int, default=50,
+                    help="job horizon in steps (default 50)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mbc", type=int, default=8,
+                    help="microbatches per iteration (default 8)")
+    ap.add_argument("--jobs", type=int, default=0, metavar="N",
+                    help="process-parallel Monte-Carlo workers for the "
+                         "incremental run (default 0 = serial)")
+    ap.add_argument("--skip-exact", action="store_true",
+                    help="skip the exact reference run (no bit-identity "
+                         "check, no measured speedup)")
+    ap.add_argument(
+        "--baseline", metavar="JSON",
+        help="previously saved bench JSON line to gate against "
+             "(compares scenarios/s at the same workload flags)",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=0.1, metavar="FRAC",
+        help="fail (exit 1) when scenarios/s drops more than this "
+             "fraction below the baseline (default 0.1)",
+    )
+    ap.add_argument(
+        "--min-speedup", type=float, default=0.0, metavar="X",
+        help="fail when the measured same-run exact/incremental "
+             "speedup is below X (0 disables)",
+    )
+    ap.add_argument(
+        "--min-pre-pr-speedup", type=float, default=0.0, metavar="X",
+        help="with --baseline: fail when scenarios/s is below X times "
+             "the baseline's recorded pre_pr_scenarios_per_sec, after "
+             "the --max-regression margin (0 disables) — the ISSUE-14 "
+             "10x acceptance gate",
+    )
+    args = ap.parse_args(argv)
+
+    perf = build_perf(args.world, args.mbc)
+    kw = dict(n_scenarios=args.scenarios, seed=args.seed,
+              horizon_steps=args.horizon)
+
+    exact = None
+    exact_elapsed = None
+    if not args.skip_exact:
+        t0 = time.perf_counter()
+        exact = perf.analyze_faults(incremental=False, **kw)
+        exact_elapsed = time.perf_counter() - t0
+
+    ctx = ReplayContext(perf)
+    t0 = time.perf_counter()
+    analysis = perf.analyze_faults(jobs=args.jobs, _ctx=ctx, **kw)
+    elapsed = time.perf_counter() - t0
+
+    stats = ctx.stats
+    steps = max(1, stats["steps"])
+    hits = (stats["cache_hits"] + stats["canon_hits"]
+            + stats["clamp_hits"])
+    result = {
+        "metric": "faults_scenarios_per_sec",
+        "value": round(args.scenarios / elapsed, 3) if elapsed else 0.0,
+        "unit": "scenarios/s",
+        "world": args.world,
+        "n_scenarios": args.scenarios,
+        "horizon": args.horizon,
+        "mbc": args.mbc,
+        "jobs": args.jobs,
+        "elapsed_s": round(elapsed, 3),
+        "predictions": stats["scenarios"],
+        "sims": stats["sims"],
+        "step_cache_hit_rate": round(hits / steps, 4),
+        "shortcircuit_rate": round(stats["shortcircuits"] / steps, 4),
+        "prefix_forks": stats["forks"],
+        "recordings": stats["recordings"],
+    }
+    ok = True
+    if exact is not None:
+        result["exact_elapsed_s"] = round(exact_elapsed, 3)
+        result["speedup"] = (
+            round(exact_elapsed / elapsed, 2) if elapsed else 0.0
+        )
+        result["bit_identical"] = analysis == exact
+        if not result["bit_identical"]:
+            # the correctness oracle: a fast wrong answer is a failure,
+            # whatever the gates below say
+            ok = False
+        if args.min_speedup and result["speedup"] < args.min_speedup:
+            result["speedup_ok"] = False
+            ok = False
+        elif args.min_speedup:
+            result["speedup_ok"] = True
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if "value" not in base or not isinstance(
+            base.get("value"), (int, float)
+        ):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field; re-record it with a plain "
+                         f"bench run",
+            }))
+            return 2
+        for key, ours in (("world", args.world),
+                          ("n_scenarios", args.scenarios),
+                          ("horizon", args.horizon),
+                          ("mbc", args.mbc),
+                          ("jobs", args.jobs)):
+            theirs = base.get(key, ours)
+            if theirs != ours:
+                print(json.dumps({
+                    "error": f"baseline {key} {theirs!r} != this run's "
+                             f"{ours!r}; not comparable — re-record the "
+                             f"baseline with matching flags",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression"] = (
+            round(1.0 - result["value"] / base["value"], 4)
+            if base["value"] else 0.0
+        )
+        result["regression_ok"] = result["value"] >= floor
+        ok = ok and result["regression_ok"]
+        pre = base.get("pre_pr_scenarios_per_sec")
+        if args.min_pre_pr_speedup and isinstance(pre, (int, float)):
+            pre_floor = (pre * args.min_pre_pr_speedup
+                         * (1.0 - args.max_regression))
+            result["pre_pr_scenarios_per_sec"] = pre
+            result["pre_pr_speedup_ok"] = result["value"] >= pre_floor
+            ok = ok and result["pre_pr_speedup_ok"]
+    print(json.dumps(result))
+    record_safely(result)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -831,12 +831,18 @@ def _run_faults(args, perf):
         if not flags and (scenario is None or not scenario.checkpoint):
             return None
         return CheckpointSpec.from_overrides(flags, base)
+    if args.jobs is not None and args.jobs < 1:
+        raise SystemExit(
+            f"invalid --jobs {args.jobs}: expected a positive worker "
+            f"count"
+        )
     if args.monte_carlo:
         with perf.diagnostics.capture(category="faults"):
             res = perf.analyze_faults(
                 n_scenarios=args.monte_carlo, seed=args.seed,
                 horizon_steps=args.horizon or 50, spec=build_spec(),
                 granularity=args.granularity,
+                jobs=args.jobs or 0, incremental=not args.exact,
             )
         g = res["goodput"]
         log.info(
@@ -879,6 +885,7 @@ def _run_faults(args, perf):
         report = perf.predict_goodput(
             scenario, spec=build_spec(scenario),
             granularity=args.granularity,
+            incremental=not args.exact,
         )
     for line in goodput_waterfall_lines(report):
         log.info(line, event="goodput_waterfall")
@@ -1531,6 +1538,16 @@ def main(argv=None):
                          "link_degradation on those dims takes effect; "
                          "'chunk' (default) is faster and models "
                          "pp/dp_cp/edp faults exactly")
+    pf.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="process-parallel Monte-Carlo: fan scenarios "
+                         "across N worker processes (serial == "
+                         "parallel bit-for-bit; default serial)")
+    pf.add_argument("--exact", action="store_true",
+                    help="disable the incremental replay engine (slack "
+                         "short-circuit, canonicalized step cache, "
+                         "healthy-prefix fork) and run the exact "
+                         "step-by-step replay — the bit-identity "
+                         "reference; ~10x+ slower")
     pf.add_argument("--json", metavar="PATH",
                     help="save the full goodput report / analysis JSON")
     _add_diag_args(pf)

@@ -575,6 +575,10 @@ def analyze(skel: DependencySkeleton, makespan: float,
             "waterfall": {"order": [], "buckets": {}, "total": 0.0},
             "path": [], "slack": {}, "per_rank_headroom": [],
             "per_link_headroom": [],
+            "slack_index": {"mode": (meta or {}).get("mode"),
+                            "buckets": 0, "makespan_s": 0.0,
+                            "ranks": [], "links": [],
+                            "rank_buckets": [], "link_buckets": []},
         })
         return report, {}
     S, W = _joins_and_work(skel)
@@ -630,6 +634,31 @@ def analyze(skel: DependencySkeleton, makespan: float,
     rank_slack: Dict[int, float] = {}
     link_work: Dict[str, float] = {}
     link_slack: Dict[str, float] = {}
+    #: class-weighted link/dim work: total wire+exposed seconds across
+    #: the EXACT world (a reduced node stands for ``weights[r]``
+    #: symmetric copies) — the fault-replay slack gate bounds the
+    #: worst-case injected delay of a dim-wide degradation with it
+    link_wwork: Dict[str, float] = {}
+    # time-bucketed slack/work: a fault window mid-step only touches
+    # the nodes it overlaps, so the replay gate needs min-slack/work
+    # restricted to the window — whole-step minima are ~always zero
+    # (the optimizer barrier alone puts a zero-slack node on every
+    # rank). A node spanning several buckets contributes its full work
+    # to each (overcount; the gate's delay bound stays conservative).
+    n_buckets = 48
+    bscale = (n_buckets / makespan) if makespan > 0 else 0.0
+    rank_bwork: Dict[int, List[float]] = {}
+    rank_bslack: Dict[int, List[float]] = {}
+    link_bwork: Dict[str, List[float]] = {}
+    link_bslack: Dict[str, List[float]] = {}
+
+    def _bucket_span(lo_t: float, hi_t: float):
+        lo = int(lo_t * bscale)
+        hi = int(hi_t * bscale)
+        lo = 0 if lo < 0 else (n_buckets - 1 if lo >= n_buckets else lo)
+        hi = lo if hi < lo else (n_buckets - 1 if hi >= n_buckets
+                                 else hi)
+        return lo, hi
     annotations: Dict[tuple, tuple] = {}
     emitted: List[int] = []
     op_work: Dict[str, float] = {}
@@ -652,6 +681,16 @@ def analyze(skel: DependencySkeleton, makespan: float,
         rank_work[r] = rank_work.get(r, 0.0) + w
         if sj < rank_slack.get(r, inf):
             rank_slack[r] = sj
+        blo, bhi = _bucket_span(S[j], skel.end[j])
+        bw = rank_bwork.get(r)
+        if bw is None:
+            bw = rank_bwork[r] = [0.0] * n_buckets
+            rank_bslack[r] = [inf] * n_buckets
+        bs = rank_bslack[r]
+        for b in range(blo, bhi + 1):
+            bw[b] += w
+            if sj < bs[b]:
+                bs[b] = sj
         lk = links[j]
         if lk is not None:
             a, b2 = lk
@@ -663,9 +702,20 @@ def analyze(skel: DependencySkeleton, makespan: float,
         else:
             key = None
         if key is not None:
+            ww = w * (weights[r] if weights is not None else 1)
             link_work[key] = link_work.get(key, 0.0) + w
+            link_wwork[key] = link_wwork.get(key, 0.0) + ww
             if sj < link_slack.get(key, inf):
                 link_slack[key] = sj
+            lbw = link_bwork.get(key)
+            if lbw is None:
+                lbw = link_bwork[key] = [0.0] * n_buckets
+                link_bslack[key] = [inf] * n_buckets
+            lbs = link_bslack[key]
+            for b in range(blo, bhi + 1):
+                lbw[b] += ww
+                if sj < lbs[b]:
+                    lbs[b] = sj
         if r == ref_rank and w > 0 and k not in ("join", "advance"):
             op = _base_op(names[j])
             op_work[op] = op_work.get(op, 0.0) + w
@@ -715,6 +765,44 @@ def analyze(skel: DependencySkeleton, makespan: float,
         e["link"] = e.pop("key")
     report["per_link_headroom"] = per_link[:64]
     report["per_link_count"] = len(per_link)
+
+    # machine-facing slack index (the fault-replay slack gate,
+    # ``simulator/faults.py``): UNtruncated, raw engine seconds.
+    # Rank rows are keyed by representative global rank (class members
+    # behave bit-identically, so they share the rep's row); link rows
+    # carry class-weighted work so a dim-wide perturbation's delay
+    # bound covers every symmetric copy in the exact world. ``None``
+    # slack = unbounded (no timing successor observed).
+    def _bs_out(arr: List[float]) -> List[Optional[float]]:
+        return [v if math.isfinite(v) else None for v in arr]
+
+    report["slack_index"] = {
+        "mode": report["meta"].get("mode"),
+        "buckets": n_buckets,
+        "makespan_s": makespan,
+        "ranks": [
+            [rank_map[r] if rank_map is not None else r,
+             rank_work.get(r, 0.0),
+             (rank_slack[r]
+              if math.isfinite(rank_slack.get(r, inf)) else None)]
+            for r in sorted(rank_work)
+        ],
+        "links": [
+            [k, link_wwork[k],
+             (link_slack[k]
+              if math.isfinite(link_slack.get(k, inf)) else None)]
+            for k in sorted(link_wwork)
+        ],
+        "rank_buckets": [
+            [rank_map[r] if rank_map is not None else r,
+             rank_bwork[r], _bs_out(rank_bslack[r])]
+            for r in sorted(rank_bwork)
+        ],
+        "link_buckets": [
+            [k, link_bwork[k], _bs_out(link_bslack[k])]
+            for k in sorted(link_bwork)
+        ],
+    }
 
     report["sim_ops"] = op_work
     return report, annotations

@@ -175,6 +175,28 @@ METRICS: Dict[str, Dict[str, str]] = {
         "help": "Requests shed with 429 by admission control, by "
                 "priority class.",
     },
+    "faults_scenarios_total": {
+        "type": "counter",
+        "help": "Fault scenarios walked by predict_goodput (one per "
+                "goodput prediction).",
+    },
+    "faults_step_cache_hits_total": {
+        "type": "counter",
+        "help": "Perturbed-step simulations answered from the replay "
+                "step cache, by kind (exact/canonical signature).",
+    },
+    "faults_slack_shortcircuits_total": {
+        "type": "counter",
+        "help": "Perturbed steps proven makespan-neutral by the "
+                "critical-path slack gate and answered without a "
+                "replay.",
+    },
+    "faults_prefix_forks_total": {
+        "type": "counter",
+        "help": "Perturbed-step replays resumed from a forked "
+                "healthy-prefix engine snapshot instead of replaying "
+                "the step from t=0.",
+    },
 }
 
 #: default bounded-reservoir size for histograms: big enough for stable

@@ -63,6 +63,30 @@ strings, and an ``event_sink`` callable (see
 in-memory event list entirely so peak RSS no longer scales with total
 event count. Completed rendezvous and consumed p2p bookkeeping are
 deleted eagerly for the same reason.
+
+Incremental replay (the ISSUE-14 fault-replay engine,
+``simulator/faults.py``) adds three capabilities, all inert on the
+default path:
+
+* ``drop_events=True`` keeps the per-rank event *counters* but never
+  constructs :class:`TraceEvent` objects — a replayed fault step only
+  needs the makespan and the death log;
+* :class:`RecordingProc` / :class:`ReplayProc` capture a rank
+  coroutine's request stream once and replay it without re-running the
+  schedule walk. ``advance`` targets are the one clock-derived request
+  payload (``StageProcess`` computes ``clock + p2p_time``), so they are
+  delta-encoded against the engine's last sent value and re-based at
+  replay time — a recorded stream stays exact under a different fault
+  timeline;
+* :meth:`SimuEngine.run_incremental` with ``pause_at=T`` stops just
+  before any service whose *timing decision* could observe fault state
+  at or after ``T`` (a heap pop at clock >= T, a compute span crossing
+  T, an async-stream op starting at or after T). Every service the
+  paused prefix performed is therefore bit-identical under any fault
+  model whose first onset is >= T, which makes the paused state a
+  reusable fork point: :meth:`SimuEngine.fork` clones it (replay procs
+  are plain index cursors), the caller attaches the scenario's fault
+  model and resumes only the suffix.
 """
 
 from __future__ import annotations
@@ -137,6 +161,93 @@ class DeadlockError(SimulationError):
     state dump in the message and structured context for diagnostics."""
 
 
+class ReplayProc:
+    """A recorded request stream driven as a rank coroutine.
+
+    Duck-types the slice of the generator protocol the engine uses
+    (``send``/``close``) and — unlike a real generator — supports
+    :meth:`clone`, which is what makes :meth:`SimuEngine.fork`
+    possible: the whole coroutine state is an index into a shared,
+    immutable stream list.
+
+    ``("advance_rel", delta)`` entries (see :class:`RecordingProc`)
+    are re-based against the engine's last sent clock value, exactly
+    mirroring how ``StageProcess`` derives its ``advance`` targets from
+    the value returned by the preceding ``send`` yield.
+    """
+
+    __slots__ = ("stream", "i", "last", "closed")
+
+    def __init__(self, stream):
+        self.stream = stream
+        self.i = 0
+        self.last = None
+        self.closed = False
+
+    def send(self, value):
+        if value is not None:
+            self.last = value
+        if self.closed or self.i >= len(self.stream):
+            raise StopIteration
+        req = self.stream[self.i]
+        self.i += 1
+        if req[0] == "advance_rel":
+            base = self.last if self.last is not None else 0.0
+            return ("advance", base + req[1])
+        return req
+
+    def close(self):
+        self.closed = True
+
+    def clone(self) -> "ReplayProc":
+        c = ReplayProc.__new__(ReplayProc)
+        c.stream = self.stream  # shared, append-never
+        c.i = self.i
+        c.last = self.last
+        c.closed = self.closed
+        return c
+
+
+class RecordingProc:
+    """Wraps a live rank coroutine and records its request stream so
+    later replays of the same step program skip the schedule walk
+    entirely (:class:`ReplayProc`).
+
+    The recorded stream is fault-independent: ``StageProcess`` yields
+    are structural except for ``advance`` targets, which are the value
+    returned by the preceding yield plus a fixed offset — those are
+    delta-encoded here (``("advance_rel", delta)``) and re-based at
+    replay time. ``complete`` is True only when the coroutine ran to
+    ``StopIteration``; a stream truncated by a rank death must not be
+    cached (it would starve longer-lived replays).
+    """
+
+    __slots__ = ("gen", "stream", "complete", "_last")
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.stream: list = []
+        self.complete = False
+        self._last = None
+
+    def send(self, value):
+        if value is not None:
+            self._last = value
+        try:
+            req = self.gen.send(value)
+        except StopIteration:
+            self.complete = True
+            raise
+        if req[0] == "advance" and self._last is not None:
+            self.stream.append(("advance_rel", req[1] - self._last))
+        else:
+            self.stream.append(req)
+        return req
+
+    def close(self):
+        self.gen.close()
+
+
 class SimuEngine:
     """Deterministic multi-rank virtual-time executor."""
 
@@ -145,7 +256,8 @@ class SimuEngine:
                  fault_model=None, dep_recorder=None,
                  event_delays: Optional[Dict[Tuple[int, int], float]] = None,
                  progress: Optional[Callable[..., None]] = None,
-                 progress_every: int = 0):
+                 progress_every: int = 0,
+                 drop_events: bool = False):
         #: optional fault-injection hook (see ``simulator/faults.py::
         #: StepFaultModel``) consulted at event-service time: piecewise
         #: compute-rate multipliers, comm-time multipliers per
@@ -173,6 +285,10 @@ class SimuEngine:
         #: them out instead — the bounded-memory path)
         self.events: List[TraceEvent] = []
         self._sink = event_sink
+        #: counts-only mode (incremental fault replay): keep the
+        #: per-rank event counters but never construct TraceEvents
+        self._drop_events = drop_events
+        self._primed = False
         self.num_events = 0
         #: per-rank event counts (total / comm-kind) — symmetry-reduced
         #: runs expand these by class weight for full-world accounting
@@ -218,25 +334,121 @@ class SimuEngine:
         self._dead = [False] * num_ranks
         self._death_at: Dict[int, float] = {}
         self.deaths: List[Tuple[int, float]] = []
+        #: per-rank fault fast paths, refreshed at every run entry (the
+        #: replay engine swaps fault models between resumes): death
+        #: time and whether the rank has any slowdown window — the hot
+        #: serve loop indexes these instead of calling into the model
+        self._death_t: List[Optional[float]] = [None] * num_ranks
+        self._has_slow: List[bool] = [False] * num_ranks
 
     def add_rank(self, rank: int, proc: Generator):
         self._procs[rank] = proc
 
     # -- engine loop -------------------------------------------------------
     def run(self) -> float:
-        # prime every coroutine to its first request (rank order: every
-        # clock is 0.0, so the heap replays exactly this tie-break)
-        for r in range(self.num_ranks):
-            self._advance_rank(r, None)
+        self.run_incremental()
+        return max(self.clock) if self.clock else 0.0
+
+    def run_incremental(self, pause_at: Optional[float] = None) -> bool:
+        """Run (or resume) the engine loop; returns True when every
+        rank finished.
+
+        With ``pause_at=T`` the loop stops (returning False) just
+        before any service whose *timing decision* could observe fault
+        state at or after virtual time ``T``: a heap pop at clock >= T,
+        a compute span that would cross T, an async-stream op whose
+        rendezvous would start at or after T, or a drain-time kill
+        (deaths are fault state by definition). Everything the paused
+        prefix served made decisions strictly before T — compute spans
+        fully inside ``[0, T)``, comm durations fixed at starts < T —
+        so the paused state is bit-identical under *any* fault model
+        whose earliest event starts at or after T, which is what makes
+        it a reusable fork point (:meth:`fork`). Resume by calling
+        again with a later ``pause_at`` or None."""
+        fault = self._fault
+        if fault is not None:
+            self._death_t = [
+                fault.death_time(r) for r in range(self.num_ranks)
+            ]
+            self._has_slow = [
+                fault.has_slow(r) for r in range(self.num_ranks)
+            ]
+        if not self._primed:
+            self._primed = True
+            # prime every coroutine to its first request (rank order:
+            # every clock is 0.0, so the heap replays this tie-break)
+            for r in range(self.num_ranks):
+                self._advance_rank(r, None)
         ready = self._ready
         served = 0
         every = self._progress_every if self._progress is not None else 0
         t0 = _time.monotonic() if every else 0.0
+        # hot-loop locals + the conditions under which the compute fast
+        # path below is bit-identical to _try_serve's compute arm (no
+        # recorder/delay/progress hooks to fire, no pending death)
+        pending = self._pending
+        clock = self.clock
+        done = self._done
+        queued = self._queued
+        procs = self._procs
+        events_by_rank = self.events_by_rank
+        death_t = self._death_t
+        has_slow = self._has_slow
+        drop = self._drop_events
+        sink = self._sink
+        events = self.events
+        fast_ok = (self._rec is None and self._delays is None
+                   and every == 0)
         while True:
             while ready:
+                if pause_at is not None and ready[0][0] >= pause_at:
+                    return False
                 _, r = heappop(ready)
-                self._queued[r] = False
-                if self._done[r] or self._pending[r] is None:
+                queued[r] = False
+                if done[r] or pending[r] is None:
+                    continue
+                if pause_at is not None and self._crosses_pause(
+                    r, pause_at
+                ):
+                    # push back untouched: the resume re-pops it first
+                    queued[r] = True
+                    heappush(ready, (clock[r], r))
+                    return False
+                req = pending[r]
+                if (fast_ok and req[0] == "compute"
+                        and (fault is None or death_t[r] is None)):
+                    # inlined compute serve (the dominant request kind
+                    # in a replay): same arithmetic, same emission,
+                    # same advance as _try_serve — minus the call chain
+                    duration = req[1]
+                    start = clock[r]
+                    if fault is not None and has_slow[r]:
+                        end = fault.compute_end(r, start, duration)
+                    else:
+                        end = start + duration
+                    if end > start:
+                        self.num_events += 1
+                        events_by_rank[r] += 1
+                        if not drop:
+                            ev = TraceEvent(r, req[3], req[2], start,
+                                            end)
+                            if sink is not None:
+                                sink(ev)
+                            else:
+                                events.append(ev)
+                    clock[r] = end
+                    proc = procs[r]
+                    try:
+                        nreq = proc.send(end)
+                    except StopIteration:
+                        done[r] = True
+                        self._n_done += 1
+                        pending[r] = None
+                        continue
+                    pending[r] = nreq
+                    if not queued[r]:
+                        queued[r] = True
+                        heappush(ready, (end, r))
                     continue
                 if not self._try_serve(r):
                     self._block(r)
@@ -254,7 +466,7 @@ class SimuEngine:
                             elapsed_s=elapsed,
                         )
             if self._n_done >= self.num_ranks:
-                break
+                return True
             # heap drained with live ranks left: nothing can wake them —
             # unless a blocked rank is scheduled to die, in which case
             # the death resolves its partners' waits (graceful
@@ -273,10 +485,113 @@ class SimuEngine:
                 ]
             if not doomed:
                 self._deadlock_dump()
+            if pause_at is not None:
+                # deaths are never earlier than the scenario onset, so
+                # the kill belongs to the suffix — pause before it
+                return False
             dt, r = min(doomed)
             self.clock[r] = max(self.clock[r], dt)
             self._kill(r)
-        return max(self.clock) if self.clock else 0.0
+
+    def _crosses_pause(self, rank: int, pause_at: float) -> bool:
+        """Whether serving ``rank``'s pending request now could commit
+        a timing decision at or after ``pause_at``. Pops are already
+        gated at clock < pause_at; the residual cases are a compute
+        span crossing the pause time (its duration integrates fault
+        windows inside the span) and an async-stream rendezvous this
+        post would complete with a start at or after the pause (its
+        comm scale is sampled at that start)."""
+        req = self._pending[rank]
+        kind = req[0]
+        if kind == "compute":
+            return self.clock[rank] + req[1] > pause_at
+        if kind == "async_collective":
+            _, stream, _duration, _name, peers = req
+            seq = self._async_seq.get((stream, rank), 0)
+            pset = frozenset(peers)
+            rv = self._async_rv.get((stream, pset, seq))
+            arrivals = rv.arrivals if rv is not None else {}
+            missing = len(pset) - len(arrivals) - (
+                0 if rank in arrivals else 1
+            )
+            if missing == 0:  # this post completes the rendezvous
+                start = max(
+                    max(arrivals.values(), default=0.0),
+                    self.clock[rank],
+                    self._async_chain.get((stream, pset), 0.0),
+                )
+                return start >= pause_at
+        return False
+
+    def fork(self) -> "SimuEngine":
+        """Clone the engine's full scheduling state. Only valid when
+        every rank coroutine is cloneable (:class:`ReplayProc`) — live
+        generators cannot be copied, which is exactly why the
+        incremental fault replay records request streams first."""
+        for p in self._procs:
+            if p is not None and not hasattr(p, "clone"):
+                raise SimulationError(
+                    "engine.fork() needs cloneable rank procs "
+                    "(ReplayProc); live generators cannot be forked",
+                    phase="simulate",
+                )
+
+        def rv_copy(rv: _Rendezvous) -> _Rendezvous:
+            return _Rendezvous(
+                peers=rv.peers, arrivals=dict(rv.arrivals),
+                duration=rv.duration, end=rv.end, consumed=rv.consumed,
+                name=rv.name, fault_extra=rv.fault_extra,
+            )
+
+        new = SimuEngine.__new__(SimuEngine)
+        new._fault = self._fault
+        new._rec = None
+        new._delays = None
+        new._progress = None
+        new._progress_every = 0
+        new.num_ranks = self.num_ranks
+        new.clock = list(self.clock)
+        new.events = []
+        new._sink = self._sink
+        new._drop_events = self._drop_events
+        new._primed = self._primed
+        new.num_events = self.num_events
+        new.events_by_rank = list(self.events_by_rank)
+        new.comm_events_by_rank = list(self.comm_events_by_rank)
+        new._procs = [
+            p.clone() if p is not None else None for p in self._procs
+        ]
+        new._pending = list(self._pending)
+        new._done = list(self._done)
+        new._n_done = self._n_done
+        new._ready = list(self._ready)
+        new._queued = list(self._queued)
+        new._waiters = {k: set(v) for k, v in self._waiters.items()}
+        new._waiting_on = list(self._waiting_on)
+        new._collectives = {
+            k: rv_copy(v) for k, v in self._collectives.items()
+        }
+        new._coll_seq = dict(self._coll_seq)
+        new._sends = dict(self._sends)
+        new._send_seq = dict(self._send_seq)
+        new._recv_seq = dict(self._recv_seq)
+        new._recv_posts = dict(self._recv_posts)
+        new._sr_done = dict(self._sr_done)
+        new._sr_dur = dict(self._sr_dur)
+        new._flow_ids = dict(self._flow_ids)
+        new._next_flow = self._next_flow
+        new._async_chain = dict(self._async_chain)
+        new._async_seq = dict(self._async_seq)
+        new._async_rv = {k: rv_copy(v) for k, v in self._async_rv.items()}
+        new.comm_done = list(self.comm_done)
+        new._async_pending = [set(s) for s in self._async_pending]
+        new.mem_hooks = []
+        new._dead = list(self._dead)
+        new._death_at = dict(self._death_at)
+        new.deaths = list(self.deaths)
+        new._death_t = list(self._death_t)
+        new._has_slow = list(self._has_slow)
+        return new
 
     # -- scheduler plumbing ------------------------------------------------
     def _enqueue(self, rank: int):
@@ -385,8 +700,7 @@ class SimuEngine:
         self.deaths.append((rank, t))
         if self._rec is not None:
             self._rec.on_death(rank, t)
-        self._emit(TraceEvent(rank, "comp", "rank_death", t, t,
-                              kind="fault"))
+        self._emit_ev(rank, "comp", "rank_death", t, t, kind="fault")
         proc = self._procs[rank]
         if proc is not None:
             proc.close()
@@ -427,11 +741,20 @@ class SimuEngine:
             if self._waiting_on[r]:
                 self._wake(r)
 
-    def _emit(self, ev: TraceEvent):
+    def _emit_ev(self, rank: int, lane: str, name: str, start: float,
+                 end: float, kind: str = "compute",
+                 flow_id: Optional[int] = None):
+        """Counting emit: under ``drop_events`` (incremental fault
+        replay) the per-rank counters advance — they drive the
+        ``event_delays`` keying and the result accounting — but no
+        :class:`TraceEvent` is ever constructed."""
         self.num_events += 1
-        self.events_by_rank[ev.rank] += 1
-        if ev.kind != "compute":
-            self.comm_events_by_rank[ev.rank] += 1
+        self.events_by_rank[rank] += 1
+        if kind != "compute":
+            self.comm_events_by_rank[rank] += 1
+        if self._drop_events:
+            return
+        ev = TraceEvent(rank, lane, name, start, end, kind, flow_id)
         if self._sink is not None:
             self._sink(ev)
         else:
@@ -460,7 +783,7 @@ class SimuEngine:
     def _try_serve(self, rank: int) -> bool:
         fault = self._fault
         if fault is not None and not self._dead[rank]:
-            dt = fault.death_time(rank)
+            dt = self._death_t[rank]
             if dt is not None and self.clock[rank] >= dt:
                 self._kill(rank)
                 return True
@@ -470,8 +793,9 @@ class SimuEngine:
             _, duration, name, lane = req
             start = self.clock[rank]
             if fault is not None:
-                end = fault.compute_end(rank, start, duration)
-                dt = fault.death_time(rank)
+                end = (fault.compute_end(rank, start, duration)
+                       if self._has_slow[rank] else start + duration)
+                dt = self._death_t[rank]
                 if dt is not None and end > dt:
                     # the rank dies mid-op: emit the truncated span,
                     # then let the kill resolve its partners
@@ -479,7 +803,7 @@ class SimuEngine:
                         if self._rec is not None:
                             self._rec.on_compute(rank, name, lane, start,
                                                  dt, 0.0)
-                        self._emit(TraceEvent(rank, lane, name, start, dt))
+                        self._emit_ev(rank, lane, name, start, dt)
                     self.clock[rank] = dt
                     self._kill(rank)
                     return True
@@ -488,11 +812,12 @@ class SimuEngine:
             if end > start:
                 # fault share of the span (slowdown stretch) for blame
                 extra = end - (start + duration)
-                end += self._delay(rank)
+                if self._delays is not None:
+                    end += self._delay(rank)
                 if self._rec is not None:
                     self._rec.on_compute(rank, name, lane, start, end,
                                          extra)
-                self._emit(TraceEvent(rank, lane, name, start, end))
+                self._emit_ev(rank, lane, name, start, end)
             self.clock[rank] = end
             self._advance_rank(rank, self.clock[rank])
             return True
@@ -509,10 +834,8 @@ class SimuEngine:
             start = self.clock[rank]
             if self._rec is not None:
                 self._rec.on_trace(rank, name, start, start + duration)
-            self._emit(
-                TraceEvent(rank, lane, name, start, start + duration,
-                           kind="comm")
-            )
+            self._emit_ev(rank, lane, name, start, start + duration,
+                          kind="comm")
             self._advance_rank(rank, start)
             return True
         if kind == "collective":
@@ -558,7 +881,9 @@ class SimuEngine:
             if rv.end is None:
                 return False  # stay blocked until the last peer arrives
             start = self.clock[rank]
-            end = rv.end + self._delay(rank)
+            end = rv.end
+            if self._delays is not None:
+                end += self._delay(rank)
             if self._rec is not None:
                 dead = [] if fault is None else [
                     p for p in rv.peers
@@ -566,15 +891,12 @@ class SimuEngine:
                 ]
                 self._rec.on_coll_serve(ckey, key, rank, name, start, end,
                                         rv.fault_extra, dead)
-            self._emit(
-                TraceEvent(rank, "comm", name, start, end, kind="comm")
-            )
+            self._emit_ev(rank, "comm", name, start, end, kind="comm")
             self.clock[rank] = end
             self._coll_seq[(key, rank)] = seq + 1
             rv.consumed += 1
-            live = len(rv.peers) if fault is None else sum(
-                1 for p in rv.peers if not self._dead[p]
-            )
+            live = len(rv.peers) if fault is None or not self.deaths \
+                else sum(1 for p in rv.peers if not self._dead[p])
             if rv.consumed >= live:
                 del self._collectives[ckey]
                 if self._rec is not None:
@@ -657,10 +979,8 @@ class SimuEngine:
                 self._rec.on_send(skey, rank, name, lane, post,
                                   post + duration, extra,
                                   advance_tail=False, rendezvous=False)
-            self._emit(
-                TraceEvent(rank, lane, name, post, post + duration,
-                           kind="p2p", flow_id=fid)
-            )
+            self._emit_ev(rank, lane, name, post, post + duration,
+                          kind="p2p", flow_id=fid)
             self._publish(("send", skey))
             self._advance_rank(rank, post)
             return True
@@ -683,10 +1003,9 @@ class SimuEngine:
                                 rank, f"abort_{name}", self.clock[rank],
                                 end,
                             )
-                        self._emit(
-                            TraceEvent(rank, lane, f"abort_{name}",
-                                       self.clock[rank], end, kind="fault")
-                        )
+                        self._emit_ev(rank, lane, f"abort_{name}",
+                                      self.clock[rank], end,
+                                      kind="fault")
                     self.clock[rank] = end
                     self._advance_rank(rank, end)
                     return True
@@ -711,10 +1030,8 @@ class SimuEngine:
                 self._rec.on_send(skey, rank, name, lane,
                                   self.clock[rank], end, extra,
                                   advance_tail=True, rendezvous=True)
-            self._emit(
-                TraceEvent(rank, lane, name, self.clock[rank], end,
-                           kind="p2p", flow_id=fid)
-            )
+            self._emit_ev(rank, lane, name, self.clock[rank], end,
+                          kind="p2p", flow_id=fid)
             self.clock[rank] = end
             self._publish(("send", skey))
             self._advance_rank(rank, end)
@@ -744,10 +1061,9 @@ class SimuEngine:
                                 rank, f"abort_{name}", self.clock[rank],
                                 end,
                             )
-                        self._emit(
-                            TraceEvent(rank, lane, f"abort_{name}",
-                                       self.clock[rank], end, kind="fault")
-                        )
+                        self._emit_ev(rank, lane, f"abort_{name}",
+                                      self.clock[rank], end,
+                                      kind="fault")
                     self.clock[rank] = end
                     self._advance_rank(rank, end)
                     return True
@@ -765,16 +1081,15 @@ class SimuEngine:
             arrive = max(self.clock[rank], post + duration)
             emitted = arrive > self.clock[rank]
             if emitted:
-                arrive += self._delay(rank)
+                if self._delays is not None:
+                    arrive += self._delay(rank)
             if self._rec is not None:
                 self._rec.on_recv_serve(skey, rank, name, self.clock[rank],
                                         arrive, emitted)
             if emitted:
-                self._emit(
-                    TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
-                               arrive, kind="wait",
-                               flow_id=self._flow_ids.get(skey))
-                )
+                self._emit_ev(rank, lane, f"wait_{name}",
+                              self.clock[rank], arrive, kind="wait",
+                              flow_id=self._flow_ids.get(skey))
             self._flow_ids.pop(skey, None)
             self.clock[rank] = arrive
             self._publish(("sendpop", skey))
@@ -814,10 +1129,9 @@ class SimuEngine:
                                           lane, post_t, post_t + sdur,
                                           extra, advance_tail=False,
                                           rendezvous=False)
-                    self._emit(
-                        TraceEvent(rank, lane, f"send_{name}", post_t,
-                                   post_t + sdur, kind="p2p", flow_id=fid)
-                    )
+                    self._emit_ev(rank, lane, f"send_{name}", post_t,
+                                  post_t + sdur, kind="p2p",
+                                  flow_id=fid)
                     self._publish(("send", out_key))
                 elif self._delays is not None and out_key in self._sr_dur:
                     # re-serve attempt: keep the duration the publish
@@ -851,11 +1165,9 @@ class SimuEngine:
                                     rank, f"abort_{name}",
                                     self.clock[rank], end,
                                 )
-                            self._emit(
-                                TraceEvent(rank, lane, f"abort_{name}",
-                                           self.clock[rank], end,
-                                           kind="fault")
-                            )
+                            self._emit_ev(rank, lane, f"abort_{name}",
+                                          self.clock[rank], end,
+                                          kind="fault")
                         self.clock[rank] = end
                         self._advance_rank(rank, end)
                         return True
@@ -883,11 +1195,9 @@ class SimuEngine:
                                     rank, f"abort_{name}",
                                     self.clock[rank], end,
                                 )
-                            self._emit(
-                                TraceEvent(rank, lane, f"abort_{name}",
-                                           self.clock[rank], end,
-                                           kind="fault")
-                            )
+                            self._emit_ev(rank, lane, f"abort_{name}",
+                                          self.clock[rank], end,
+                                          kind="fault")
                         self.clock[rank] = end
                         self._advance_rank(rank, end)
                         return True
@@ -923,10 +1233,8 @@ class SimuEngine:
                     in_key, out_key, emitted,
                 )
             if emitted:
-                self._emit(
-                    TraceEvent(rank, lane, f"wait_{name}", self.clock[rank],
-                               end, kind="wait")
-                )
+                self._emit_ev(rank, lane, f"wait_{name}",
+                              self.clock[rank], end, kind="wait")
             self.clock[rank] = end
             self._advance_rank(rank, end)
             return True
@@ -970,9 +1278,7 @@ class SimuEngine:
             if self._rec is not None:
                 self._rec.on_async_finish_peer(ckey, chain_key, name,
                                                start, pend, peer, extra)
-            self._emit(
-                TraceEvent(peer, "comm", name, start, pend, kind="comm")
-            )
+            self._emit_ev(peer, "comm", name, start, pend, kind="comm")
         if self._rec is not None:
             self._rec.on_async_done(ckey)
         del self._async_rv[ckey]

@@ -96,24 +96,66 @@ def _membership(groups: List[List[int]]) -> Dict[int, List[int]]:
     return by_rank
 
 
-def build_reduction(st, perturbation: Optional[dict] = None,
-                    signatures: Optional[dict] = None) -> ReductionPlan:
-    """Partition the world into symmetry classes and map the simulated
-    structures onto class representatives. Deterministic: classes are
-    numbered by their smallest member.
+def canonical_class_order(plan: ReductionPlan,
+                          seeds: List[tuple]) -> List[int]:
+    """A structure-canonical ordering of a plan's classes, used by the
+    fault-replay step cache (``simulator/faults.py``) to relabel two
+    plans that differ only in *which* symmetric ranks a scenario
+    touched into one byte-equal cache key.
 
-    ``signatures`` maps rank -> extra hashable identity folded into the
-    initial colors: a fault scenario's per-rank event signature
-    (``faults.py::FaultScenario.rank_signatures``) shatters exactly the
-    classes its rank-scoped events touch, the same way a straggler
-    ``perturbation`` does."""
-    perturbation = perturbation or {}
-    signatures = signatures or {}
+    Runs the same color-refinement idiom as :func:`build_reduction`,
+    but over *classes*: initial colors are ``(stage, perturb, class
+    size, seed)`` — ``seeds[i]`` carries the class's fault timeline —
+    refined by the color tuples of each class's rendezvous-group peers
+    (in group order) and pipeline neighbours until stable. Classes are
+    then ordered by final color, ties broken by original class index.
+
+    The ordering is only a *relabeling recipe*: the cache key built
+    from it re-serializes the full engine problem in the new
+    numbering, so an imperfect canonicalization can cost cache hits
+    but never correctness (byte-equal keys are byte-equal problems).
+    """
+    k = plan.n_classes
+    color: List[tuple] = [
+        (plan.stages[i], plan.perturbs[i], len(plan.classes[i]), seeds[i])
+        for i in range(k)
+    ]
+    canon: Dict[tuple, int] = {}
+    out: List[int] = [0] * k
+    n_colors = 0
+    while True:
+        canon.clear()
+        for i in range(k):
+            sig = [color[i]]
+            for dim in sorted(plan.groups[i]):
+                sig.append(
+                    (dim, tuple(color[p] for p in plan.groups[i][dim]))
+                )
+            sig.append(tuple(sorted(
+                (s, color[p]) for s, p in plan.neighbor_maps[i].items()
+            )))
+            key = tuple(sig)
+            c = canon.get(key)
+            if c is None:
+                c = canon[key] = len(canon)
+            out[i] = c
+        if len(canon) == n_colors:
+            break
+        n_colors = len(canon)
+        color = [(c,) for c in out]
+    return sorted(range(k), key=lambda i: (out[i], i))
+
+
+def reduction_structure(st) -> tuple:
+    """The world's relational structure — group memberships, pipeline
+    stages and neighbours — computed once and reusable across
+    :func:`build_reduction` calls on the same strategy (the
+    fault-replay engine builds one plan per scenario partition, and at
+    pod scale this precompute dominates the refinement itself)."""
     n = st.world_size
     pp = st.pp_size
     stride = st.tp_size * st.cp_size * st.dp_size  # == StageProcess._pp_stride
 
-    # relational structure, computed once (same sources as the runner)
     memberships: Dict[str, Dict[int, List[int]]] = {}
     for dim in ("tp", "cp", "ep", "etp"):
         if getattr(st, f"{dim}_size") > 1:
@@ -140,8 +182,42 @@ def build_reduction(st, perturbation: Optional[dict] = None,
     nxt = [pp_next(r) for r in range(n)]
     prv = [pp_prev(r) for r in range(n)]
     dims = sorted(memberships)
+    return memberships, stages, nxt, prv, dims
 
-    # color refinement to fixpoint
+
+def build_reduction(st, perturbation: Optional[dict] = None,
+                    signatures: Optional[dict] = None,
+                    structure: Optional[tuple] = None) -> ReductionPlan:
+    """Partition the world into symmetry classes and map the simulated
+    structures onto class representatives. Deterministic: classes are
+    numbered by their smallest member.
+
+    ``signatures`` maps rank -> extra hashable identity folded into the
+    initial colors: a fault scenario's per-rank event signature
+    (``faults.py::FaultScenario.rank_signatures``) shatters exactly the
+    classes its rank-scoped events touch, the same way a straggler
+    ``perturbation`` does. Signature *values* reach the refinement only
+    through equality, so any renaming that preserves the induced
+    partition yields the same plan — seeding them with the healthy
+    class ids (as the fault-replay engine does) additionally makes the
+    refinement converge from the already-stable healthy partition.
+
+    ``structure`` reuses a precomputed :func:`reduction_structure`."""
+    perturbation = perturbation or {}
+    signatures = signatures or {}
+    n = st.world_size
+    pp = st.pp_size
+
+    stride = st.tp_size * st.cp_size * st.dp_size
+    if structure is None:
+        structure = reduction_structure(st)
+    memberships, stages, nxt, prv, dims = structure
+
+    # color refinement to fixpoint. Group color tuples are computed
+    # once per shared group object per iteration (members reference
+    # the same list), not once per member — at pod scale the dp_cp
+    # buckets alone are 16+ members wide and this is the refinement's
+    # dominant cost.
     color = [
         (stages[r], float(perturbation.get(r, 1.0)), signatures.get(r))
         for r in range(n)
@@ -151,12 +227,17 @@ def build_reduction(st, perturbation: Optional[dict] = None,
     n_colors = 0
     while True:
         canon.clear()
+        group_colors: Dict[int, tuple] = {}
         for r in range(n):
             sig = [color[r]]
             for dim in dims:
                 grp = memberships[dim].get(r)
                 if grp is not None:
-                    sig.append(tuple(color[p] for p in grp))
+                    gc = group_colors.get(id(grp))
+                    if gc is None:
+                        gc = tuple(color[p] for p in grp)
+                        group_colors[id(grp)] = gc
+                    sig.append(gc)
                 else:
                     sig.append(None)
             if pp > 1:

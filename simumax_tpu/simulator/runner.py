@@ -72,6 +72,46 @@ def _world_memberships(st) -> dict:
     return memberships
 
 
+def build_reduced_engine(perf, plan, granularity: str,
+                         fault_model=None, engine_kw: Optional[dict] = None,
+                         wrap_proc=None, drop_events: bool = False):
+    """Engine + one ``StageProcess`` coroutine per symmetry class of
+    ``plan`` — the world-rank construction shared by
+    :func:`run_simulation` and the incremental fault-replay engine
+    (``simulator/faults.py``), so the two can never drift.
+
+    ``wrap_proc(engine_rank, gen) -> proc`` wraps each coroutine (the
+    replay engine passes a ``RecordingProc`` to capture request
+    streams); ``drop_events=True`` keeps event counters without
+    constructing trace records (replays need only makespan + deaths).
+    """
+    k = plan.n_classes
+    engine = SimuEngine(k, fault_model=fault_model,
+                        drop_events=drop_events, **(engine_kw or {}))
+    barrier = list(range(k))
+    for i in range(k):
+        groups = {
+            d: g for d, g in plan.groups[i].items()
+            if d in ("tp", "cp", "ep", "etp")
+        }
+        buckets = {
+            d: g for d, g in plan.groups[i].items()
+            if d in ("dp_cp", "edp")
+        }
+        proc = StageProcess(
+            perf, plan.stages[i], tracker=None,
+            granularity=granularity,
+            rank=i, perturb=plan.perturbs[i],
+            groups=groups, bucket_groups=buckets,
+            neighbor_map=plan.neighbor_maps[i] or None,
+            barrier_group=barrier,
+        ).process()
+        if wrap_proc is not None:
+            proc = wrap_proc(i, proc)
+        engine.add_rank(i, proc)
+    return engine
+
+
 def run_simulation(
     perf,
     save_path: Optional[str] = None,
@@ -285,28 +325,10 @@ def run_simulation(
                 faults, rank_map=plan.reps if plan is not None else None
             )
         if plan is not None:
-            k = plan.n_classes
-            engine = SimuEngine(k, event_sink=sink,
-                                fault_model=fault_model, **engine_kw)
-            barrier = list(range(k))
-            for i in range(k):
-                groups = {
-                    d: g for d, g in plan.groups[i].items()
-                    if d in ("tp", "cp", "ep", "etp")
-                }
-                buckets = {
-                    d: g for d, g in plan.groups[i].items()
-                    if d in ("dp_cp", "edp")
-                }
-                proc = StageProcess(
-                    perf, plan.stages[i], tracker=None,
-                    granularity=granularity,
-                    rank=i, perturb=plan.perturbs[i],
-                    groups=groups, bucket_groups=buckets,
-                    neighbor_map=plan.neighbor_maps[i] or None,
-                    barrier_group=barrier,
-                )
-                engine.add_rank(i, proc.process())
+            engine = build_reduced_engine(
+                perf, plan, granularity, fault_model=fault_model,
+                engine_kw=dict(event_sink=sink, **engine_kw),
+            )
         else:
             from simumax_tpu.parallel.mesh import rank_coords
 

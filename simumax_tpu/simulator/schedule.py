@@ -299,7 +299,6 @@ class StageProcess:
     # -- one microbatch forward / backward ---------------------------------
     def _fwd(self, mb: int, clock: List[float], chunks=None) -> Generator:
         for chunk in (chunks if chunks is not None else self.chunks):
-            leaves = chunk.called_leaves()
             if self.granularity == "chunk":
                 dur = (chunk.cost_info.compute.fwd * self.perturb
                        + chunk.cost_info.net_exposed.fwd)
@@ -308,7 +307,7 @@ class StageProcess:
                 self._alloc(t, chunk.act_info.cache_bytes,
                             f"mb{mb}:c{chunk.chunk_idx}", "act")
                 continue
-            for leaf in leaves:
+            for leaf in chunk.called_leaves():
                 comp = leaf.cost_info.compute.fwd * self.perturb
                 name = leaf.path_name().split(".", 1)[-1]
                 for ev in self._comm_events(leaf, "fwd", "pre"):
@@ -332,7 +331,6 @@ class StageProcess:
 
     def _bwd(self, mb: int, clock: List[float], chunks=None) -> Generator:
         for chunk in reversed(chunks if chunks is not None else self.chunks):
-            leaves = chunk.called_leaves()
             if self.granularity == "chunk":
                 dur = (
                     chunk.cost_info.compute.bwd * self.perturb
@@ -344,6 +342,7 @@ class StageProcess:
                 clock[0] = t
                 self._free(t, token=f"mb{mb}:c{chunk.chunk_idx}", tag="act")
                 continue
+            leaves = chunk.called_leaves()
             done = set()
             i = len(leaves) - 1
             while i >= 0:

@@ -21,6 +21,8 @@ from simumax_tpu.simulator.faults import (
     CheckpointSpec,
     FaultEvent,
     FaultScenario,
+    ReplayContext,
+    ReplayOptions,
     predict_goodput,
     sample_scenario,
 )
@@ -514,3 +516,189 @@ class TestChaos:
         p, healthy = _chaos_perf(key)
         empty = p.simulate(None, faults=FaultScenario([]), **SIM)
         assert empty == healthy
+
+
+# ---------------------------------------------------------------------------
+# Incremental fault replay (ISSUE 14): bit-identity sweep, slack
+# soundness, parallel Monte-Carlo
+# ---------------------------------------------------------------------------
+
+#: every optimization independently off + all on + all off: each
+#: variant must be bit-identical to the exact (incremental=False) path
+REPLAY_VARIANTS = {
+    "all_on": ReplayOptions(),
+    "no_gate": ReplayOptions(short_circuit=False),
+    "no_canon": ReplayOptions(canonical_cache=False),
+    "no_fork": ReplayOptions(prefix_fork=False),
+    "no_clamp": ReplayOptions(horizon_clamp=False),
+    "all_off": ReplayOptions(short_circuit=False, canonical_cache=False,
+                             prefix_fork=False, horizon_clamp=False),
+}
+
+
+class TestIncrementalReplay:
+    @pytest.mark.parametrize("key", sorted(CHAOS_CONFIGS))
+    def test_bit_identity_sweep(self, key):
+        """Incremental-vs-exact GoodputReport bit-identity on the full
+        dense/MoE/MLA x pp{1,2,4} grid, with every optimization
+        toggled off independently. ``to_dict()`` must compare equal —
+        byte-equal after json round-trip — for every variant."""
+        import json as _json
+
+        p, healthy = _chaos_perf(key)
+        world = p.strategy.world_size
+        spec = CheckpointSpec(interval_steps=2, restart_overhead_s=2.0)
+        ctxs = {
+            name: ReplayContext(p, options=opts)
+            for name, opts in REPLAY_VARIANTS.items()
+        }
+        for seed in range(2):
+            rng = random.Random(
+                sum(ord(c) for c in key) * 977 + seed
+            )
+            sc = sample_scenario(
+                rng, world, healthy["end_time_ms"] * 6,
+                horizon_steps=4, seed=seed,
+            )
+            exact = predict_goodput(
+                p, sc, spec=spec, incremental=False,
+            ).to_dict()
+            exact_bytes = _json.dumps(exact, sort_keys=True)
+            for name, ctx in ctxs.items():
+                got = predict_goodput(p, sc, spec=spec, _ctx=ctx)
+                assert got.to_dict() == exact, (key, seed, name)
+                assert _json.dumps(
+                    got.to_dict(), sort_keys=True
+                ) == exact_bytes, (key, seed, name)
+
+    def test_bit_identity_leaf_granularity(self, perf):
+        """Leaf granularity resolves intra-stage collectives, so the
+        replay engine must stay exact for tp link degradation too."""
+        h_ms = perf.simulate(
+            None, world_ranks=True, granularity="leaf",
+            track_memory=False,
+        )["end_time_ms"]
+        sc = FaultScenario([
+            FaultEvent("link_degradation", 0.0, duration_ms=h_ms,
+                       dim="*", multiplier=3.0),
+            FaultEvent("slowdown", h_ms * 0.2, duration_ms=h_ms,
+                       rank=1, multiplier=2.0),
+        ], horizon_steps=3)
+        spec = CheckpointSpec(interval_steps=2, restart_overhead_s=2.0)
+        a = predict_goodput(p := perf, sc, spec=spec,
+                            granularity="leaf", incremental=False)
+        b = predict_goodput(p, sc, spec=spec, granularity="leaf")
+        assert a.to_dict() == b.to_dict()
+
+    def test_slack_shortcircuit_sound_and_live(self, perf, healthy):
+        """The PR-7-style soundness property for the slack gate: when
+        the gate answers a sub-scenario without simulating, an exact
+        replay of the same sub-scenario must land on the healthy
+        makespan to the bit — and across a seeded sweep of
+        small-perturbation scenarios the gate must actually fire
+        (proven live, not vacuously sound)."""
+        ctx = ReplayContext(perf, options=ReplayOptions(
+            canonical_cache=False, prefix_fork=False,
+            horizon_clamp=False,
+        ))
+        h = ctx.healthy()["end_time"]
+        h_ms = healthy["end_time_ms"]
+        fired = 0
+        for seed in range(24):
+            rng = random.Random(4242 + seed)
+            events = [FaultEvent(
+                "slowdown", rng.uniform(0, h_ms * 0.8),
+                duration_ms=rng.uniform(h_ms * 0.001, h_ms * 0.05),
+                rank=rng.randrange(8),
+                # tiny and large multipliers: the gate must fire on
+                # (some of) the former and never mis-fire on the latter
+                multiplier=rng.choice((1.0005, 1.002, 4.0)),
+            )]
+            if rng.random() < 0.4:
+                events.append(FaultEvent(
+                    "link_degradation", rng.uniform(0, h_ms * 0.5),
+                    duration_ms=rng.uniform(h_ms * 0.01, h_ms * 0.2),
+                    dim=rng.choice(("pp", "dp_cp", "tp")),
+                    multiplier=rng.choice((1.001, 5.0)),
+                ))
+            sub = FaultScenario(events)
+            before = ctx.stats["shortcircuits"]
+            dur, death = ctx.simulate_step(sub, h)
+            exact = perf.simulate(None, faults=sub, **SIM)
+            if ctx.stats["shortcircuits"] > before:
+                fired += 1
+                assert death is None
+                # the gate's claim, replay-verified: zero movement
+                assert exact["end_time"] == h, (seed, sub.to_dict())
+            assert dur == exact["end_time"], (seed, sub.to_dict())
+        assert fired > 0, "slack gate never fired across the sweep"
+
+    def test_analyze_incremental_equals_exact(self, perf):
+        kw = dict(n_scenarios=4, seed=11, horizon_steps=6,
+                  spec=CheckpointSpec(interval_steps=2,
+                                      restart_overhead_s=2.0))
+        a = perf.analyze_faults(incremental=False, **kw)
+        b = perf.analyze_faults(**kw)
+        assert a == b
+
+    def test_analyze_serial_parallel_bit_identical(self, perf):
+        """PR-2 executor discipline: ``jobs=N`` must be bit-for-bit
+        equal to the serial walk (results merge in scenario order; the
+        canonical cache only dedupes, never changes a value)."""
+        kw = dict(n_scenarios=4, seed=7, horizon_steps=5,
+                  spec=CheckpointSpec(interval_steps=2,
+                                      restart_overhead_s=2.0))
+        a = perf.analyze_faults(**kw)
+        b = perf.analyze_faults(jobs=2, **kw)
+        assert a == b
+
+    def test_analyze_reuses_base_walk_for_spec_interval(self, perf):
+        """Satellite: a grid entry equal to ``spec.interval_steps``
+        reuses the base reports instead of re-walking every scenario
+        — the walk count stays at one per scenario."""
+        spec = CheckpointSpec(interval_steps=3, restart_overhead_s=2.0)
+        ctx = ReplayContext(perf)
+        res = perf.analyze_faults(
+            n_scenarios=3, seed=5, horizon_steps=6, spec=spec,
+            intervals=[3], _ctx=ctx,
+        )
+        assert ctx.stats["scenarios"] == 3  # base walks only
+        exact = perf.analyze_faults(
+            n_scenarios=3, seed=5, horizon_steps=6, spec=spec,
+            intervals=[3], incremental=False,
+        )
+        assert res == exact
+
+    def test_replay_counters_in_registry(self, perf):
+        from simumax_tpu.observe.telemetry import get_registry
+
+        reg = get_registry()
+        before = reg.counter("faults_scenarios_total").value
+        predict_goodput(
+            perf, FaultScenario([], horizon_steps=2),
+            spec=CheckpointSpec(interval_steps=2),
+        )
+        assert reg.counter("faults_scenarios_total").value > before
+
+    def test_ctx_rejects_reduce_false(self, perf):
+        with pytest.raises(ConfigError, match="reduce"):
+            ReplayContext(perf, reduce=False)
+
+    def test_cli_exact_and_jobs_flags(self, tmp_path):
+        import json as _json
+
+        from simumax_tpu.cli import main
+
+        out_a = tmp_path / "exact.json"
+        out_b = tmp_path / "inc.json"
+        base = ["faults", "--model", "llama2-tiny",
+                "--strategy", "tp1_pp2_dp4_mbs1",
+                "--system", "tpu_v5e_256",
+                "--monte-carlo", "2", "--horizon", "4"]
+        main(base + ["--exact", "--json", str(out_a)])
+        main(base + ["--json", str(out_b)])
+        assert _json.loads(out_a.read_text()) == (
+            _json.loads(out_b.read_text())
+        )
+        with pytest.raises(SystemExit, match="--jobs"):
+            main(base + ["--jobs", "0"])
