@@ -1,0 +1,254 @@
+"""Fleet-simulation micro-benchmark: jobs-steps/sec of the multi-job
+trace walk on the reference 512-chip trace (no TPU required — the
+workload is the cross-job replay amortization itself, docs/fleet.md).
+
+Measures the ISSUE-15 perf stack end to end: ONE replay context per
+template serving every job instantiated from it (healthy-step DES,
+recorded streams, snapshot ladders, canonical step cache shared
+across the whole trace), against the **naive baseline** — the same
+scheduler walk costing every job with a fresh replay context per
+``predict_goodput`` call, which re-pays the healthy-step DES run and
+all replay state per job (``fleet/sim.py`` ``naive=True``).
+
+Prints exactly ONE JSON line::
+
+    {"metric": "fleet_jobs_steps_per_sec", "value": ..., "unit":
+     "jobs-steps/s", "n_jobs": ..., "templates": ..., "world": ...,
+     "total_steps": ..., "elapsed_s": ..., "costings": ...,
+     "sims": ..., "step_cache_hit_rate": ...,
+     "naive_elapsed_s": ..., "naive_jobs_steps_per_sec": ...,
+     "speedup": ..., "bit_identical": true, ...}
+
+``value`` counts trace job-steps per second of the *shared* walk;
+``speedup`` is the same-run, same-machine ratio against the naive
+loop, and ``bit_identical`` asserts the two fleet reports compare
+equal with elastic reshaping disabled — the correctness oracle of the
+gate. ``--jobs N`` additionally runs the pooled walk and asserts
+``parallel_identical`` (serial == parallel byte-equality).
+
+Usage::
+
+    python bench_fleet.py                        # shared + naive
+    python bench_fleet.py --jobs 2               # + parallel oracle
+    python bench_fleet.py --skip-naive           # shared only
+    python bench_fleet.py --elastic-demo         # + elastic timing
+    python bench_fleet.py \
+        --baseline results/bench_fleet_baseline.json \
+        --max-regression 0.7 --min-speedup 6 \
+        --min-naive-speedup 10   # gates (exit 1 on breach)
+
+The recorded baseline carries ``naive_jobs_steps_per_sec`` — the
+naive loop measured on the recording machine. ``--min-naive-speedup``
+gates the shared walk's throughput against that recorded number times
+the shared wide CI margin, so a revert to per-job replay-state
+rebuilds fails the build even on a slower runner (the ISSUE-15 10x
+acceptance gate).
+"""
+
+import argparse
+import copy
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from tools.bench_history import record_safely
+except ImportError:  # script copied out of the repo: no trajectory
+    def record_safely(result):
+        return None
+
+import warnings
+
+warnings.filterwarnings("ignore")
+
+from simumax_tpu.fleet import FleetSimulator
+from simumax_tpu.fleet.trace import FleetTrace
+
+DEFAULT_TRACE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "configs", "fleet", "v5p512_reference.json",
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", default=DEFAULT_TRACE,
+                    metavar="TRACE.json",
+                    help="fleet trace to walk (default: the reference "
+                         "512-chip trace)")
+    ap.add_argument("--reps", type=int, default=2, metavar="N",
+                    help="shared-walk repetitions; the fastest is "
+                         "recorded (machine-noise control, the "
+                         "bench_simulate min-of-N idiom; default 2)")
+    ap.add_argument("--jobs", type=int, default=0, metavar="N",
+                    help="also run the pooled walk with N workers and "
+                         "assert serial == parallel byte-equality")
+    ap.add_argument("--skip-naive", action="store_true",
+                    help="skip the naive reference walk (no "
+                         "bit-identity check, no measured speedup)")
+    ap.add_argument("--elastic-demo", action="store_true",
+                    help="also time the elastic walk (trace scheduler "
+                         "settings; informational, never gated)")
+    ap.add_argument(
+        "--baseline", metavar="JSON",
+        help="previously saved bench JSON line to gate against",
+    )
+    ap.add_argument(
+        "--max-regression", type=float, default=0.15, metavar="FRAC",
+        help="fail (exit 1) when jobs-steps/s drops more than this "
+             "fraction below the baseline (default 0.15)",
+    )
+    ap.add_argument(
+        "--min-speedup", type=float, default=0.0, metavar="X",
+        help="fail when the measured same-run naive/shared speedup "
+             "is below X (0 disables)",
+    )
+    ap.add_argument(
+        "--min-naive-speedup", type=float, default=0.0, metavar="X",
+        help="with --baseline: fail when jobs-steps/s is below X "
+             "times the baseline's recorded "
+             "naive_jobs_steps_per_sec, after the --max-regression "
+             "margin (0 disables) — the ISSUE-15 10x acceptance gate",
+    )
+    args = ap.parse_args(argv)
+
+    trace = FleetTrace.load(args.trace).to_dict()
+    total_steps = sum(j["horizon_steps"] for j in trace["jobs"])
+
+    # estimates are built untimed on BOTH modes (they share them);
+    # the timed region isolates the replay-state differential. The
+    # fastest of --reps walks is recorded (every rep is a FRESH
+    # simulator: replay state is rebuilt, nothing leaks between reps)
+    elapsed = None
+    report = shared = None
+    for _ in range(max(1, args.reps)):
+        sim = FleetSimulator(copy.deepcopy(trace), elastic=False)
+        sim.prepare()
+        t0 = time.perf_counter()
+        rep = sim.run()
+        dt = time.perf_counter() - t0
+        if report is not None and rep != report:
+            # determinism oracle across repetitions
+            print(json.dumps({
+                "error": "fleet walk is not deterministic across "
+                         "repetitions",
+            }))
+            return 1
+        if elapsed is None or dt < elapsed:
+            elapsed, shared = dt, sim
+        if report is None:
+            report = rep
+    sims = hits = steps = 0
+    for rt in shared._runtimes.values():
+        s = rt.ctx.stats
+        sims += s["sims"]
+        steps += s["steps"]
+        hits += s["cache_hits"] + s["canon_hits"] + s["clamp_hits"]
+
+    result = {
+        "metric": "fleet_jobs_steps_per_sec",
+        "value": round(total_steps / elapsed, 3) if elapsed else 0.0,
+        "unit": "jobs-steps/s",
+        "n_jobs": len(trace["jobs"]),
+        "templates": len(trace["templates"]),
+        "world": sum(p["chips"] for p in trace["fleet"]["pods"]),
+        "total_steps": total_steps,
+        "elapsed_s": round(elapsed, 3),
+        "costings": shared.stats["costings"],
+        "sims": sims,
+        "step_cache_hit_rate": round(hits / max(1, steps), 4),
+        "fleet_goodput": round(report["fleet_goodput"], 6),
+        "slo_fraction": round(report["slo"]["fraction"], 6),
+    }
+    ok = True
+    if not args.skip_naive:
+        naive_sim = FleetSimulator(
+            copy.deepcopy(trace), elastic=False, naive=True,
+        )
+        naive_sim.prepare()
+        t0 = time.perf_counter()
+        naive_report = naive_sim.run()
+        naive_elapsed = time.perf_counter() - t0
+        result["naive_elapsed_s"] = round(naive_elapsed, 3)
+        result["naive_jobs_steps_per_sec"] = (
+            round(total_steps / naive_elapsed, 3) if naive_elapsed
+            else 0.0
+        )
+        result["speedup"] = (
+            round(naive_elapsed / elapsed, 2) if elapsed else 0.0
+        )
+        # the correctness oracle: with elastic off, the shared walk's
+        # per-job GoodputReports (and the whole payload) must equal
+        # the naive loop's byte-for-byte
+        result["bit_identical"] = report == naive_report
+        if not result["bit_identical"]:
+            ok = False
+        if args.min_speedup and result["speedup"] < args.min_speedup:
+            result["speedup_ok"] = False
+            ok = False
+        elif args.min_speedup:
+            result["speedup_ok"] = True
+    if args.jobs:
+        t0 = time.perf_counter()
+        par_report = FleetSimulator(
+            copy.deepcopy(trace), elastic=False, jobs=args.jobs,
+        ).run()
+        result["parallel_elapsed_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        result["parallel_identical"] = report == par_report
+        if not result["parallel_identical"]:
+            ok = False
+    if args.elastic_demo:
+        t0 = time.perf_counter()
+        el_report = FleetSimulator(copy.deepcopy(trace)).run()
+        result["elastic_elapsed_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        result["elastic_reshapes"] = sum(
+            j["reshapes"] for j in el_report["jobs"]
+        )
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if not isinstance(base.get("value"), (int, float)):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field; re-record it with a plain "
+                         f"bench run",
+            }))
+            return 2
+        for key in ("n_jobs", "templates", "world", "total_steps"):
+            theirs = base.get(key, result[key])
+            if theirs != result[key]:
+                print(json.dumps({
+                    "error": f"baseline {key} {theirs!r} != this "
+                             f"run's {result[key]!r}; not comparable "
+                             f"— re-record the baseline",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression"] = (
+            round(1.0 - result["value"] / base["value"], 4)
+            if base["value"] else 0.0
+        )
+        result["regression_ok"] = result["value"] >= floor
+        ok = ok and result["regression_ok"]
+        nv = base.get("naive_jobs_steps_per_sec")
+        if args.min_naive_speedup and isinstance(nv, (int, float)):
+            naive_floor = (nv * args.min_naive_speedup
+                           * (1.0 - args.max_regression))
+            result["baseline_naive_jobs_steps_per_sec"] = nv
+            result["naive_speedup_ok"] = result["value"] >= naive_floor
+            ok = ok and result["naive_speedup_ok"]
+    print(json.dumps(result))
+    record_safely(result)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Worked fleet-simulation example (docs/fleet.md, README).
+
+Walks a small two-pod fleet: three jobs from one template share 32
+chips under a maintenance window, a priority preemption, and a spot
+reclaim that (with elastic scheduling) shrinks the victim's dp
+instead of rolling it back — then prints the fleet report and the
+scheduler-decision timeline, and contrasts elastic vs
+rollback-restart accounting for the reclaimed job.
+
+The reference 512-chip trace the bench gates lives at
+``configs/fleet/v5p512_reference.json``; walk it the same way (it
+takes a few seconds shared, ~30x longer with ``naive=True``):
+
+CLI equivalent::
+
+    python -m simumax_tpu fleet \
+        --trace configs/fleet/v5p512_reference.json
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from simumax_tpu.fleet import fleet_report_lines, simulate_fleet
+
+TRACE = {
+    "schema": "simumax-fleet-trace-v1",
+    "fleet": {
+        "pods": [{"name": "p0", "chips": 16},
+                 {"name": "p1", "chips": 16}],
+        "maintenance": [
+            {"pod": "p1", "start_s": 8.0, "duration_s": 4.0},
+        ],
+        "spot_reclaims": [
+            {"pod": "p0", "start_s": 3.0, "chips": 4},
+        ],
+        "scheduler": {"policy": "priority", "elastic": True,
+                      "reshape_overhead_s": 5.0},
+    },
+    "templates": {
+        # llama2-tiny, tp1 x pp2 x dp8 on 16 chips; gbs 48 splits
+        # over 6 survivors after losing one dp replica, so the spot
+        # reclaim can reshape instead of restarting
+        "tiny-16": {
+            "model": "llama2-tiny",
+            "strategy": "tp1_pp2_dp4_mbs1",
+            "system": "tpu_v5e_256",
+            "granularity": "chunk",
+            "overrides": {"strategy": {"world_size": 16,
+                                       "micro_batch_num": 6}},
+        },
+    },
+    "jobs": [
+        {"name": "batch-a", "template": "tiny-16", "arrival_s": 0.0,
+         "horizon_steps": 120, "priority": "normal", "spot": True,
+         "slo_goodput": 0.8, "checkpoint": {"interval_steps": 30}},
+        {"name": "batch-b", "template": "tiny-16", "arrival_s": 0.5,
+         "horizon_steps": 120, "priority": "low", "spot": True,
+         "slo_goodput": 0.7},
+        {"name": "interactive", "template": "tiny-16",
+         "arrival_s": 2.0, "horizon_steps": 30, "priority": "high",
+         "slo_goodput": 0.9, "checkpoint": {"interval_steps": 10}},
+    ],
+}
+
+
+def main():
+    report = simulate_fleet(TRACE)
+    for line in fleet_report_lines(report, top_decisions=20):
+        print(line)
+
+    print()
+    print("-- elastic vs rollback-restart, per reclaimed job --")
+    restart = simulate_fleet(TRACE, elastic=False)
+    for el, rb in zip(report["jobs"], restart["jobs"]):
+        if el["reshapes"] or (rb["report"] or {}).get("n_restarts"):
+            eg = el["report"]["goodput"] if el["report"] else None
+            rg = rb["report"]["goodput"] if rb["report"] else None
+            print(f"  {el['name']}: elastic goodput "
+                  f"{100.0 * eg:.2f}% ({el['reshapes']} reshapes) vs "
+                  + (f"restart goodput {100.0 * rg:.2f}% "
+                     f"({rb['report']['n_restarts']} restarts)"
+                     if rg is not None else
+                     f"restart path starved ({rb['state']})"))
+
+
+if __name__ == "__main__":
+    main()

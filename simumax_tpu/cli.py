@@ -30,9 +30,12 @@ takes ``--faults SCENARIO.json`` (timed rank slowdowns, preemptions,
 link degradation, rank deaths injected into the simulated step);
 ``faults`` predicts the goodput waterfall of a scenario over its job
 horizon (``--scenario``) or Monte-Carlos the failure space for the
-optimal checkpoint interval (``--monte-carlo N --seed S``).
-``SimulationError`` escaping any command exits 3 with a one-line
-message (the full engine dump goes to ``--diagnostics``).
+optimal checkpoint interval (``--monte-carlo N --seed S``);
+``fleet`` walks a multi-job arrival trace over a shared pod fleet
+(docs/fleet.md) for fleet-wide goodput, per-job SLO attainment, and
+the scheduler-decision timeline. ``SimulationError`` escaping any
+command exits 3 with a one-line message (the full engine dump goes to
+``--diagnostics``).
 
 Observability surface (see ``docs/observability.md``): ``explain``
 renders the MFU-loss waterfall + top-N op table from the
@@ -898,6 +901,44 @@ def _run_faults(args, perf):
                  path=args.json)
 
 
+def cmd_fleet(args):
+    """Multi-job fleet walk (docs/fleet.md): fleet goodput, per-job
+    SLO attainment, scheduler-decision timeline."""
+    from simumax_tpu.fleet.report import fleet_report_lines
+
+    log = _log()
+    if args.naive or not _cache_enabled(args):
+        # the naive baseline (and cache-off runs) walk directly; the
+        # default path routes through the planner so repeated
+        # capacity-planning queries hit the persistent store
+        from simumax_tpu.fleet.sim import simulate_fleet
+
+        report = simulate_fleet(
+            args.trace, jobs=args.jobs or 0, elastic=args.elastic,
+            naive=args.naive,
+        )
+    else:
+        from simumax_tpu.service.planner import Planner
+
+        planner = Planner(cache_dir=getattr(args, "cache_dir", None))
+        report, meta = planner.fleet(
+            args.trace, jobs=args.jobs or 0, elastic=args.elastic,
+            with_meta=True,
+        )
+        log.info(
+            f"[cache {meta['cache']}] {meta['key'][:16]}",
+            event="fleet_cache", cache=meta["cache"],
+            key=meta["key"],
+        )
+    for line in fleet_report_lines(report, top_decisions=args.top):
+        log.info(line, event="fleet")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+        log.info(f"fleet report -> {args.json}", event="fleet_json",
+                 path=args.json)
+
+
 def cmd_critpath(args):
     from simumax_tpu import PerfLLM
 
@@ -1104,7 +1145,7 @@ def cmd_serve(args):
         f"[serve] planning service on http://{host}:{port} "
         f"({mode_desc}; cache: {cache_desc}) — GET /healthz /stats "
         f"/metrics, POST /v1/estimate /v1/explain /v1/search "
-        f"/v1/faults /v1/simulate"
+        f"/v1/faults /v1/simulate /v1/fleet"
         + (f"; admission backlog {args.admission}" if admission
            else "")
         + (f"; warm queue {args.warm}" if warmer else "")
@@ -1554,6 +1595,51 @@ def main(argv=None):
     _add_log_args(pf)
     pf.set_defaults(fn=cmd_faults)
 
+    pfl = sub.add_parser(
+        "fleet",
+        help="multi-job fleet simulation over a job-arrival trace: "
+             "fleet-wide goodput, per-job SLO attainment, and the "
+             "scheduler-decision timeline (docs/fleet.md)",
+    )
+    pfl.add_argument(
+        "--trace", required=True, metavar="TRACE.json",
+        help="fleet trace (simumax-fleet-trace-v1: pods + "
+             "maintenance/spot/degradation windows + templates + "
+             "job arrivals)",
+    )
+    pfl.add_argument(
+        "--elastic", action="store_true", default=None,
+        help="force elastic dp-reshape on rank death (overrides the "
+             "trace's scheduler.elastic)",
+    )
+    pfl.add_argument(
+        "--no-elastic", dest="elastic", action="store_false",
+        help="force rollback-restart accounting (overrides the "
+             "trace's scheduler.elastic)",
+    )
+    pfl.add_argument(
+        "--jobs", type=int, default=0, metavar="N",
+        help="fan job costings across N worker processes (serial == "
+             "parallel bit-for-bit; default serial)",
+    )
+    pfl.add_argument(
+        "--naive", action="store_true",
+        help="cost every job with a fresh replay context (the "
+             "per-job predict_goodput loop bench_fleet.py gates "
+             "against) instead of the shared per-template context",
+    )
+    pfl.add_argument("--top", type=int, default=12, metavar="N",
+                     help="decision-timeline lines to print "
+                          "(default 12)")
+    pfl.add_argument("--json", metavar="PATH",
+                     help="save the full fleet report JSON")
+    pfl.add_argument("--cache-dir", metavar="DIR",
+                     help="planner cache directory override")
+    pfl.add_argument("--no-cache", action="store_true",
+                     help="bypass the planner cache")
+    _add_log_args(pfl)
+    pfl.set_defaults(fn=cmd_fleet)
+
     pd = sub.add_parser(
         "dualpp",
         help="DualPipe bidirectional-schedule projection (even pp)",
@@ -1584,8 +1670,8 @@ def main(argv=None):
         help="long-running JSON-over-HTTP planning server backed by "
              "the persistent content-addressed cache "
              "(docs/service.md): concurrent estimate/explain/search/"
-             "faults/simulate queries, single-flight dedup, NDJSON "
-             "sweep streaming, /healthz + /stats",
+             "faults/simulate/fleet queries, single-flight dedup, "
+             "NDJSON sweep streaming, /healthz + /stats",
     )
     psv.add_argument("--host", default="127.0.0.1",
                      help="bind address (default 127.0.0.1)")

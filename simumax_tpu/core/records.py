@@ -360,13 +360,17 @@ class GoodputBuckets:
     #: wall time of work lost to a failure and re-run: steps committed
     #: since the last checkpoint plus the aborted partial step
     restart_replay: float = 0.0
+    #: elastic dp-reshape cost (fleet simulation): aborted partial step
+    #: plus the state-redistribution collectives and re-init overhead
+    #: when survivors shrink instead of rolling back to a checkpoint
+    reshape: float = 0.0
 
     @property
     def wall_time(self) -> float:
         return (
             self.useful_train + self.fault_stall + self.checkpoint_write
             + self.restore_read + self.restart_overhead
-            + self.restart_replay
+            + self.restart_replay + self.reshape
         )
 
     def to_dict(self) -> Dict[str, float]:

@@ -1,0 +1,31 @@
+"""Fleet-scale goodput simulation: N jobs sharing the pod fleet
+(docs/fleet.md). ``trace`` defines the input schema, ``sim`` the
+scheduler walk with cross-job replay amortization, ``report`` the
+payload + rendering."""
+
+from simumax_tpu.fleet.report import build_fleet_report, fleet_report_lines
+from simumax_tpu.fleet.sim import (
+    FleetSimulator,
+    TemplateRuntime,
+    elastic_goodput_walk,
+    simulate_fleet,
+)
+from simumax_tpu.fleet.trace import (
+    FleetSpec,
+    FleetTrace,
+    JobSpec,
+    TemplateSpec,
+)
+
+__all__ = [
+    "FleetTrace",
+    "FleetSpec",
+    "TemplateSpec",
+    "JobSpec",
+    "FleetSimulator",
+    "TemplateRuntime",
+    "simulate_fleet",
+    "elastic_goodput_walk",
+    "build_fleet_report",
+    "fleet_report_lines",
+]

@@ -75,6 +75,7 @@ GOODPUT_WATERFALL_ORDER = (
     "restore_read",
     "restart_overhead",
     "restart_replay",
+    "reshape",
 )
 
 _GOODPUT_SHORT = {
@@ -84,6 +85,7 @@ _GOODPUT_SHORT = {
     "restore_read": "restore",
     "restart_overhead": "restart",
     "restart_replay": "replay",
+    "reshape": "reshape",
 }
 
 
@@ -93,7 +95,9 @@ def build_goodput_waterfall(report) -> Dict[str, Any]:
     buckets sum to the job wall time within 1e-6 by construction (the
     goodput accounting is itself the decomposition)."""
     d = report if isinstance(report, dict) else report.to_dict()
-    buckets = {k: d["buckets"][k] for k in GOODPUT_WATERFALL_ORDER}
+    # .get: pre-reshape persisted reports carry no "reshape" bucket
+    buckets = {k: d["buckets"].get(k, 0.0)
+               for k in GOODPUT_WATERFALL_ORDER}
     return {
         "order": list(GOODPUT_WATERFALL_ORDER),
         "buckets": buckets,

@@ -197,6 +197,24 @@ METRICS: Dict[str, Dict[str, str]] = {
                 "healthy-prefix engine snapshot instead of replaying "
                 "the step from t=0.",
     },
+    "fleet_jobs_total": {
+        "type": "counter",
+        "help": "Fleet-simulation job events, by event (admitted/"
+                "queued/resumed/preempted/reclaimed/reshaped/"
+                "restarted/frozen/completed/starved).",
+    },
+    "fleet_template_ctx_total": {
+        "type": "counter",
+        "help": "Fleet job costings by template replay-context fate: "
+                "kind=built paid a fresh healthy-step DES + replay "
+                "state, kind=shared reused another job's context — "
+                "the cross-job amortization the fleet bench gates.",
+    },
+    "fleet_slo_attainment": {
+        "type": "gauge",
+        "help": "Fraction of SLO-carrying jobs meeting their goodput "
+                "SLO in the most recent fleet trace walk.",
+    },
 }
 
 #: default bounded-reservoir size for histograms: big enough for stable

@@ -1293,6 +1293,27 @@ class PerfLLM(PerfBase):
 
         return analyze_faults(self, **kwargs)
 
+    def rebatched_iter_time(self, micro_batch_num: int) -> float:
+        """Analytical iteration time (seconds) of this built layout
+        under a different micro-batch count, via the :meth:`rebatch`
+        fast path — the fleet simulator's elastic-reshape re-costing
+        (``fleet/sim.py``): after a dp shrink the surviving replicas
+        carry ``gbs / (dp_eff * mbs)`` microbatches each, and only the
+        schedule/memory analyses read ``micro_batch_num``, so the
+        shrunk step is re-costed without rebuilding the module tree.
+
+        Mutates this estimate's strategy (the caller owns a dedicated
+        costing estimate; the fleet's per-template runtime keeps one
+        beside the replay context's untouched estimate) and leaves it
+        re-estimated at ``micro_batch_num`` on return."""
+        from simumax_tpu.search.prune import clone_strategy
+
+        st = clone_strategy(self.strategy)
+        st.micro_batch_num = int(micro_batch_num)
+        st.__post_init__()
+        self.rebatch(st)
+        return self.analysis_cost()["iter_time"]
+
     def analysis_dualpp(self, save_path: Optional[str] = None):
         """Per-rank DualPipe projection of this estimate (even pp only):
         bidirectional schedule, 2 stage chunks per rank, pp+1 in-flight

@@ -38,6 +38,7 @@ from simumax_tpu.core.config import (
     StrategyConfig,
     SystemConfig,
 )
+from simumax_tpu.core.errors import FeasibilityError
 
 #: headroom on the closed-form parameter bound: prune only when the
 #: floor exceeds usable HBM by >10%, so modest accounting skew between
@@ -72,6 +73,53 @@ def clone_strategy(st: StrategyConfig) -> StrategyConfig:
     if st.megatron_recompute_modules is not None:
         new.megatron_recompute_modules = list(st.megatron_recompute_modules)
     new.__post_init__()
+    return new
+
+
+def shrink_strategy(st: StrategyConfig, replicas: int) -> StrategyConfig:
+    """The dp-shrunk twin of ``st`` after losing ``replicas``
+    data-parallel replicas to spot reclaim / rank death — the fleet
+    simulator's elastic-reshape target layout (``fleet/sim.py``,
+    docs/fleet.md). The layout shape (tp/cp/ep/pp) is unchanged;
+    ``world_size`` drops by one replica's chips
+    (``tp * cp * pp`` each) and ``micro_batch_num`` grows so the
+    global batch is preserved across the survivors.
+
+    Raises :class:`FeasibilityError` when the shrink is not
+    well-formed: fewer replicas than lost, or a global batch that the
+    surviving replicas cannot split evenly (the walk then falls back
+    to rollback-restart accounting). Pair with
+    :func:`memory_lower_bound` — the shrunk layout re-shards ZeRO
+    state over fewer replicas, so it must also still fit HBM."""
+    replicas = int(replicas)
+    if replicas < 1:
+        raise FeasibilityError(
+            f"shrink_strategy: replicas must be >= 1, got {replicas}",
+            phase="fleet",
+        )
+    dp_eff = st.dp_size - replicas
+    if dp_eff < 1:
+        raise FeasibilityError(
+            f"cannot shrink dp {st.dp_size} by {replicas} replicas: "
+            f"no survivors",
+            phase="fleet", dp=st.dp_size, replicas=replicas,
+        )
+    gbs = st.global_batch_size
+    if gbs % (dp_eff * st.micro_batch_size) != 0:
+        raise FeasibilityError(
+            f"global batch {gbs} does not split over {dp_eff} "
+            f"surviving replicas at micro_batch_size "
+            f"{st.micro_batch_size}",
+            phase="fleet", gbs=gbs, dp_eff=dp_eff,
+        )
+    new = clone_strategy(st)
+    new.world_size = (
+        st.world_size
+        - replicas * st.tp_size * st.cp_size * st.pp_size
+    )
+    new.micro_batch_num = gbs // (dp_eff * st.micro_batch_size)
+    new.__post_init__()
+    new.sanity_check()
     return new
 
 

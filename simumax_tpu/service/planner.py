@@ -512,6 +512,58 @@ class Planner:
                              "key": key, "deps": deps}
         return payload
 
+    def fleet(self, trace, jobs: int = 0,
+              elastic: Optional[bool] = None,
+              with_meta: bool = False, raw: bool = False):
+        """Multi-job fleet-trace walk (``fleet/sim.py``,
+        docs/fleet.md): deterministic in the trace, hence cacheable
+        (namespace ``fleet``). Template configs resolve through the
+        loader so an edited registry config or recalibration
+        invalidates the key; ``jobs`` (costing fan-out) is a serving
+        detail and never part of the identity — serial and parallel
+        walks are bit-identical by the fleet contract."""
+        import copy as _copy
+
+        from simumax_tpu.fleet.trace import FleetTrace
+
+        # deep copy: FleetTrace.load passes FleetTrace objects
+        # through, and the template refs below are replaced with
+        # loaded configs — the caller's object (and the identity of
+        # its repeat queries) must stay untouched
+        tr = _copy.deepcopy(FleetTrace.load(trace))
+        trace_dict = tr.to_dict()
+        deps: list = []
+        resolved: Dict[str, Any] = {}
+        for name in sorted(tr.templates):
+            t = tr.templates[name]
+            m = self._loader.load("model", t.model, deps=deps)
+            st = self._loader.load("strategy", t.strategy, deps=deps)
+            sysc = self._loader.load("system", t.system, deps=deps)
+            resolved[name] = {
+                "model": m.to_dict(),
+                "strategy": st.to_dict(),
+                "system": sysc.to_dict(),
+            }
+            # the walk consumes the loaded objects (template
+            # ``overrides`` still apply on top at build time)
+            t.model, t.strategy, t.system = m, st, sysc
+        identity = query_identity(
+            "fleet", trace=canonical(trace_dict),
+            templates=resolved, elastic=elastic,
+        )
+
+        def compute():
+            from simumax_tpu.fleet.sim import simulate_fleet
+
+            return simulate_fleet(tr, jobs=jobs, elastic=elastic)
+
+        payload, hit, key = self._cached("fleet", identity, compute,
+                                         raw=raw)
+        if with_meta:
+            return payload, {"cache": "hit" if hit else "miss",
+                             "key": key, "deps": deps}
+        return payload
+
     def search(self, model, system, global_batch_size: int,
                base_strategy="tp1_pp1_dp8_mbs1", world: int = 0,
                seq_len: int = 0, tp_list=(1, 2, 4, 8),

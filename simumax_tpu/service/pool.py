@@ -152,6 +152,13 @@ def evaluate_query(planner, endpoint: str, q: dict
                 track_memory=bool(q.get("track_memory", False)),
                 with_meta=True, raw=True,
             )
+        elif endpoint == "/v1/fleet":
+            payload, meta = planner.fleet(
+                q["trace"],
+                jobs=int(q.get("jobs") or 0),
+                elastic=q.get("elastic"),
+                with_meta=True, raw=True,
+            )
         elif endpoint == "/v1/search":
             payload, meta = planner.search(
                 **search_kwargs(q), with_meta=True)

@@ -30,6 +30,9 @@ dicts):
                       completion order) then a final ``{"result": ...}``
 ``POST /v1/faults``   seeded Monte-Carlo goodput analysis
 ``POST /v1/simulate`` discrete-event replay summary
+``POST /v1/fleet``    multi-job fleet-trace walk (docs/fleet.md):
+                      fleet goodput, per-job SLO attainment, and the
+                      scheduler-decision timeline
 ====================  =====================================================
 
 Every response carries ``X-SimuMax-Cache: hit|miss`` (+ the
@@ -412,6 +415,7 @@ class _Handler(BaseHTTPRequestHandler):
     #: client-controlled, and the registry never evicts, so unique
     #: paths would mint unbounded instruments and /metrics series
     KNOWN_ENDPOINTS = frozenset({
+        "/v1/fleet",
         "/healthz", "/stats", "/metrics",
         "/v1/estimate", "/v1/explain", "/v1/faults",
         "/v1/simulate", "/v1/search",
@@ -495,7 +499,8 @@ class _Handler(BaseHTTPRequestHandler):
     #: stays off it (a parsed body is needed for the stream check and
     #: the warm offer).
     FAST_PATH_ENDPOINTS = ("/v1/estimate", "/v1/explain",
-                           "/v1/faults", "/v1/simulate")
+                           "/v1/faults", "/v1/simulate",
+                           "/v1/fleet")
 
     # -- the pooled serving fast lane --------------------------------------
     # Part of the --workers serving rebuild: siege-level traffic is
@@ -869,6 +874,14 @@ class _Handler(BaseHTTPRequestHandler):
                 q["model"], q["strategy"], q["system"],
                 granularity=q.get("granularity", "chunk"),
                 track_memory=bool(q.get("track_memory", False)),
+                with_meta=True, raw=True,
+            )
+            self._send_json(200, payload, meta)
+        elif endpoint == "/v1/fleet":
+            payload, meta = planner.fleet(
+                q["trace"],
+                jobs=int(q.get("jobs") or 0),
+                elastic=q.get("elastic"),
                 with_meta=True, raw=True,
             )
             self._send_json(200, payload, meta)

@@ -51,7 +51,8 @@ from simumax_tpu.core.errors import ConfigError
 
 #: known namespaces (directories under the root). Nothing enforces the
 #: set — it documents the layout and seeds `cache stats` rendering.
-NAMESPACES = ("estimate", "explain", "sweep", "profiles", "des")
+NAMESPACES = ("estimate", "explain", "sweep", "profiles", "des",
+              "fleet")
 
 #: default size budget: plenty for years of sweep cells, small enough
 #: to never matter on a dev machine

@@ -141,8 +141,14 @@ class _Rendezvous:
     #: completion time, computed once when the last peer arrives
     end: Optional[float] = None
     #: peers that served their completion — the rendezvous record is
-    #: deleted when every peer consumed it (bounded-memory contract)
-    consumed: int = 0
+    #: deleted when every live peer consumed it (bounded-memory
+    #: contract). A SET, not a count: a peer that consumed and *then*
+    #: died must not be double-counted against the live quota, or the
+    #: record is deleted while a live straggler still needs it — the
+    #: straggler then re-creates the rendezvous at the same seq and
+    #: deadlocks (found by the fleet walk's death-during-optimizer
+    #: suspension pattern, pinned in tests/test_fleet.py)
+    consumed: "set" = field(default_factory=set)
     #: op name, retained so a deferred completion (a dead peer resolved
     #: by the fault model) can still emit a labelled trace span
     name: str = ""
@@ -539,7 +545,8 @@ class SimuEngine:
         def rv_copy(rv: _Rendezvous) -> _Rendezvous:
             return _Rendezvous(
                 peers=rv.peers, arrivals=dict(rv.arrivals),
-                duration=rv.duration, end=rv.end, consumed=rv.consumed,
+                duration=rv.duration, end=rv.end,
+                consumed=set(rv.consumed),
                 name=rv.name, fault_extra=rv.fault_extra,
             )
 
@@ -894,10 +901,19 @@ class SimuEngine:
             self._emit_ev(rank, "comm", name, start, end, kind="comm")
             self.clock[rank] = end
             self._coll_seq[(key, rank)] = seq + 1
-            rv.consumed += 1
-            live = len(rv.peers) if fault is None or not self.deaths \
-                else sum(1 for p in rv.peers if not self._dead[p])
-            if rv.consumed >= live:
+            rv.consumed.add(rank)
+            done_rv = len(rv.consumed) >= len(rv.peers)
+            if not done_rv and fault is not None and self.deaths:
+                # every peer either consumed or died: a dead peer that
+                # consumed BEFORE dying is already in the set, so a
+                # live straggler can never be counted out (deleting
+                # early would re-create the rendezvous at this seq and
+                # deadlock the straggler)
+                done_rv = all(
+                    p in rv.consumed or self._dead[p]
+                    for p in rv.peers
+                )
+            if done_rv:
                 del self._collectives[ckey]
                 if self._rec is not None:
                     self._rec.on_coll_done(ckey)

@@ -65,6 +65,7 @@ import math
 import os
 import random
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -99,6 +100,13 @@ class FaultEvent:
     * ``rank_death`` — ``rank`` dies at ``start_ms`` and never
       returns; the job must restart from the last checkpoint
       (:func:`predict_goodput` accounts the restart).
+
+    ``slowdown`` / ``preemption`` / ``rank_death`` may target a
+    ``ranks`` *list* instead of a single ``rank`` — exactly equivalent
+    to (and bit-identical with) one single-rank event per listed rank,
+    but O(ranks) cheaper to window and replay. The fleet simulator
+    leans on this: a maintenance window freezing a 128-chip pod is one
+    event, not 128 (``fleet/sim.py``).
     """
 
     kind: str
@@ -116,6 +124,18 @@ class FaultEvent:
         if self.duration_ms is None:
             return math.inf
         return self.start_ms + self.duration_ms
+
+    def targets(self) -> Tuple[int, ...]:
+        """The perturbed ranks: ``rank`` or the ``ranks`` list (for
+        ``link_degradation`` the list is a *scope*, not a target —
+        this returns () there)."""
+        if self.kind == "link_degradation":
+            return ()
+        if self.rank is not None:
+            return (self.rank,)
+        if self.ranks is not None:
+            return tuple(self.ranks)
+        return ()
 
     def validate(self, world_size: Optional[int] = None) -> "FaultEvent":
         def bad(msg):
@@ -135,10 +155,16 @@ class FaultEvent:
         ):
             bad("duration_ms must be a finite positive number")
         if self.kind in ("slowdown", "preemption", "rank_death"):
-            if self.rank is None:
-                bad("needs a target rank")
-            if world_size is not None and not 0 <= self.rank < world_size:
-                bad(f"rank {self.rank} outside world [0, {world_size})")
+            if self.rank is None and not self.ranks:
+                bad("needs a target rank (or a ranks list)")
+            if self.rank is not None and self.ranks is not None:
+                bad("rank and ranks are mutually exclusive")
+            if world_size is not None:
+                oob = [r for r in self.targets()
+                       if not 0 <= r < world_size]
+                if oob:
+                    bad(f"rank {oob[0]} outside world "
+                        f"[0, {world_size})")
         if self.kind == "preemption" and self.duration_ms is None:
             bad("preemption needs a finite duration_ms")
         if self.kind in ("slowdown", "link_degradation"):
@@ -275,6 +301,8 @@ class FaultScenario:
                     out.append(FaultEvent(
                         "rank_death", start_ms=ev.start_ms - offset_ms,
                         rank=ev.rank,
+                        ranks=list(ev.ranks)
+                        if ev.ranks is not None else None,
                     ))
                 continue
             if ev.end_ms <= offset_ms or ev.start_ms >= offset_ms + span_ms:
@@ -306,10 +334,8 @@ class FaultScenario:
         perturb every group of a dim identically and shatter nothing."""
         sigs: Dict[int, List[tuple]] = {}
         for ev in self.events:
-            targets: Sequence[int] = ()
-            if ev.rank is not None:
-                targets = (ev.rank,)
-            elif ev.kind == "link_degradation" and ev.ranks is not None:
+            targets: Sequence[int] = ev.targets()
+            if ev.kind == "link_degradation" and ev.ranks is not None:
                 targets = ev.ranks
             for r in targets:
                 sigs.setdefault(r, []).append(ev.signature())
@@ -369,18 +395,24 @@ class StepFaultModel:
                     # gate proves such events delay nothing and must
                     # agree with the engine to the bit)
                     continue
-                self._slow.setdefault(ev.rank, []).append(
-                    (s, e, ev.multiplier)
-                )
+                for r in ev.targets():
+                    self._slow.setdefault(r, []).append(
+                        (s, e, ev.multiplier)
+                    )
             elif ev.kind == "preemption":
-                self._slow.setdefault(ev.rank, []).append((s, e, math.inf))
+                for r in ev.targets():
+                    self._slow.setdefault(r, []).append(
+                        (s, e, math.inf)
+                    )
             elif ev.kind == "link_degradation":
                 scope = (frozenset(ev.ranks)
                          if ev.ranks is not None else None)
                 self._links.append((ev.dim, s, e, ev.multiplier, scope))
             elif ev.kind == "rank_death":
-                prev = self._deaths.get(ev.rank)
-                self._deaths[ev.rank] = s if prev is None else min(prev, s)
+                for r in ev.targets():
+                    prev = self._deaths.get(r)
+                    self._deaths[r] = s if prev is None \
+                        else min(prev, s)
         for wins in self._slow.values():
             wins.sort()
 
@@ -788,6 +820,15 @@ class ReplayContext:
         #: pure function of stage + rendezvous structure)
         self._stage_sources: Dict[int, Tuple[list, Any, int]] = {}
         self._cache: Dict[tuple, Tuple[float, Optional[float]]] = {}
+        #: id -> weakref of scenarios already validated against this
+        #: estimate's world — the fleet walk re-costs one scenario
+        #: object many times against a shared context, and validation
+        #: is O(events)/call. (id-keyed because dataclass equality
+        #: makes FaultScenario unhashable; the weakref guards against
+        #: id reuse after collection.)
+        self._validated: Dict[int, Any] = {}
+        #: checkpoint-override dict -> resolved CheckpointSpec
+        self._specs: Dict[Optional[tuple], CheckpointSpec] = {}
         #: clamped / canonical entries additionally carry the realized
         #: raw end (`raw_limit`) their open-ended windows must cover
         self._clamped: Dict[tuple, Tuple[float, Optional[float],
@@ -795,6 +836,39 @@ class ReplayContext:
         self._canon: Dict[tuple, Tuple[float, Optional[float],
                                        float]] = {}
         self._ckpt: Dict[tuple, CheckpointCostModel] = {}
+
+    # -- hoisted per-call prologue (satellite of ISSUE 15) -----------------
+    def validate_scenario(self, scenario: FaultScenario):
+        """``scenario.validate(world_size)`` hoisted to once per
+        scenario *object* per context. Scenarios are immutable once
+        handed to a prediction (the step cache already keys on event
+        identity), so re-validating the same object on every
+        ``predict_goodput`` call — thousands of times per template in
+        the fleet walk — only re-pays an O(events) walk. The
+        single-call path (no shared context) still validates every
+        time, unchanged."""
+        key = id(scenario)
+        ref = self._validated.get(key)
+        if ref is not None and ref() is scenario:
+            return
+        scenario.validate(self.perf.strategy.world_size)
+        self._validated[key] = weakref.ref(
+            scenario,
+            lambda _r, k=key, m=self._validated: m.pop(k, None),
+        )
+
+    def resolve_spec(self, scenario: FaultScenario) -> CheckpointSpec:
+        """``CheckpointSpec.from_overrides(scenario.checkpoint)``
+        memoized on the override values — byte-identical resolution,
+        one dataclass build per distinct override set instead of one
+        per call."""
+        ck = scenario.checkpoint
+        key = tuple(sorted(ck.items())) if ck else None
+        spec = self._specs.get(key)
+        if spec is None:
+            spec = CheckpointSpec.from_overrides(ck)
+            self._specs[key] = spec
+        return spec
 
     # -- memoized healthy step + checkpoint chain --------------------------
     def healthy(self) -> dict:
@@ -938,12 +1012,13 @@ class ReplayContext:
             if ev.kind == "link_degradation":
                 link_events.append((ev.dim, ev.multiplier, s, e))
                 continue
-            entry = by_rank.setdefault(ev.rank, [1.0, [], False])
-            entry[1].append((s, e))
-            if ev.kind == "preemption":
-                entry[2] = True
-            else:
-                entry[0] *= ev.multiplier
+            for r in ev.targets():
+                entry = by_rank.setdefault(r, [1.0, [], False])
+                entry[1].append((s, e))
+                if ev.kind == "preemption":
+                    entry[2] = True
+                else:
+                    entry[0] *= ev.multiplier
 
         def _link_mult_wins(key):
             m, wins = 1.0, []
@@ -1115,7 +1190,8 @@ class ReplayContext:
         by_rank: Dict[int, List[tuple]] = {}
         for sig, ev in zip(sigs, sub.events):
             if ev.kind != "link_degradation":
-                by_rank.setdefault(ev.rank, []).append(sig)
+                for r in ev.targets():
+                    by_rank.setdefault(r, []).append(sig)
         rank_events = [
             tuple(sorted(by_rank.get(reps[i], ()), key=repr))
             for i in range(k)
@@ -1557,7 +1633,6 @@ def predict_goodput(
     ``options`` tunes the individual optimizations; ``_ctx`` shares
     one replay context across calls (``analyze_faults`` does).
     """
-    scenario.validate(perf.strategy.world_size)
     from simumax_tpu.observe.telemetry import get_registry, get_tracer
 
     ctx = _ctx
@@ -1572,11 +1647,19 @@ def predict_goodput(
             "different estimate",
             phase="simulate",
         )
+    # validation + checkpoint-spec resolution hoist once per shared
+    # context (the fleet walk re-costs a scenario thousands of times);
+    # without a context both run per call, behaviorally identical
+    if ctx is not None:
+        ctx.validate_scenario(scenario)
+    else:
+        scenario.validate(perf.strategy.world_size)
     # an explicitly passed spec wins outright (a CLI flag must beat
     # the scenario's bundled default, not the other way round); the
     # scenario's "checkpoint" block only fills in when none is given
     if spec is None:
-        spec = CheckpointSpec.from_overrides(scenario.checkpoint)
+        spec = (ctx.resolve_spec(scenario) if ctx is not None
+                else CheckpointSpec.from_overrides(scenario.checkpoint))
     with get_tracer().span("predict_goodput",
                            events=len(scenario.events),
                            horizon=scenario.horizon_steps,
@@ -1901,15 +1984,21 @@ def analyze_faults(
                  for i, s in enumerate(scenarios)],
             )
         elif pending:
+            # one spec per interval, shared across scenarios (the
+            # per-(scenario, interval) rebuild was pure duplication)
+            k_specs = {
+                k: CheckpointSpec(
+                    interval_steps=int(k),
+                    restart_overhead_s=spec.restart_overhead_s,
+                    write_gbps=spec.write_gbps,
+                    read_gbps=spec.read_gbps,
+                )
+                for k in pending
+            }
             for i, s in enumerate(scenarios):
                 per: Dict[int, float] = {}
                 for k in pending:
-                    k_spec = CheckpointSpec(
-                        interval_steps=int(k),
-                        restart_overhead_s=spec.restart_overhead_s,
-                        write_gbps=spec.write_gbps,
-                        read_gbps=spec.read_gbps,
-                    )
+                    k_spec = k_specs[k]
                     with _deadline(scenario_timeout,
                                    f"scenario[{i}]@interval{k}"):
                         per[int(k)] = predict_goodput(

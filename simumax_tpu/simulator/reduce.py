@@ -146,6 +146,18 @@ def canonical_class_order(plan: ReductionPlan,
     return sorted(range(k), key=lambda i: (out[i], i))
 
 
+def orbit_of(plan: ReductionPlan, rank: int) -> int:
+    """The symmetry-orbit (class) index of a global rank under a
+    reduction plan. The fleet scheduler annotates placement decisions
+    with the orbits its fault events land in: two events whose target
+    ranks share an orbit of the *healthy* plan are the same abstract
+    event up to relabeling, so the fault-replay step cache answers the
+    second from the first's replay (``faults.ReplayContext``'s
+    canonical keying) — the cross-job amortization the fleet bench
+    measures."""
+    return plan.class_of[rank]
+
+
 def reduction_structure(st) -> tuple:
     """The world's relational structure — group memberships, pipeline
     stages and neighbours — computed once and reusable across

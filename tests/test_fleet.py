@@ -1,0 +1,595 @@
+"""Fleet-simulation tests (ISSUE 15): trace schema validation,
+multi-rank fault events, the engine's consumed-set regression, fleet
+determinism/equivalence oracles (serial == parallel, one-job ==
+predict_goodput, shared == naive, reshape-off == rollback-restart),
+orbit-cache liveness, SLO/bucket accounting, the planner/server/CLI
+surfaces, and the new prune/perf/reduce helpers."""
+
+import copy
+import http.client
+import json
+import threading
+
+import pytest
+
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+)
+from simumax_tpu.core.errors import ConfigError, FeasibilityError
+from simumax_tpu.fleet import (
+    FleetSimulator,
+    FleetTrace,
+    fleet_report_lines,
+    simulate_fleet,
+)
+from simumax_tpu.perf import PerfLLM
+from simumax_tpu.simulator.faults import (
+    FaultEvent,
+    FaultScenario,
+    ReplayContext,
+    predict_goodput,
+)
+
+TOL = 1e-6
+
+
+def tiny_perf(world=16, mbc=8, tp=1, pp=2):
+    st = get_strategy_config("tp1_pp2_dp4_mbs1")
+    st.tp_size = tp
+    st.pp_size = pp
+    st.world_size = world
+    st.micro_batch_num = mbc
+    st.__post_init__()
+    p = PerfLLM().configure(st, "llama2-tiny", "tpu_v5e_256")
+    p.run_estimate()
+    return p
+
+
+def tiny_template(world=16, mbc=8):
+    return {
+        "model": "llama2-tiny", "strategy": "tp1_pp2_dp4_mbs1",
+        "system": "tpu_v5e_256", "granularity": "chunk",
+        "overrides": {"strategy": {"world_size": world,
+                                   "micro_batch_num": mbc}},
+    }
+
+
+def base_trace(**fleet_extra):
+    fleet = {
+        "pods": [{"name": "p0", "chips": 16},
+                 {"name": "p1", "chips": 16}],
+        "scheduler": {"policy": "fifo"},
+    }
+    fleet.update(fleet_extra)
+    return {
+        "schema": "simumax-fleet-trace-v1",
+        "fleet": fleet,
+        "templates": {"t": tiny_template()},
+        "jobs": [
+            {"name": "a", "template": "t", "horizon_steps": 30,
+             "slo_goodput": 0.9,
+             "checkpoint": {"interval_steps": 10}},
+            {"name": "b", "template": "t", "arrival_s": 0.5,
+             "horizon_steps": 30, "slo_goodput": 0.5},
+        ],
+    }
+
+
+# --------------------------------------------------------------------------
+# Trace schema
+# --------------------------------------------------------------------------
+
+
+class TestTraceSchema:
+    def test_round_trip(self, tmp_path):
+        tr = FleetTrace.load(base_trace(
+            maintenance=[{"pod": "p1", "start_s": 2.0,
+                          "duration_s": 1.0}],
+            link_degradations=[{"pod": "p0", "dim": "tp",
+                                "multiplier": 2.0, "start_s": 1.0,
+                                "duration_s": 3.0}],
+            spot_reclaims=[{"pod": "p0", "start_s": 5.0,
+                            "chips": 4}],
+        ))
+        path = tmp_path / "trace.json"
+        tr.save(str(path))
+        back = FleetTrace.load(str(path))
+        assert back.to_dict() == tr.to_dict()
+
+    @pytest.mark.parametrize(
+        "mutate,match",
+        [
+            (lambda d: d["fleet"].pop("pods"), "at least one pod"),
+            (lambda d: d["fleet"]["pods"].append(
+                {"name": "p0", "chips": 8}), "duplicate pod"),
+            (lambda d: d["fleet"].update(scheduler={
+                "policy": "lottery"}), "policy"),
+            (lambda d: d["jobs"].__setitem__(
+                0, dict(d["jobs"][0], template="nope")),
+             "unknown template"),
+            (lambda d: d["jobs"].append(dict(d["jobs"][0])),
+             "duplicate job"),
+            (lambda d: d["jobs"].__setitem__(
+                0, dict(d["jobs"][0], slo_goodput=1.5)),
+             "slo_goodput"),
+            (lambda d: d["fleet"].update(scheduler={
+                "policy": "fifo", "frobnicate": 1}),
+             "unknown scheduler"),
+            (lambda d: d["fleet"].update(maintenance=[
+                {"pod": "p9", "start_s": 0.0, "duration_s": 1.0}]),
+             "unknown pod"),
+        ],
+    )
+    def test_validation_rejects(self, mutate, match):
+        d = base_trace()
+        mutate(d)
+        with pytest.raises(ConfigError, match=match):
+            FleetTrace.load(d)
+
+    def test_priority_names(self):
+        d = base_trace()
+        d["jobs"][0]["priority"] = "high"
+        d["jobs"][1]["priority"] = 0
+        tr = FleetTrace.load(d)
+        assert tr.jobs[0].priority == 2
+        assert tr.jobs[1].priority == 0
+
+    def test_spot_process_deterministic(self):
+        d = base_trace()
+        d["fleet"]["spot"] = {"rate_per_hour": 600.0,
+                              "horizon_s": 120.0, "chips": 4,
+                              "seed": 7}
+        a = FleetTrace.load(copy.deepcopy(d)).fleet.materialize_spot()
+        b = FleetTrace.load(copy.deepcopy(d)).fleet.materialize_spot()
+        assert [r.to_dict() for r in a] == [r.to_dict() for r in b]
+        assert a and all(0 <= r.start_s < 120.0 for r in a)
+        assert a == sorted(a, key=lambda r: (r.start_s, r.pod,
+                                             r.chips))
+
+
+# --------------------------------------------------------------------------
+# Multi-rank fault events (faults.py ranks-list extension)
+# --------------------------------------------------------------------------
+
+
+class TestMultiRankEvents:
+    @pytest.fixture(scope="class")
+    def perf(self):
+        return tiny_perf()
+
+    def test_ranks_list_bit_identical_to_expansion(self, perf):
+        multi = FaultScenario(events=[
+            FaultEvent("preemption", start_ms=100.0,
+                       duration_ms=300.0, ranks=[4, 5, 6, 7]),
+            FaultEvent("slowdown", start_ms=800.0, duration_ms=200.0,
+                       ranks=[0, 8], multiplier=2.0),
+        ], horizon_steps=16, checkpoint={"interval_steps": 8})
+        single = FaultScenario(events=(
+            [FaultEvent("preemption", start_ms=100.0,
+                        duration_ms=300.0, rank=r)
+             for r in (4, 5, 6, 7)]
+            + [FaultEvent("slowdown", start_ms=800.0,
+                          duration_ms=200.0, rank=r, multiplier=2.0)
+               for r in (0, 8)]
+        ), horizon_steps=16, checkpoint={"interval_steps": 8})
+        rm = predict_goodput(perf, multi)
+        rs = predict_goodput(perf, single)
+        assert rm.to_dict() == rs.to_dict()
+        exact = predict_goodput(perf, copy.deepcopy(multi),
+                                incremental=False)
+        assert rm.to_dict() == exact.to_dict()
+
+    def test_ranks_list_death(self, perf):
+        multi = FaultScenario(
+            events=[FaultEvent("rank_death", start_ms=500.0,
+                               ranks=[3, 9])], horizon_steps=8)
+        single = FaultScenario(
+            events=[FaultEvent("rank_death", start_ms=500.0, rank=3),
+                    FaultEvent("rank_death", start_ms=500.0,
+                               rank=9)], horizon_steps=8)
+        assert predict_goodput(perf, multi).to_dict() \
+            == predict_goodput(perf, single).to_dict()
+
+    @pytest.mark.parametrize(
+        "event,match",
+        [
+            (FaultEvent("preemption", duration_ms=1.0),
+             "target rank"),
+            (FaultEvent("preemption", duration_ms=1.0, rank=0,
+                        ranks=[1]), "mutually exclusive"),
+            (FaultEvent("slowdown", duration_ms=1.0, ranks=[3, 99],
+                        multiplier=2.0), "outside world"),
+        ],
+    )
+    def test_ranks_validation(self, event, match):
+        with pytest.raises(ConfigError, match=match):
+            FaultScenario([event]).validate(16)
+
+    def test_consumed_set_death_regression(self):
+        """The fleet walk's suspension pattern — a rank death at the
+        instant an all-rank freeze starts, landing in the optimizer
+        tail where some peers have consumed a rendezvous the dying
+        rank also consumed — used to delete the rendezvous record
+        while a live straggler still needed it (the old count-based
+        ``consumed >= live`` check), deadlocking the straggler on a
+        recreated rendezvous at the same seq. Pinned: the exact and
+        incremental paths complete and agree to the bit."""
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.tp_size = 2
+        st.pp_size = 2
+        st.world_size = 64
+        st.micro_batch_num = 8
+        st.__post_init__()
+        m = get_model_config("deepseekv2-lite")
+        m = copy.deepcopy(m)
+        m.layer_num = 8
+        p = PerfLLM().configure(st, m, "tpu_v5e_256")
+        p.run_estimate()
+        T = 7246.954394342879
+        sc = FaultScenario(events=[
+            FaultEvent("preemption", start_ms=T,
+                       duration_ms=42802.57834004669,
+                       ranks=list(range(64))),
+            FaultEvent("rank_death", start_ms=T, rank=0),
+        ], horizon_steps=50, checkpoint={"interval_steps": 20})
+        inc = predict_goodput(p, copy.deepcopy(sc),
+                              granularity="leaf")
+        exact = predict_goodput(p, copy.deepcopy(sc),
+                                granularity="leaf",
+                                incremental=False)
+        assert inc.to_dict() == exact.to_dict()
+        assert inc.n_restarts >= 1
+
+    def test_validate_hoist_and_spec_memo(self):
+        perf = tiny_perf()
+        ctx = ReplayContext(perf)
+        bad = FaultScenario(events=[
+            FaultEvent("preemption", start_ms=1.0, duration_ms=1.0,
+                       rank=99)], horizon_steps=4)
+        with pytest.raises(ConfigError, match="outside world"):
+            predict_goodput(perf, bad, _ctx=ctx)
+        s1 = FaultScenario(events=[], horizon_steps=4,
+                           checkpoint={"interval_steps": 2})
+        s2 = FaultScenario(events=[], horizon_steps=8,
+                           checkpoint={"interval_steps": 2})
+        # same override values -> one memoized CheckpointSpec
+        assert ctx.resolve_spec(s1) is ctx.resolve_spec(s2)
+        assert ctx.resolve_spec(s1).interval_steps == 2
+
+
+# --------------------------------------------------------------------------
+# Fleet walk equivalences
+# --------------------------------------------------------------------------
+
+
+def churn_trace():
+    """Two pods, maintenance + reclaim + priority preemption: every
+    scheduler path fires, with a gbs that shrinks divisibly (48 over
+    6 survivors) so elastic mode reshapes."""
+    d = base_trace(
+        maintenance=[{"pod": "p1", "start_s": 2.0,
+                      "duration_s": 1.0}],
+        link_degradations=[{"pod": "p0", "dim": "pp",
+                            "multiplier": 1.5, "start_s": 1.0,
+                            "duration_s": 2.0}],
+        spot_reclaims=[{"pod": "p0", "start_s": 1.0, "chips": 4}],
+        scheduler={"policy": "priority", "elastic": True,
+                   "reshape_overhead_s": 5.0},
+    )
+    d["templates"]["t"] = tiny_template(mbc=6)
+    d["jobs"] = [
+        {"name": "a", "template": "t", "horizon_steps": 60,
+         "priority": "normal", "spot": True, "slo_goodput": 0.8,
+         "checkpoint": {"interval_steps": 20}},
+        {"name": "b", "template": "t", "arrival_s": 0.5,
+         "horizon_steps": 40, "priority": "low", "spot": True,
+         "slo_goodput": 0.5},
+        {"name": "hi", "template": "t", "arrival_s": 1.5,
+         "horizon_steps": 15, "priority": "high",
+         "slo_goodput": 0.9, "checkpoint": {"interval_steps": 5}},
+    ]
+    return d
+
+
+class TestFleetEquivalence:
+    def test_one_job_equals_predict_goodput(self):
+        d = base_trace()
+        d["jobs"] = [d["jobs"][0]]
+        rep = simulate_fleet(d)
+        perf = tiny_perf()
+        direct = perf.predict_goodput(FaultScenario(
+            events=[], horizon_steps=30,
+            checkpoint={"interval_steps": 10},
+        ))
+        assert rep["jobs"][0]["report"] == direct.to_dict()
+        assert rep["n_jobs"] == 1
+        assert rep["jobs"][0]["slo_attained"] \
+            == (direct.goodput >= 0.9)
+
+    def test_shared_equals_naive_bit_for_bit(self):
+        d = churn_trace()
+        shared = simulate_fleet(copy.deepcopy(d), elastic=False)
+        naive = simulate_fleet(copy.deepcopy(d), elastic=False,
+                               naive=True)
+        assert shared == naive
+
+    def test_serial_equals_parallel_bit_for_bit(self):
+        d = churn_trace()
+        serial = simulate_fleet(copy.deepcopy(d), elastic=False)
+        parallel = simulate_fleet(copy.deepcopy(d), elastic=False,
+                                  jobs=2)
+        assert serial == parallel
+
+    def test_reshape_off_is_rollback_restart(self):
+        d = churn_trace()
+        el = simulate_fleet(copy.deepcopy(d))
+        rb = simulate_fleet(copy.deepcopy(d), elastic=False)
+        # whichever spot job the reclaim hit: it reshaped under the
+        # elastic policy, so the same job restarts without it
+        el_a = next(j for j in el["jobs"] if j["reshapes"] >= 1)
+        rb_a = next(j for j in rb["jobs"]
+                    if j["name"] == el_a["name"])
+        # elastic: the reclaim shrinks a's dp — no rollback, reshape
+        # bucket charged, committed steps kept
+        assert el_a["reshapes"] >= 1
+        assert el_a["report"]["n_restarts"] == 0
+        assert el_a["report"]["buckets"]["reshape"] > 0.0
+        assert el_a["chips_final"] < el_a["chips"]
+        # rollback-restart: same reclaim kills + restarts from the
+        # last checkpoint instead
+        assert rb_a["reshapes"] == 0
+        assert rb_a["report"]["n_restarts"] >= 1
+        assert rb_a["report"]["buckets"]["reshape"] == 0.0
+
+    def test_buckets_sum_to_wall(self):
+        for elastic in (True, False):
+            rep = simulate_fleet(copy.deepcopy(churn_trace()),
+                                 elastic=elastic)
+            for j in rep["jobs"]:
+                if j["report"] is None:
+                    continue
+                b = j["report"]["buckets"]
+                assert abs(sum(b.values())
+                           - j["report"]["wall_time_s"]) < TOL, \
+                    (elastic, j["name"])
+
+    def test_priority_preemption_timeline(self):
+        rep = simulate_fleet(churn_trace(), elastic=False)
+        events = [d["event"] for d in rep["decisions"]]
+        assert "preempted" in events
+        assert "resumed" in events
+        victim = next(j for j in rep["jobs"]
+                      if j["suspensions"] >= 1)
+        assert victim["report"]["wall_time_s"] > 0
+
+    def test_slo_accounting(self):
+        rep = simulate_fleet(churn_trace(), elastic=False)
+        flags = [j["slo_attained"] for j in rep["jobs"]
+                 if "slo_attained" in j]
+        assert rep["slo"]["total"] == len(flags)
+        assert rep["slo"]["attained"] == sum(flags)
+        assert rep["slo"]["fraction"] == pytest.approx(
+            sum(flags) / len(flags))
+
+    def test_starved_job_reported(self):
+        d = base_trace()
+        # the fleet permanently loses chips before the only job that
+        # needs all of them can ever resume
+        d["fleet"]["pods"] = [{"name": "p0", "chips": 16}]
+        d["fleet"]["spot_reclaims"] = [
+            {"pod": "p0", "start_s": 0.1, "chips": 8}]
+        d["jobs"] = [dict(d["jobs"][0], spot=True)]
+        rep = simulate_fleet(d, elastic=False)
+        job = rep["jobs"][0]
+        assert job["state"] != "done"
+        assert any(x["event"] == "starved"
+                   for x in rep["decisions"])
+        assert rep["slo"]["attained"] == 0
+
+    def test_elastic_infeasible_falls_back(self):
+        d = churn_trace()
+        # gbs 64 does not split over 6 survivors: the reclaim cannot
+        # reshape and must take the kill path even with elastic on
+        d["templates"]["t"] = tiny_template(mbc=8)
+        rep = simulate_fleet(d)
+        events = [x["event"] for x in rep["decisions"]]
+        assert "reshaped" not in events
+        assert ("restarted" in events) or ("frozen" in events)
+
+    def test_naive_elastic_rejected(self):
+        with pytest.raises(ConfigError, match="naive"):
+            FleetSimulator(churn_trace(), naive=True)
+
+    def test_report_lines_render(self):
+        rep = simulate_fleet(churn_trace())
+        lines = fleet_report_lines(rep)
+        assert any("fleet goodput" in ln for ln in lines)
+        assert any("SLO" in ln for ln in lines)
+
+
+class TestOrbitCacheLiveness:
+    def test_placement_shifted_kill_shares_one_replay(self):
+        """Two same-template jobs killed at the same job-relative
+        instant on placement-shifted (symmetric) ranks: the second
+        job's death-step replays are answered from the first's via
+        the orbit-canonical step cache — zero new simulations."""
+        perf = tiny_perf(world=16, mbc=8)
+        ctx = ReplayContext(perf)
+        t_kill = 250.0
+
+        def job(rank):
+            return FaultScenario(
+                events=[FaultEvent("rank_death", start_ms=t_kill,
+                                   rank=rank)],
+                horizon_steps=12,
+                checkpoint={"interval_steps": 6})
+
+        # ranks 2 and 3 sit in symmetric dp replicas (same stage,
+        # same group roles) — verified against the healthy reduction
+        from simumax_tpu.simulator.reduce import (
+            build_reduction,
+            orbit_of,
+        )
+
+        plan = build_reduction(perf.strategy, {})
+        assert orbit_of(plan, 2) == orbit_of(plan, 3)
+        r1 = predict_goodput(perf, job(2), _ctx=ctx)
+        sims_after_first = ctx.stats["sims"]
+        canon_before = ctx.stats["canon_hits"]
+        r2 = predict_goodput(perf, job(3), _ctx=ctx)
+        assert ctx.stats["sims"] == sims_after_first
+        assert ctx.stats["canon_hits"] > canon_before
+        # symmetric placements: identical goodput decomposition
+        assert r1.to_dict() == r2.to_dict()
+
+    def test_fleet_decisions_annotate_orbits(self):
+        rep = simulate_fleet(churn_trace())
+        orbits = [d["orbit"] for d in rep["decisions"]
+                  if "orbit" in d]
+        assert orbits, "kill/reshape decisions carry orbit ids"
+
+
+# --------------------------------------------------------------------------
+# prune/perf/reduce helpers
+# --------------------------------------------------------------------------
+
+
+class TestReshapeHelpers:
+    def test_shrink_strategy(self):
+        from simumax_tpu.search.prune import shrink_strategy
+
+        st = get_strategy_config("tp1_pp2_dp4_mbs1")
+        st.world_size = 16
+        st.micro_batch_num = 6  # gbs 48 over dp 8
+        st.__post_init__()
+        shrunk = shrink_strategy(st, 2)  # dp 6 -> mbc 8
+        assert shrunk.world_size == 16 - 2 * (1 * 1 * 2)
+        assert shrunk.micro_batch_num == 8
+        assert shrunk.global_batch_size == st.global_batch_size
+        with pytest.raises(FeasibilityError, match="does not split"):
+            shrink_strategy(st, 1)  # dp 7: 48 % 7 != 0
+        with pytest.raises(FeasibilityError, match="no survivors"):
+            shrink_strategy(st, 8)
+
+    def test_rebatched_iter_time(self):
+        perf = tiny_perf(mbc=4)
+        base = perf.analysis_cost()["iter_time"]
+        doubled = perf.rebatched_iter_time(8)
+        assert doubled > base
+        assert perf.strategy.micro_batch_num == 8
+        assert perf.analysis_cost()["iter_time"] == doubled
+
+    def test_reshape_bucket_in_waterfall(self):
+        from simumax_tpu.observe.ledger import (
+            GOODPUT_WATERFALL_ORDER,
+            build_goodput_waterfall,
+        )
+
+        assert "reshape" in GOODPUT_WATERFALL_ORDER
+        # pre-reshape persisted reports (no "reshape" key) still render
+        legacy = {
+            "wall_time_s": 10.0, "goodput": 0.9,
+            "horizon_steps": 5, "n_restarts": 0, "n_checkpoints": 1,
+            "buckets": {k: 0.0 for k in GOODPUT_WATERFALL_ORDER
+                        if k != "reshape"},
+        }
+        wf = build_goodput_waterfall(legacy)
+        assert wf["buckets"]["reshape"] == 0.0
+
+
+# --------------------------------------------------------------------------
+# Service + CLI surfaces
+# --------------------------------------------------------------------------
+
+
+class TestFleetService:
+    def test_planner_fleet_cache(self, tmp_path):
+        from simumax_tpu.service.planner import Planner
+
+        planner = Planner(cache_dir=str(tmp_path / "store"))
+        d = base_trace()
+        p1, m1 = planner.fleet(copy.deepcopy(d), with_meta=True)
+        assert m1["cache"] == "miss"
+        p2, m2 = planner.fleet(copy.deepcopy(d), with_meta=True)
+        assert m2["cache"] == "hit" and m2["key"] == m1["key"]
+        assert p1 == p2
+        # worker fan-out is a serving detail, never part of the key
+        p3, m3 = planner.fleet(copy.deepcopy(d), jobs=2,
+                               with_meta=True)
+        assert m3["cache"] == "hit" and p3 == p1
+        # elastic changes results, hence the key
+        _p4, m4 = planner.fleet(copy.deepcopy(d), elastic=True,
+                                with_meta=True)
+        assert m4["key"] != m1["key"]
+
+    def test_server_endpoint(self, tmp_path):
+        from simumax_tpu.service.planner import Planner
+        from simumax_tpu.service.server import make_server
+
+        srv = make_server(
+            Planner(cache_dir=str(tmp_path / "srv-store")),
+            "127.0.0.1", 0)
+        thread = threading.Thread(target=srv.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+
+            def post(body):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=300)
+                conn.request("POST", "/v1/fleet", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                headers = dict(resp.getheaders())
+                conn.close()
+                return resp.status, headers, data
+
+            status, h1, d1 = post({"trace": base_trace()})
+            assert status == 200
+            assert h1["X-SimuMax-Cache"] == "miss"
+            rep = json.loads(d1)
+            assert rep["schema"] == "simumax-fleet-v1"
+            assert rep["n_jobs"] == 2
+            status, h2, d2 = post({"trace": base_trace()})
+            assert status == 200
+            assert h2["X-SimuMax-Cache"] == "hit"
+            assert d1 == d2
+            status, _h, data = post({"trace": {"schema": "nope"}})
+            assert status == 400 and "error" in json.loads(data)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_cli_fleet(self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        trace_path = tmp_path / "trace.json"
+        trace_path.write_text(json.dumps(base_trace()))
+        out_path = tmp_path / "report.json"
+        main(["fleet", "--trace", str(trace_path), "--no-cache",
+              "--json", str(out_path)])
+        out = capsys.readouterr().out
+        assert "fleet goodput" in out
+        report = json.loads(out_path.read_text())
+        assert report["schema"] == "simumax-fleet-v1"
+        assert len(report["jobs"]) == 2
+
+    def test_bench_fleet_smoke(self, tmp_path):
+        import bench_fleet
+
+        trace_path = tmp_path / "trace.json"
+        trace_path.write_text(json.dumps(base_trace()))
+        rc = bench_fleet.main(["--trace", str(trace_path),
+                               "--reps", "1"])
+        assert rc == 0
+
+    def test_fleet_metrics_cataloged(self):
+        from simumax_tpu.observe.telemetry import METRICS
+
+        assert METRICS["fleet_jobs_total"]["type"] == "counter"
+        assert METRICS["fleet_template_ctx_total"]["type"] \
+            == "counter"
+        assert METRICS["fleet_slo_attainment"]["type"] == "gauge"
