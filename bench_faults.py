@@ -65,7 +65,13 @@ from simumax_tpu.core.config import (
     get_system_config,
 )
 from simumax_tpu.perf import PerfLLM
-from simumax_tpu.simulator.faults import ReplayContext
+from simumax_tpu.simulator.faults import ReplayContext, ReplayOptions
+
+
+def _compile_cache_shapes() -> int:
+    from simumax_tpu.simulator.batched_replay import compile_cache_info
+
+    return compile_cache_info()["compiled_shapes"]
 
 
 def build_perf(world: int, mbc: int):
@@ -128,11 +134,26 @@ def main(argv=None):
              "the --max-regression margin (0 disables) — the ISSUE-14 "
              "10x acceptance gate",
     )
+    ap.add_argument(
+        "--replay-backend", default="auto",
+        choices=("numpy", "jax", "auto"),
+        help="miss-replay backend of the incremental run (ISSUE-17 "
+             "batched replay; the exact reference always walks the "
+             "scalar engine, so bit_identical doubles as the backend "
+             "oracle)",
+    )
+    ap.add_argument(
+        "--max-fallback-rate", type=float, default=0.0, metavar="FRAC",
+        help="fail when more than this fraction of miss replays fell "
+             "back to the scalar engine (0 disables; counted per "
+             "reason in the JSON line)",
+    )
     args = ap.parse_args(argv)
 
     perf = build_perf(args.world, args.mbc)
     kw = dict(n_scenarios=args.scenarios, seed=args.seed,
               horizon_steps=args.horizon)
+    options = ReplayOptions(replay_backend=args.replay_backend)
 
     exact = None
     exact_elapsed = None
@@ -141,7 +162,15 @@ def main(argv=None):
         exact = perf.analyze_faults(incremental=False, **kw)
         exact_elapsed = time.perf_counter() - t0
 
-    ctx = ReplayContext(perf)
+    if args.replay_backend != "numpy":
+        # untimed warmup: one throwaway analysis populates the padded-
+        # shape XLA compile cache (module-level, context-independent),
+        # so the timed run measures replay throughput, not tracing —
+        # the bench_fleet prepare() discipline
+        perf.analyze_faults(_ctx=ReplayContext(perf, options=options),
+                            **kw)
+
+    ctx = ReplayContext(perf, options=options)
     t0 = time.perf_counter()
     analysis = perf.analyze_faults(jobs=args.jobs, _ctx=ctx, **kw)
     elapsed = time.perf_counter() - t0
@@ -150,6 +179,12 @@ def main(argv=None):
     steps = max(1, stats["steps"])
     hits = (stats["cache_hits"] + stats["canon_hits"]
             + stats["clamp_hits"])
+    fallbacks = {
+        k[len("fallback_"):]: v
+        for k, v in sorted(stats.items())
+        if k.startswith("fallback_")
+    }
+    fb_total = sum(fallbacks.values())
     result = {
         "metric": "faults_scenarios_per_sec",
         "value": round(args.scenarios / elapsed, 3) if elapsed else 0.0,
@@ -166,8 +201,20 @@ def main(argv=None):
         "shortcircuit_rate": round(stats["shortcircuits"] / steps, 4),
         "prefix_forks": stats["forks"],
         "recordings": stats["recordings"],
+        "replay_backend": args.replay_backend,
+        "batched": stats.get("batched", 0),
+        "fallbacks": fallbacks,
+        "fallback_rate": round(
+            fb_total / max(1, stats.get("batched", 0) + fb_total), 4
+        ),
+        "compiled_shapes": _compile_cache_shapes(),
     }
     ok = True
+    if args.max_fallback_rate:
+        result["fallback_rate_ok"] = (
+            result["fallback_rate"] <= args.max_fallback_rate
+        )
+        ok = ok and result["fallback_rate_ok"]
     if exact is not None:
         result["exact_elapsed_s"] = round(exact_elapsed, 3)
         result["speedup"] = (
@@ -199,7 +246,8 @@ def main(argv=None):
                           ("n_scenarios", args.scenarios),
                           ("horizon", args.horizon),
                           ("mbc", args.mbc),
-                          ("jobs", args.jobs)):
+                          ("jobs", args.jobs),
+                          ("replay_backend", args.replay_backend)):
             theirs = base.get(key, ours)
             if theirs != ours:
                 print(json.dumps({

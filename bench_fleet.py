@@ -66,6 +66,7 @@ warnings.filterwarnings("ignore")
 
 from simumax_tpu.fleet import FleetSimulator
 from simumax_tpu.fleet.trace import FleetTrace
+from simumax_tpu.simulator.faults import ReplayOptions
 
 DEFAULT_TRACE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
@@ -113,7 +114,21 @@ def main(argv=None):
              "naive_jobs_steps_per_sec, after the --max-regression "
              "margin (0 disables) — the ISSUE-15 10x acceptance gate",
     )
+    ap.add_argument(
+        "--replay-backend", default="auto",
+        choices=("numpy", "jax", "auto"),
+        help="miss-replay backend of the shared walk (ISSUE-17 "
+             "batched replay; the naive loop always walks the scalar "
+             "engine, so bit_identical doubles as the backend oracle)",
+    )
+    ap.add_argument(
+        "--max-fallback-rate", type=float, default=0.0, metavar="FRAC",
+        help="fail when more than this fraction of batched-eligible "
+             "miss replays fell back to the scalar engine "
+             "(0 disables; counted per reason in the JSON line)",
+    )
     args = ap.parse_args(argv)
+    options = ReplayOptions(replay_backend=args.replay_backend)
 
     trace = FleetTrace.load(args.trace).to_dict()
     total_steps = sum(j["horizon_steps"] for j in trace["jobs"])
@@ -125,7 +140,8 @@ def main(argv=None):
     elapsed = None
     report = shared = None
     for _ in range(max(1, args.reps)):
-        sim = FleetSimulator(copy.deepcopy(trace), elastic=False)
+        sim = FleetSimulator(copy.deepcopy(trace), elastic=False,
+                             options=options)
         sim.prepare()
         t0 = time.perf_counter()
         rep = sim.run()
@@ -141,12 +157,18 @@ def main(argv=None):
             elapsed, shared = dt, sim
         if report is None:
             report = rep
-    sims = hits = steps = 0
+    sims = hits = steps = batched = 0
+    fallbacks = {}
     for rt in shared._runtimes.values():
         s = rt.ctx.stats
         sims += s["sims"]
         steps += s["steps"]
         hits += s["cache_hits"] + s["canon_hits"] + s["clamp_hits"]
+        batched += s.get("batched", 0)
+        for k, v in s.items():
+            if k.startswith("fallback_"):
+                key = k[len("fallback_"):]
+                fallbacks[key] = fallbacks.get(key, 0) + v
 
     result = {
         "metric": "fleet_jobs_steps_per_sec",
@@ -162,8 +184,20 @@ def main(argv=None):
         "step_cache_hit_rate": round(hits / max(1, steps), 4),
         "fleet_goodput": round(report["fleet_goodput"], 6),
         "slo_fraction": round(report["slo"]["fraction"], 6),
+        "replay_backend": args.replay_backend,
+        "batched": batched,
+        "fallbacks": dict(sorted(fallbacks.items())),
     }
+    fb_total = sum(fallbacks.values())
+    result["fallback_rate"] = round(
+        fb_total / max(1, batched + fb_total), 4
+    )
     ok = True
+    if args.max_fallback_rate:
+        result["fallback_rate_ok"] = (
+            result["fallback_rate"] <= args.max_fallback_rate
+        )
+        ok = ok and result["fallback_rate_ok"]
     if not args.skip_naive:
         naive_sim = FleetSimulator(
             copy.deepcopy(trace), elastic=False, naive=True,
@@ -195,6 +229,7 @@ def main(argv=None):
         t0 = time.perf_counter()
         par_report = FleetSimulator(
             copy.deepcopy(trace), elastic=False, jobs=args.jobs,
+            options=options,
         ).run()
         result["parallel_elapsed_s"] = round(
             time.perf_counter() - t0, 3

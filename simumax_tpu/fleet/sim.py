@@ -62,6 +62,7 @@ from simumax_tpu.simulator.faults import (
     ReplayContext,
     ReplayOptions,
     _deadline,
+    _predict_goodput_batch,
     predict_goodput,
 )
 
@@ -1038,15 +1039,36 @@ class FleetSimulator:
 
     def _cost_serial(self, batch: List[tuple]) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
+        # lockstep costing: jobs sharing a template context advance
+        # their goodput walks in rounds, so one flush's step misses
+        # reach the batched replay backend together instead of one at
+        # a time (bit-identical to the serial loop — the PR-14 cache
+        # contract). Reshape jobs walk the elastic path and per-job
+        # SIGALRM deadlines need one job on the clock at a time, so
+        # both keep the serial loop.
+        lockstep: Dict[str, List[Tuple[int, FaultScenario]]] = {}
         for (idx, key, scenario, reshapes, levels) in batch:
             rt = self._runtimes[key]
-            ctx = None if self.naive else rt.ctx
-            with _deadline(self.scenario_timeout,
-                           f"fleet job[{idx}]"):
-                out[idx] = _cost_job(
-                    rt.perf, ctx, rt.granularity, scenario,
-                    reshapes, levels,
-                )
+            if (self.naive or reshapes
+                    or self.scenario_timeout is not None):
+                ctx = None if self.naive else rt.ctx
+                with _deadline(self.scenario_timeout,
+                               f"fleet job[{idx}]"):
+                    out[idx] = _cost_job(
+                        rt.perf, ctx, rt.granularity, scenario,
+                        reshapes, levels,
+                    )
+            else:
+                lockstep.setdefault(key, []).append((idx, scenario))
+        for key in sorted(lockstep):
+            ctx = self._runtimes[key].ctx
+            items = lockstep[key]
+            reports = _predict_goodput_batch(
+                ctx,
+                [(sc, ctx.resolve_spec(sc)) for _i, sc in items],
+            )
+            for (idx, _sc), report in zip(items, reports):
+                out[idx] = report.to_dict()
         return out
 
     def _cost_pool(self, batch: List[tuple]) -> Dict[int, dict]:

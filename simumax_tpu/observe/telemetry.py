@@ -197,6 +197,18 @@ METRICS: Dict[str, Dict[str, str]] = {
                 "healthy-prefix engine snapshot instead of replaying "
                 "the step from t=0.",
     },
+    "replay_batched_total": {
+        "type": "counter",
+        "help": "Perturbed-step cache misses replayed through the "
+                "batched vmapped array program, by backend.",
+    },
+    "replay_batch_fallbacks_total": {
+        "type": "counter",
+        "help": "Batch-round cache misses that fell back to the "
+                "scalar engine, by counted reason (deaths/sendrecv/"
+                "unknown_kind/no_streams/lowering_error/"
+                "jax_unavailable/small_batch/backend_numpy).",
+    },
     "fleet_jobs_total": {
         "type": "counter",
         "help": "Fleet-simulation job events, by event (admitted/"

@@ -1,0 +1,763 @@
+"""Batched scenario replay: the reduced DES lowered into a vmapped
+JAX array program (ROADMAP open item 4, the raw-speed refactor behind
+``replay_backend="jax"``).
+
+PR 14's incremental replay already collapsed Monte-Carlo fault analysis
+onto a small set of *step-program families* (one recorded per-class
+request stream per touched-rank partition) and answered 81-98%% of
+steps from caches — but every remaining miss still walked the Python
+event loop of :class:`simulator.engine.SimuEngine` one request at a
+time. This module compiles a family's recorded streams ONCE into a
+fixed-shape array program and replays all of a Monte-Carlo round's
+cache misses in a single compiled call:
+
+* :func:`lower_family` runs a symbolic (time-free) scheduler over the
+  recorded streams, mirroring the engine's rendezvous / p2p / async
+  matching rules, and emits a linear op table in a dependency-valid
+  service order. With no rank deaths the engine's values are
+  order-independent (every op's outputs are pure functions of its
+  inputs — max/+ clock algebra), so ANY valid topological order
+  reproduces the scalar engine bit-for-bit; the one order-dependent
+  request kind (``sendrecv``) is a justified fallback, not lowered.
+* :func:`solve_batch` evaluates the op table as a ``jax.lax.scan``
+  over op index — rendezvous joins as masked segment-max, compute ops
+  as the exact piecewise slowdown integration of
+  ``StepFaultModel.compute_end``, link degradations as an ordered
+  product over the scenario's event-ordered link windows — vmapped
+  over the scenario batch and jitted under ``enable_x64``.
+* Compiled programs are cached by PADDED shape only (op tables are
+  *arguments*, not closure constants), so every family whose padded
+  dimensions agree shares one XLA executable — the PR 11 compile-cache
+  discipline at family granularity.
+
+The scalar engine remains the bit-identity oracle: batched makespans
+feed the same ``(raw_end * straggle_ratio, None, raw_end)`` tail as
+``ReplayContext._replay``, and ``tests/test_batched_replay.py`` pins
+byte-equality of whole ``GoodputReport``/fleet reports across the
+chaos grid. Scenarios that cannot lower fall back per-scenario to the
+scalar engine with a counted reason (``FALLBACK_REASONS``) — never a
+whole-batch downgrade.
+
+Determinism: this module is in the SIM003 lint scope — no wall-clock,
+no unsorted set iteration; the symbolic scheduler visits ranks in
+index order, so the emitted op table is a pure function of the input
+streams.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# Lowering vocabulary (the SIM002-style drift contract)
+# --------------------------------------------------------------------------
+
+#: op codes of the array program, scan-dispatched via ``lax.switch``
+OP_NOOP = 0          # padding
+OP_COMPUTE = 1       # piecewise slowdown integration (compute_end)
+OP_ADVANCE_ABS = 2   # clock = max(clock, t)
+OP_ADVANCE_REL = 3   # clock = max(clock, clock + delta)
+OP_COLL = 4          # sync rendezvous: masked max + link scale
+OP_ASYNC_POST = 5    # record poster's clock in a value slot
+OP_ASYNC_FINISH = 6  # chained stream op: max(posts, chain) + scale
+OP_WAIT_COMM = 7     # clock = max(clock, comm_done)
+OP_SEND = 8          # publish post + scaled duration (non-blocking)
+OP_SEND_SYNC = 9     # rendezvous send: max(clock, peer recv post)
+OP_RECV = 10         # consume a published send
+
+N_OP_KINDS = 11
+
+#: engine request kind -> lowered op kind(s). Every kind the scalar
+#: engine's ``_try_serve`` handles MUST appear here or in
+#: ``FALLBACK_REQUEST_KINDS`` — drift is a staticcheck finding
+#: (SIM008, ``tools/staticcheck/checkers/replay_drift.py``).
+LOWERED_REQUEST_KINDS: Dict[str, Tuple[int, ...]] = {
+    "compute": (OP_COMPUTE,),
+    "advance": (OP_ADVANCE_ABS,),
+    "advance_rel": (OP_ADVANCE_REL,),
+    "trace": (OP_NOOP,),  # zero-advance visibility span: no state
+    "collective": (OP_COLL,),
+    "async_collective": (OP_ASYNC_POST, OP_ASYNC_FINISH),
+    "wait_comm": (OP_WAIT_COMM,),
+    "send": (OP_SEND,),
+    "send_sync": (OP_SEND_SYNC,),
+    "recv": (OP_RECV,),
+}
+
+#: request kinds deliberately NOT lowered, with the justification the
+#: drift checker requires. A kind listed here routes the scenario to
+#: the scalar engine with a counted fallback reason.
+FALLBACK_REQUEST_KINDS: Dict[str, str] = {
+    "sendrecv": "completion races the peer's recv consumption "
+                "(_sr_done): genuinely service-order-dependent, so no "
+                "single static op order reproduces the engine",
+}
+
+#: the closed per-scenario fallback-reason catalogue surfaced by
+#: ``replay_batch_fallbacks_total{reason}`` and the bench JSON lines
+FALLBACK_REASONS = (
+    "deaths",          # rank deaths mid-step: kill/abort paths stay scalar
+    "sendrecv",        # stream contains an order-dependent sendrecv
+    "unknown_kind",    # stream contains a kind outside the vocabulary
+    "no_streams",      # family not recorded yet (first sim records)
+    "lowering_error",  # symbolic schedule wedged / inconsistent stream
+    "jax_unavailable", # no jax at runtime: numpy scalar engine only
+    "small_batch",     # auto backend: batch below the dispatch floor
+    "backend_numpy",   # replay_backend="numpy" requested
+)
+
+#: minimum miss-batch size for ``replay_backend="auto"`` to dispatch
+#: the compiled program; below it the XLA dispatch + prep overhead
+#: beats the win and the scalar engine stays faster (PR 11 discipline:
+#: ``search/batched.py::JIT_GROUP_MIN``, scaled to step-replay cost)
+JIT_BATCH_MIN = 2
+
+
+class LoweringError(Exception):
+    """The family's streams cannot lower to an array program; carries
+    the counted fallback ``reason``."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+
+
+_JAX: Optional[bool] = None
+
+
+def jax_available() -> bool:
+    """Whether the jax backend can be used (import guarded: the scalar
+    engine remains the no-JAX path, so machines without jax keep the
+    full fault model)."""
+    global _JAX
+    if _JAX is None:
+        try:
+            import jax
+            import jax.numpy
+
+            _JAX = jax.numpy is not None
+        except Exception:
+            _JAX = False
+    return _JAX
+
+
+# --------------------------------------------------------------------------
+# Symbolic lowering: recorded streams -> linear op table
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class LoweredProgram:
+    """The fixed-shape array program of one step-program family."""
+
+    n_classes: int
+    reps: Tuple[int, ...]            # class -> representative global rank
+    kind: np.ndarray                 # int32 [L]
+    rank: np.ndarray                 # int32 [L]
+    dur: np.ndarray                  # float64 [L]
+    aux: np.ndarray                  # int32 [L] (dst / slot / chain id)
+    mask: np.ndarray                 # bool [L, K] rendezvous members
+    refs: np.ndarray                 # int32 [L, G] async post slots
+    peer_mask: np.ndarray            # bool [L, K] comm-scale scope peers
+    op_dim_id: np.ndarray            # int32 [L], -1 = not a comm op
+    dim_ids: Dict[str, int]          # collective-dim vocabulary
+    n_chains: int                    # async chain slots (V2 length)
+
+    @property
+    def n_ops(self) -> int:
+        return int(self.kind.shape[0])
+
+
+def _key_dim_of(key) -> Optional[str]:
+    from simumax_tpu.simulator.faults import key_dim
+
+    return key_dim(key)
+
+
+def lower_family(streams: Sequence[list], plan) -> LoweredProgram:
+    """Lower one family's recorded per-class request streams into a
+    linear op table.
+
+    Runs a time-free mirror of the engine's matching rules (rendezvous
+    seq counters, p2p send/recv seq + post windows, async chains) and
+    serves requests in a deterministic lowest-ready-class order. The
+    emitted order is *a* valid topological order of the step's event
+    DAG; with no deaths the engine's values are order-independent, so
+    the array program reproduces the ready-heap schedule bit-for-bit.
+
+    Raises :class:`LoweringError` with a counted reason for streams
+    that cannot lower (``sendrecv``, unknown kinds, or a wedged
+    symbolic schedule)."""
+    k_classes = plan.n_classes
+    if len(streams) != k_classes:
+        raise LoweringError("lowering_error",
+                            f"{len(streams)} streams for {k_classes} "
+                            "classes")
+    idx = [0] * k_classes
+    done = [len(s) == 0 for s in streams]
+    coll_seq: Dict[tuple, int] = {}
+    send_seq: Dict[tuple, int] = {}
+    recv_seq: Dict[tuple, int] = {}
+    async_seq: Dict[tuple, int] = {}
+    collectives: Dict[tuple, dict] = {}
+    sends: Dict[tuple, int] = {}         # skey -> publishing op slot
+    recv_posted: set = set()
+    async_rv: Dict[tuple, dict] = {}
+    async_pending: List[set] = [set() for _ in range(k_classes)]
+    chain_ids: Dict[tuple, int] = {}
+
+    kinds: List[int] = []
+    ranks: List[int] = []
+    durs: List[float] = []
+    auxs: List[int] = []
+    masks: List[Optional[Tuple[int, ...]]] = []
+    refs: List[Optional[Tuple[int, ...]]] = []
+    peer_masks: List[Optional[Tuple[int, ...]]] = []
+    op_dims: List[Optional[str]] = []
+
+    def emit(op: int, rank: int = 0, dur: float = 0.0, aux: int = 0,
+             mask: Optional[Tuple[int, ...]] = None,
+             ref: Optional[Tuple[int, ...]] = None,
+             peers: Optional[Tuple[int, ...]] = None,
+             dim: Optional[str] = None) -> int:
+        kinds.append(op)
+        ranks.append(rank)
+        durs.append(dur)
+        auxs.append(aux)
+        masks.append(mask)
+        refs.append(ref)
+        peer_masks.append(peers)
+        op_dims.append(dim)
+        return len(kinds) - 1
+
+    def serve(r: int) -> bool:
+        """Attempt to serve class ``r``'s next request; True when it
+        progressed (the request completed and the pointer advanced)."""
+        req = streams[r][idx[r]]
+        kind = req[0]
+        if kind == "compute":
+            _, duration, _name, _lane = req
+            emit(OP_COMPUTE, rank=r, dur=float(duration))
+            return True
+        if kind == "advance":
+            emit(OP_ADVANCE_ABS, rank=r, dur=float(req[1]))
+            return True
+        if kind == "advance_rel":
+            emit(OP_ADVANCE_REL, rank=r, dur=float(req[1]))
+            return True
+        if kind == "trace":
+            return True  # no clock/state effect under drop_events
+        if kind == "collective":
+            # seq bookkeeping mirrors the engine exactly: a rank
+            # arrives under its CURRENT per-(key, rank) seq, stays
+            # blocked until the rendezvous completes, and increments
+            # only when it consumes the completed rendezvous — a
+            # blocked peer re-served after completion must land on the
+            # same ckey, not the next seq slot
+            _, key, duration, _name, peers = req
+            seq = coll_seq.get((key, r), 0)
+            pset = frozenset(peers)
+            ckey = (key, pset, seq)
+            rv = collectives.get(ckey)
+            if rv is None:
+                rv = collectives[ckey] = {
+                    "arrived": set(), "consumed": set(),
+                    "dur": float(duration), "done": False,
+                }
+            if r not in rv["arrived"]:
+                if r not in pset:
+                    raise LoweringError(
+                        "lowering_error",
+                        f"collective {key!r}#{seq}: class {r} not in "
+                        f"its own peer list")
+                if rv["dur"] != float(duration):
+                    raise LoweringError(
+                        "lowering_error",
+                        f"collective {key!r}#{seq}: mismatched "
+                        "durations")
+                rv["arrived"].add(r)
+                if rv["arrived"] == pset:
+                    members = tuple(sorted(pset))
+                    emit(OP_COLL, dur=rv["dur"], mask=members,
+                         peers=members, dim=_key_dim_of(key))
+                    rv["done"] = True
+            if not rv["done"]:
+                return False  # blocked until the last peer arrives
+            coll_seq[(key, r)] = seq + 1
+            rv["consumed"].add(r)
+            if rv["consumed"] == pset:
+                del collectives[ckey]
+            return True
+        if kind == "async_collective":
+            _, stream_name, duration, _name, peers = req
+            seq = async_seq.get((stream_name, r), 0)
+            async_seq[(stream_name, r)] = seq + 1
+            pset = frozenset(peers)
+            ckey = (stream_name, pset, seq)
+            rv = async_rv.get(ckey)
+            if rv is None:
+                rv = async_rv[ckey] = {
+                    "slots": [], "arrived": set(), "dur": float(duration),
+                }
+            if r not in pset or rv["dur"] != float(duration):
+                raise LoweringError(
+                    "lowering_error",
+                    f"async {stream_name!r}#{seq}: inconsistent post")
+            slot = emit(OP_ASYNC_POST, rank=r)
+            rv["slots"].append(slot)
+            rv["arrived"].add(r)
+            async_pending[r].add(ckey)
+            if rv["arrived"] == pset:
+                chain_key = (stream_name, pset)
+                cid = chain_ids.setdefault(chain_key, len(chain_ids))
+                members = tuple(sorted(pset))
+                emit(OP_ASYNC_FINISH, dur=rv["dur"], aux=cid,
+                     mask=members, ref=tuple(rv["slots"]),
+                     peers=members, dim=_key_dim_of(stream_name))
+                del async_rv[ckey]
+                for p in pset:
+                    async_pending[p].discard(ckey)
+            return True  # poster never blocks
+        if kind == "wait_comm":
+            if async_pending[r]:
+                return False  # some posted op still waits on peers
+            emit(OP_WAIT_COMM, rank=r)
+            return True
+        if kind == "send":
+            _, dst, tag, duration, _name, *_rest = req
+            seq = send_seq.get((r, dst, tag), 0)
+            send_seq[(r, dst, tag)] = seq + 1
+            skey = (r, dst, tag, seq)
+            if skey in sends:
+                raise LoweringError("lowering_error",
+                                    f"duplicate send {skey}")
+            sends[skey] = emit(OP_SEND, rank=r, dur=float(duration),
+                               peers=(r, dst), dim="pp")
+            return True
+        if kind == "send_sync":
+            _, dst, tag, duration, _name, *_rest = req
+            seq = send_seq.get((r, dst, tag), 0)
+            skey = (r, dst, tag, seq)
+            if skey not in recv_posted:
+                return False  # peer not at its recv yet
+            send_seq[(r, dst, tag)] = seq + 1
+            sends[skey] = emit(OP_SEND_SYNC, rank=r,
+                               dur=float(duration), aux=dst,
+                               peers=(r, dst), dim="pp")
+            return True
+        if kind == "recv":
+            _, src, tag, _name, *_rest = req
+            seq = recv_seq.get((r, src, tag), 0)
+            skey = (src, r, tag, seq)
+            recv_posted.add(skey)
+            slot = sends.pop(skey, None)
+            if slot is None:
+                return False  # sender hasn't published yet
+            recv_posted.discard(skey)
+            recv_seq[(r, src, tag)] = seq + 1
+            emit(OP_RECV, rank=r, aux=slot)
+            return True
+        if kind in FALLBACK_REQUEST_KINDS:
+            raise LoweringError(kind)
+        raise LoweringError("unknown_kind", repr(kind))
+
+    remaining = sum(len(s) for s in streams)
+    while remaining:
+        progressed = False
+        for r in range(k_classes):
+            if done[r]:
+                continue
+            while idx[r] < len(streams[r]):
+                if not serve(r):
+                    break
+                idx[r] += 1
+                remaining -= 1
+                progressed = True
+            if idx[r] >= len(streams[r]):
+                done[r] = True
+        if not progressed:
+            raise LoweringError("lowering_error",
+                                "symbolic schedule made no progress "
+                                "(wedged rendezvous/p2p matching)")
+    if collectives or async_rv:
+        raise LoweringError("lowering_error",
+                            "unfinished rendezvous at stream end")
+
+    n_ops = len(kinds)
+    group = max((len(rf) for rf in refs if rf), default=1)
+    mask_a = np.zeros((n_ops, k_classes), dtype=bool)
+    peer_a = np.zeros((n_ops, k_classes), dtype=bool)
+    refs_a = np.full((n_ops, max(group, 1)), n_ops, dtype=np.int32)
+    dim_ids: Dict[str, int] = {}
+    dim_a = np.full(n_ops, -1, dtype=np.int32)
+    for i in range(n_ops):
+        if masks[i]:
+            mask_a[i, list(masks[i])] = True
+        if peer_masks[i]:
+            peer_a[i, list(peer_masks[i])] = True
+        if refs[i]:
+            refs_a[i, : len(refs[i])] = refs[i]
+        d = op_dims[i]
+        if d is not None:
+            dim_a[i] = dim_ids.setdefault(d, len(dim_ids))
+    return LoweredProgram(
+        n_classes=k_classes,
+        reps=tuple(plan.reps),
+        kind=np.asarray(kinds, dtype=np.int32),
+        rank=np.asarray(ranks, dtype=np.int32),
+        dur=np.asarray(durs, dtype=np.float64),
+        aux=np.asarray(auxs, dtype=np.int32),
+        mask=mask_a,
+        refs=refs_a,
+        peer_mask=peer_a,
+        op_dim_id=dim_a,
+        dim_ids=dim_ids,
+        n_chains=max(len(chain_ids), 1),
+    )
+
+
+# --------------------------------------------------------------------------
+# Per-scenario host prep (vectorized numpy; no JAX needed here)
+# --------------------------------------------------------------------------
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length() if n > 1 else 1
+
+
+@dataclass
+class ScenarioArrays:
+    """One scenario's fault-model arrays, padded to the batch shape."""
+
+    win_s: np.ndarray     # [K, W]
+    win_e: np.ndarray     # [K, W]
+    win_m: np.ndarray     # [K, W]
+    edges: np.ndarray     # [K, We]
+    has_slow: np.ndarray  # [K] bool
+    link_s: np.ndarray    # [E]
+    link_e: np.ndarray    # [E]
+    link_m: np.ndarray    # [E]
+    app: np.ndarray       # [L, E] bool: link applies to op
+
+
+def prepare_scenario(prog: LoweredProgram, model, wp: int, wep: int,
+                     ep: int) -> ScenarioArrays:
+    """Lower one ``StepFaultModel`` (no deaths) against ``prog``:
+    per-class slowdown windows + integration edges, and the scenario's
+    event-ordered link windows with a precomputed per-op applicability
+    matrix (dim match x scope intersection), so the compiled program
+    never branches on host state."""
+    k = prog.n_classes
+    win_s = np.full((k, wp), math.inf)
+    win_e = np.full((k, wp), math.inf)
+    win_m = np.ones((k, wp))
+    edges = np.full((k, wep), math.inf)
+    has_slow = np.zeros(k, dtype=bool)
+    for i in range(k):
+        wins = model._slow.get(prog.reps[i])
+        if not wins:
+            continue
+        has_slow[i] = True
+        for j, (s, e, m) in enumerate(wins):
+            win_s[i, j] = s
+            win_e[i, j] = e
+            win_m[i, j] = m
+        eds = sorted({x for w in wins for x in w[:2]
+                      if math.isfinite(x)})
+        edges[i, : len(eds)] = eds
+    links = model._links
+    n_ops = prog.n_ops
+    link_s = np.full(ep, math.inf)
+    link_e = np.full(ep, math.inf)
+    link_m = np.ones(ep)
+    app = np.zeros((n_ops, ep), dtype=bool)
+    is_comm = prog.op_dim_id >= 0
+    for j, (d, s, e, mult, scope) in enumerate(links):
+        link_s[j] = s
+        link_e[j] = e
+        link_m[j] = mult
+        if d == "*":
+            dim_ok = is_comm
+        else:
+            dim_ok = prog.op_dim_id == prog.dim_ids.get(d, -2)
+        if scope is None:
+            app[:, j] = dim_ok
+        else:
+            in_scope = np.fromiter(
+                (prog.reps[c] in scope for c in range(k)), dtype=bool,
+                count=k,
+            )
+            app[:, j] = dim_ok & (prog.peer_mask @ in_scope)
+    return ScenarioArrays(win_s, win_e, win_m, edges, has_slow,
+                          link_s, link_e, link_m, app)
+
+
+# --------------------------------------------------------------------------
+# Compiled program cache (keyed by padded shape ONLY — tables are
+# arguments, so families sharing a bucket share one XLA executable)
+# --------------------------------------------------------------------------
+
+_PROGRAM_CACHE: Dict[tuple, Any] = {}
+
+
+def compile_cache_info() -> Dict[str, int]:
+    """Observability hook: compiled-shape count (bench forensics)."""
+    return {"compiled_shapes": len(_PROGRAM_CACHE)}
+
+
+def _compiled(lp: int, kp: int, gp: int, cp: int, wp: int, wep: int,
+              ep: int):
+    key = (lp, kp, gp, cp, wp, wep, ep)
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    inf = jnp.inf
+
+    def run_one(n_ops, kind_a, rank_a, dur_a, aux_a, mask_a, refs_a,
+                win_s, win_e, win_m, edges, has_slow,
+                link_s, link_e, link_m, app_bits):
+        clock0 = jnp.zeros((kp,), dtype=jnp.float64)
+        cd0 = jnp.zeros((kp,), dtype=jnp.float64)
+        v0 = jnp.full((lp + 1,), 0.0, dtype=jnp.float64).at[lp].set(-inf)
+        v20 = jnp.zeros((cp,), dtype=jnp.float64)
+
+        # Body discipline (the measured 10x): NO lax.switch/cond at
+        # all. An HLO Conditional materializes its operands and defeats
+        # XLA:CPU fusion, costing ~2us/iteration in branch dispatch
+        # alone; but every op kind here is a handful of scalar max/+
+        # flops, so computing ALL kinds' candidate results and
+        # combining them with scalar selects fuses into one flat loop
+        # body. State updates are unconditional and inert for kinds
+        # that don't own the resource (no-op writes / all-false masks).
+        def body(i, carry):
+            clock, cd, v, v2 = carry
+            k_i = kind_a[i]
+            r = rank_a[i]
+            d = dur_a[i]
+            a = aux_a[i]
+            msk = mask_a[i]
+            apb = app_bits[i]
+            cr = clock[r]
+            cdr = cd[r]
+            a_kp = jnp.clip(a, 0, kp - 1)
+            a_cp = jnp.clip(a, 0, cp - 1)
+            a_lp = jnp.clip(a, 0, lp)
+            ca = clock[a_kp]          # send_sync partner clock
+            va = v[a_lp]              # recv: published send value
+            v2a = v2[a_cp]            # async chain tail
+            gmax = jnp.max(v[refs_a[i]])   # async post arrivals
+            cstart = jnp.max(jnp.where(msk, clock, -inf))
+
+            is_compute = k_i == OP_COMPUTE
+            is_adv_abs = k_i == OP_ADVANCE_ABS
+            is_adv_rel = k_i == OP_ADVANCE_REL
+            is_coll = k_i == OP_COLL
+            is_af = k_i == OP_ASYNC_FINISH
+            is_wait = k_i == OP_WAIT_COMM
+            is_send = k_i == OP_SEND
+            is_ss = k_i == OP_SEND_SYNC
+            is_recv = k_i == OP_RECV
+
+            # one comm-scale evaluation at the kind-selected start time
+            af_start = jnp.maximum(gmax, v2a)
+            ss_start = jnp.maximum(cr, ca)
+            t_comm = jnp.where(is_coll, cstart,
+                               jnp.where(is_af, af_start,
+                                         jnp.where(is_ss, ss_start,
+                                                   cr)))
+            # ordered product over the scenario's event-ordered link
+            # windows — float multiply is order-sensitive, so the
+            # engine's event order is preserved (identity factors for
+            # inactive links: x * 1.0 is bit-exact x)
+            scale = jnp.asarray(1.0, dtype=jnp.float64)
+            for j in range(ep):
+                act = ((apb >> j) & 1).astype(bool) \
+                    & (link_s[j] <= t_comm) & (t_comm < link_e[j])
+                scale = scale * jnp.where(act, link_m[j], 1.0)
+            # abs() blocks LLVM's mul+add -> fma contraction (XLA:CPU
+            # emits contractable IR, and a fused single rounding is a
+            # 1-ulp drift off the engine's two-step rounding); it is a
+            # bit-exact identity here since d >= 0 and scale >= 1
+            dsc = jnp.abs(d * scale)
+            coll_end = cstart + dsc
+            af_end = af_start + dsc
+            ss_end = ss_start + dsc
+
+            # compute: exact piecewise slowdown integration, UNROLLED
+            # (a nested lax.scan defeats fusion) and executed
+            # unconditionally. The engine advances segment by segment
+            # to the NEXT window boundary > t; since the per-class edge
+            # list is sorted ascending (inf-padded), visiting edges in
+            # table order with a "passed already" guard reproduces that
+            # exact sequence WITHOUT a min-reduce per step — each
+            # executed step sees e == min(edges > t), and the float
+            # expressions are the engine's verbatim, so the walk stays
+            # bit-identical. wp == 0 (no slowdown anywhere in the
+            # batch) collapses the whole chain to ``res = cr + d``.
+            ws, we, wm = win_s[r], win_e[r], win_m[r]
+            eds = edges[r]
+            trivial = (~has_slow[r]) | (d <= 0.0)
+            t, work, pdone, res = cr, d, trivial, cr + d
+            for s in range(wep + 1):
+                e = eds[s] if s < wep else inf
+                act = (~pdone) & (e > t)
+                mult = jnp.asarray(1.0, dtype=jnp.float64)
+                for j in range(wp):
+                    win = (ws[j] <= t) & (t < we[j])
+                    mult = jnp.where(win, mult * wm[j], mult)
+                frozen = jnp.isinf(mult)
+                # abs() = identity (work >= 0, mult >= 1): fma fence,
+                # as for dsc above — `t + need` must round twice
+                need = jnp.abs(work * mult)
+                fits = (~frozen) & (t + need <= e)
+                res = jnp.where(act & fits, t + need, res)
+                pdone = pdone | (act & fits)
+                work = jnp.where(act & ~(fits | frozen),
+                                 work - (e - t) / mult, work)
+                t = jnp.where(act & ~fits, e, t)
+
+            new_cr = jnp.where(
+                is_compute, res,
+                jnp.where(is_adv_abs, jnp.maximum(cr, d),
+                jnp.where(is_adv_rel, jnp.maximum(cr, cr + d),
+                jnp.where(is_wait, jnp.maximum(cr, cdr),
+                jnp.where(is_ss, ss_end,
+                jnp.where(is_recv, jnp.maximum(cr, va), cr))))))
+            vval = jnp.where(is_send, cr + dsc,
+                             jnp.where(is_ss, ss_end, cr))
+            v2val = jnp.where(is_af, af_end, v2a)
+            grp_end = jnp.where(is_coll, coll_end, af_end)
+
+            clock = clock.at[r].set(new_cr)
+            clock = jnp.where(is_coll & msk, grp_end, clock)
+            cd = jnp.where(is_af & msk, jnp.maximum(cd, grp_end), cd)
+            v = v.at[i].set(vval)
+            v2 = v2.at[a_cp].set(v2val)
+            return (clock, cd, v, v2)
+
+        # dynamic trip count: the padded table tail is all NOOPs, so
+        # stopping at the family's REAL op count skips up to half the
+        # bucket's iterations for free (n_ops is an argument, not a
+        # shape, so the compile key stays the padded bucket)
+        clock, _, _, _ = jax.lax.fori_loop(
+            0, n_ops, body, (clock0, cd0, v0, v20))
+        return jnp.max(clock)
+
+    fn = jax.jit(jax.vmap(
+        run_one,
+        in_axes=(None, None, None, None, None, None, None,
+                 0, 0, 0, 0, 0, 0, 0, 0, 0),
+    ))
+    if len(_PROGRAM_CACHE) > 64:
+        _PROGRAM_CACHE.clear()
+    _PROGRAM_CACHE[key] = fn
+    return fn
+
+
+def solve_batch(prog: LoweredProgram, models: Sequence[Any]
+                ) -> np.ndarray:
+    """Replay ``prog`` under each scenario's fault model in ONE
+    compiled vmapped call; returns the raw (pre-straggle) makespans,
+    bit-identical to ``SimuEngine.run()`` on the same streams.
+
+    Caller contract: every model has no deaths (``deaths`` fall back
+    scalar), and the caller holds ``jax.experimental.enable_x64()``
+    around trace AND execution."""
+    n = len(models)
+    k = prog.n_classes
+    n_ops = prog.n_ops
+    w_max = max((len(m._slow.get(rep, ()))
+                 for m in models for rep in prog.reps), default=0)
+    e_max = max((len(m._links) for m in models), default=0)
+    # fault-array width buckets: wp drives the length of the unrolled
+    # integration chain and ep the link-scale product (every op pays
+    # both), so they hug the batch's real maxima — 0 is a real bucket
+    # that deletes the loop at trace time (a no-slowdown batch computes
+    # ``cr + d`` directly; a no-link batch gets scale == 1.0). The
+    # sampler emits 1-2 windows/links per scenario, so the shape space
+    # stays tiny (the shape key is the compile key — PR 11 discipline)
+    wp = _pow2(w_max) if w_max else 0
+    wep = 2 * wp
+    ep = _pow2(e_max) if e_max else 0
+    lp = _pow2(n_ops)
+    kp = _pow2(k)
+    gp = _pow2(prog.refs.shape[1])
+    cp = _pow2(prog.n_chains)
+    bp = _pow2(n)
+
+    kind_a = np.zeros(lp, dtype=np.int32)
+    kind_a[:n_ops] = prog.kind
+    rank_a = np.zeros(lp, dtype=np.int32)
+    rank_a[:n_ops] = prog.rank
+    dur_a = np.zeros(lp, dtype=np.float64)
+    dur_a[:n_ops] = prog.dur
+    aux_a = np.zeros(lp, dtype=np.int32)
+    aux_a[:n_ops] = prog.aux
+    mask_a = np.zeros((lp, kp), dtype=bool)
+    mask_a[:n_ops, :k] = prog.mask
+    refs_a = np.full((lp, gp), lp, dtype=np.int32)
+    refs_a[:n_ops, : prog.refs.shape[1]] = np.where(
+        prog.refs >= n_ops, lp, prog.refs)
+
+    arrs = [prepare_scenario(prog, m, wp, wep, ep) for m in models]
+
+    # padded classes: inert windows / edges / flags; padded batch rows
+    # repeat the last real scenario (results discarded past n)
+    win_s = np.full((bp, kp, wp), math.inf)
+    win_e = np.full((bp, kp, wp), math.inf)
+    win_m = np.ones((bp, kp, wp))
+    edges = np.full((bp, kp, wep), math.inf)
+    has_slow = np.zeros((bp, kp), dtype=bool)
+    link_s = np.full((bp, ep), math.inf)
+    link_e = np.full((bp, ep), math.inf)
+    link_m = np.ones((bp, ep))
+    # per-op link applicability packed as a bitmask (bit j = link j):
+    # one int gather per scan iteration instead of an (ep,) bool row
+    shifts = np.arange(ep, dtype=np.int64)
+    app_bits = np.zeros((bp, lp), dtype=np.int64)
+    for b in range(bp):
+        a = arrs[min(b, n - 1)]
+        win_s[b, :k] = a.win_s
+        win_e[b, :k] = a.win_e
+        win_m[b, :k] = a.win_m
+        edges[b, :k] = a.edges
+        has_slow[b, :k] = a.has_slow
+        link_s[b] = a.link_s
+        link_e[b] = a.link_e
+        link_m[b] = a.link_m
+        app_bits[b, :n_ops] = (
+            a.app.astype(np.int64) << shifts).sum(axis=1)
+
+    from jax.experimental import enable_x64
+
+    fn = _compiled(lp, kp, gp, cp, wp, wep, ep)
+    # x64 held around trace AND execution: the engine oracle runs in
+    # python doubles, and bit-identity is the whole contract
+    with enable_x64():
+        raw = fn(n_ops, kind_a, rank_a, dur_a, aux_a, mask_a, refs_a,
+                 win_s, win_e, win_m, edges, has_slow,
+                 link_s, link_e, link_m, app_bits)
+    return np.asarray(raw)[:n]
+
+
+__all__ = [
+    "FALLBACK_REASONS",
+    "FALLBACK_REQUEST_KINDS",
+    "JIT_BATCH_MIN",
+    "LOWERED_REQUEST_KINDS",
+    "LoweredProgram",
+    "LoweringError",
+    "ScenarioArrays",
+    "compile_cache_info",
+    "jax_available",
+    "lower_family",
+    "prepare_scenario",
+    "solve_batch",
+]

@@ -78,6 +78,11 @@ EVENT_KINDS = ("slowdown", "link_degradation", "preemption", "rank_death")
 #: schedule issues rendezvous on, plus "pp" (p2p) and "*" (every comm op)
 LINK_DIMS = ("tp", "cp", "ep", "etp", "dp_cp", "edp", "pp", "*")
 
+#: canonical-cache probes tolerated without a single hit before the
+#: layer goes dormant for the context's lifetime (probing serializes
+#: the whole engine problem — the costliest key in the pipeline)
+CANON_PROBE_LIMIT = 512
+
 
 # --------------------------------------------------------------------------
 # Scenario schema
@@ -671,6 +676,15 @@ def _simulate_step(perf, sub: FaultScenario,
     return out
 
 
+def _batched_replay():
+    """Lazy import of the batched-replay lowering (keeps faults.py
+    importable without jax/numpy on the path until a batch dispatch
+    actually needs them)."""
+    from simumax_tpu.simulator import batched_replay
+
+    return batched_replay
+
+
 # --------------------------------------------------------------------------
 # Incremental fault replay (ISSUE 14 tentpole)
 # --------------------------------------------------------------------------
@@ -699,6 +713,17 @@ class ReplayOptions:
     horizon_clamp: bool = True
     #: fork-ladder bound: snapshots retained per step-program family
     max_snapshots: int = 16
+    #: miss-replay backend: ``"numpy"`` keeps every miss on the scalar
+    #: engine walk; ``"jax"`` lowers miss batches to the vmapped array
+    #: program (``simulator/batched_replay.py``) whenever the family
+    #: can lower; ``"auto"`` dispatches jax only when it is importable
+    #: and the miss batch is large enough to amortize dispatch —
+    #: per-scenario scalar fallback with a counted reason otherwise,
+    #: never a whole-batch downgrade
+    replay_backend: str = "auto"
+    #: auto-dispatch floor for ``replay_backend="auto"`` (0 = use
+    #: ``batched_replay.JIT_BATCH_MIN``)
+    jit_batch_min: int = 0
 
 
 @dataclass
@@ -796,11 +821,12 @@ class ReplayContext:
         self.stats: Dict[str, int] = {k: 0 for k in (
             "scenarios", "steps", "sims", "recordings", "replays",
             "forks", "shortcircuits", "cache_hits", "canon_hits",
-            "clamp_hits",
+            "clamp_hits", "batched",
         )}
         from simumax_tpu.observe.telemetry import get_registry
 
         _reg = get_registry()
+        self._registry = _reg
         self._c_scenarios = _reg.counter("faults_scenarios_total")
         self._c_hits = _reg.counter("faults_step_cache_hits_total",
                                     kind="exact")
@@ -810,6 +836,10 @@ class ReplayContext:
                                      kind="clamped")
         self._c_gate = _reg.counter("faults_slack_shortcircuits_total")
         self._c_forks = _reg.counter("faults_prefix_forks_total")
+        self._c_batched = _reg.counter("replay_batched_total",
+                                       backend="jax")
+        #: reason -> counter, filled lazily from the closed catalogue
+        self._c_fallbacks: Dict[str, Any] = {}
         self._healthy: Optional[dict] = None
         self._slack: Optional[tuple] = None
         self._structure = None  # memoized reduction relations
@@ -836,6 +866,21 @@ class ReplayContext:
         self._canon: Dict[tuple, Tuple[float, Optional[float],
                                        float]] = {}
         self._ckpt: Dict[tuple, CheckpointCostModel] = {}
+        #: id(fam) -> LoweredProgram | fallback-reason str (fams are
+        #: owned by self._families, so ids are stable for our lifetime)
+        self._lowerings: Dict[int, Any] = {}
+        #: (id(plan), rank_events) -> canonical class order — the
+        #: refinement in reduce.canonical_class_order is a pure
+        #: function of both, and Monte-Carlo rounds re-ask it for the
+        #: same few event patterns thousands of times
+        self._canon_orders: Dict[tuple, Any] = {}
+        #: adaptive canonical probing: key serialization is the most
+        #: expensive cache layer, and a workload whose scenarios never
+        #: relabel onto each other pays it for nothing. After
+        #: CANON_PROBE_LIMIT misses with zero hits the layer goes
+        #: dormant (cache-speed only: a canon hit returns the same
+        #: bytes a fresh sim would, so skipping can't change results)
+        self._canon_misses = 0
 
     # -- hoisted per-call prologue (satellite of ISSUE 15) -----------------
     def validate_scenario(self, scenario: FaultScenario):
@@ -1196,7 +1241,11 @@ class ReplayContext:
             tuple(sorted(by_rank.get(reps[i], ()), key=repr))
             for i in range(k)
         ]
-        order = canonical_class_order(plan, rank_events)
+        mkey = (id(plan), tuple(rank_events))
+        order = self._canon_orders.get(mkey)
+        if order is None:
+            order = canonical_class_order(plan, rank_events)
+            self._canon_orders[mkey] = order
         perm = [0] * k
         for new, old in enumerate(order):
             perm[old] = new
@@ -1413,26 +1462,25 @@ class ReplayContext:
         return (raw_end * ratio, None, raw_end)
 
     # -- the step entry point ----------------------------------------------
-    def simulate_step(self, sub: FaultScenario, span_s: float
-                      ) -> Tuple[float, Optional[float]]:
-        """(wall duration, death time | None) of one step under the
-        re-based sub-scenario ``sub`` (nominal window ``span_s``
-        seconds) — the incremental twin of :func:`_simulate_step`,
-        bit-identical by construction."""
-        self.stats["steps"] += 1
+    def _step_probe(self, sub: FaultScenario, span_s: float):
+        """The cache/short-circuit pipeline of one step, short of
+        simulating: ``(answer, None)`` when a cache layer or the slack
+        gate answers, else ``(None, miss_state)`` where ``miss_state``
+        carries everything :meth:`_step_commit` needs to store the
+        simulated result — ``(key, hkey, ckey, fam, min_end)``."""
         key = sub.signature()
         hit = self._cache.get(key)
         if hit is not None:
             self.stats["cache_hits"] += 1
             self._c_hits.inc()
-            return hit
+            return hit, None
         opts = self.options
         if opts.short_circuit and self._gate(sub):
             self.stats["shortcircuits"] += 1
             self._c_gate.inc()
             out = (self.healthy()["end_time"], None)
             self._cache[key] = out
-            return out
+            return out, None
         sigs, min_end, clamped = self._clamp_events(sub, span_s)
         hkey = None
         if clamped:
@@ -1443,10 +1491,12 @@ class ReplayContext:
                 self.stats["clamp_hits"] += 1
                 self._c_clamp.inc()
                 self._cache[key] = out
-                return out
+                return out, None
         fam = None
         ckey = None
-        if opts.canonical_cache:
+        if opts.canonical_cache and (
+                self._canon_misses < CANON_PROBE_LIMIT
+                or self.stats.get("canon_hits", 0) > 0):
             fam = self._family(sub)
             ckey = self._canonical_key(sub, fam.plan, sigs)
             got = self._canon.get(ckey)
@@ -1457,10 +1507,20 @@ class ReplayContext:
                 self._cache[key] = out
                 if hkey is not None:
                     self._clamped[hkey] = got
-                return out
+                return out, None
+            self._canon_misses += 1
         if fam is None:
             fam = self._family(sub)
-        dur, death, raw_limit = self._replay(sub, fam)
+        return None, (key, hkey, ckey, fam, min_end)
+
+    def _step_commit(self, state: tuple,
+                     result: Tuple[float, Optional[float], float]
+                     ) -> Tuple[float, Optional[float]]:
+        """Store one simulated miss into every cache layer whose
+        validity guard passes — the exact tail of the pre-batched
+        ``simulate_step``, shared by the scalar and batched paths."""
+        key, hkey, ckey, _fam, min_end = state
+        dur, death, raw_limit = result
         out = (dur, death)
         self.stats["sims"] += 1
         self._cache[key] = out
@@ -1473,6 +1533,191 @@ class ReplayContext:
             if ckey is not None:
                 self._canon[ckey] = entry
         return out
+
+    def simulate_step(self, sub: FaultScenario, span_s: float
+                      ) -> Tuple[float, Optional[float]]:
+        """(wall duration, death time | None) of one step under the
+        re-based sub-scenario ``sub`` (nominal window ``span_s``
+        seconds) — the incremental twin of :func:`_simulate_step`,
+        bit-identical by construction."""
+        self.stats["steps"] += 1
+        out, state = self._step_probe(sub, span_s)
+        if out is not None:
+            return out
+        return self._step_commit(state, self._replay(sub, state[3]))
+
+    # -- batched miss replay (ISSUE 17 tentpole) ---------------------------
+    def simulate_step_batch(self, reqs: List[Tuple[FaultScenario, float]]
+                            ) -> List[Tuple[float, Optional[float]]]:
+        """Answer one lockstep round of steps together: probe every
+        request through the cache pipeline, then replay the deduped
+        misses — batched through the vmapped array program where the
+        family lowers, scalar with a counted fallback reason where it
+        doesn't. Answers are bit-identical to calling
+        :meth:`simulate_step` on each request in order: the caches
+        guarantee cached == computed, and within-round duplicates
+        (exact, clamped, or canonical) defer to the next round where
+        the freshly committed entries answer them through the same
+        validity guards the serial path applies."""
+        outs: List[Any] = [None] * len(reqs)
+        pending = []
+        for j, (sub, span_s) in enumerate(reqs):
+            self.stats["steps"] += 1
+            out, state = self._step_probe(sub, span_s)
+            if out is not None:
+                outs[j] = out
+            else:
+                pending.append((j, sub, span_s, state))
+        while pending:
+            seen: set = set()
+            batch, rest = [], []
+            for item in pending:
+                key, hkey, ckey = item[3][0], item[3][1], item[3][2]
+                dup = (key in seen
+                       or (hkey is not None and hkey in seen)
+                       or (ckey is not None and ckey in seen))
+                if dup:
+                    rest.append(item)
+                    continue
+                seen.add(key)
+                if hkey is not None:
+                    seen.add(hkey)
+                if ckey is not None:
+                    seen.add(ckey)
+                batch.append(item)
+            self._solve_misses(batch, outs)
+            pending = []
+            for j, sub, span_s, _old in rest:
+                out, state = self._step_probe(sub, span_s)
+                if out is not None:
+                    outs[j] = out
+                else:
+                    pending.append((j, sub, span_s, state))
+        return outs
+
+    def _count_fallback(self, reason: str, n: int = 1):
+        k = "fallback_" + reason
+        self.stats[k] = self.stats.get(k, 0) + n
+        c = self._c_fallbacks.get(reason)
+        if c is None:
+            c = self._registry.counter("replay_batch_fallbacks_total",
+                                       reason=reason)
+            self._c_fallbacks[reason] = c
+        c.inc(n)
+
+    def _lowered(self, fam: _StepFamily):
+        """``fam``'s lowered array program, or the fallback-reason
+        string explaining why it cannot lower. Lowering outcomes are
+        memoized per family; the one retryable miss — streams not
+        recorded yet — is not cached, so the family lowers on the
+        round after its recording run."""
+        if not self.options.prefix_fork:
+            return "no_streams"
+        got = self._lowerings.get(id(fam))
+        if got is not None:
+            return got
+        if fam.streams is None and self._stage_sources:
+            fam.streams = self._remap_streams(fam)
+        if fam.streams is None:
+            return "no_streams"
+        br = _batched_replay()
+        try:
+            prog = br.lower_family(fam.streams, fam.plan)
+        except br.LoweringError as err:
+            prog = err.reason
+        self._lowerings[id(fam)] = prog
+        return prog
+
+    def _solve_misses(self, batch: List[tuple], outs: List[Any]):
+        """Replay one deduped round of cache misses. Lowerable
+        families go through ``batched_replay.solve_batch`` in one
+        vmapped call per family; everything else falls back to the
+        scalar engine per scenario with a counted reason."""
+        backend = self.options.replay_backend
+        scalar: List[Tuple[tuple, str]] = []
+        groups: Dict[int, Tuple[_StepFamily, Any, list]] = {}
+        if backend == "numpy":
+            scalar = [(item, "backend_numpy") for item in batch]
+        elif not _batched_replay().jax_available():
+            scalar = [(item, "jax_unavailable") for item in batch]
+        else:
+            for item in batch:
+                _j, sub, _span, state = item
+                fam = state[3]
+                model = StepFaultModel(sub, rank_map=fam.plan.reps)
+                if model._deaths:
+                    scalar.append((item, "deaths"))
+                    continue
+                prog = self._lowered(fam)
+                if isinstance(prog, str):
+                    scalar.append((item, prog))
+                    continue
+                groups.setdefault(id(fam), (fam, prog, []))[2].append(
+                    (item, model))
+            if backend == "auto":
+                floor = (self.options.jit_batch_min
+                         or _batched_replay().JIT_BATCH_MIN)
+                for gid in list(groups):
+                    members = groups[gid][2]
+                    if len(members) < floor:
+                        scalar.extend(
+                            (it, "small_batch") for it, _m in members)
+                        del groups[gid]
+        self._solve_groups(groups, outs)
+        # scalar loop with a staleness retry: "no_streams" is the one
+        # fallback a scalar replay CURES (the first sim of a stage
+        # records its stream sources), so every later no_streams item
+        # in the same round re-attempts lowering and rejoins a batched
+        # group instead of walking the engine — one recorder per
+        # stage, not one per scenario
+        retry: Dict[int, Tuple[_StepFamily, Any, list]] = {}
+        for item, reason in scalar:
+            j, sub, _span, state = item
+            if reason == "no_streams":
+                fam = state[3]
+                prog = self._lowered(fam)
+                if not isinstance(prog, str):
+                    model = StepFaultModel(sub, rank_map=fam.plan.reps)
+                    retry.setdefault(id(fam), (fam, prog, []))[2].append(
+                        (item, model))
+                    continue
+            self._count_fallback(reason)
+            outs[j] = self._step_commit(state,
+                                        self._replay(sub, state[3]))
+        if retry and backend == "auto":
+            floor = (self.options.jit_batch_min
+                     or _batched_replay().JIT_BATCH_MIN)
+            for gid in list(retry):
+                members = retry[gid][2]
+                if len(members) < floor:
+                    for it, _m in members:
+                        j, sub, _span, state = it
+                        self._count_fallback("small_batch")
+                        outs[j] = self._step_commit(
+                            state, self._replay(sub, state[3]))
+                    del retry[gid]
+        self._solve_groups(retry, outs)
+
+    def _solve_groups(self, groups: Dict[int, Tuple["_StepFamily",
+                                                    Any, list]],
+                      outs: List[Any]):
+        """Solve per-family miss groups in one vmapped call each and
+        commit the makespans through the scalar engine's exact
+        ``(raw * ratio, None, raw)`` tail."""
+        if not groups:
+            return
+        ratio = self.healthy()["straggle_ratio"]
+        br = _batched_replay()
+        for fam, prog, members in groups.values():
+            raws = br.solve_batch(prog, [m for _it, m in members])
+            self.stats["batched"] += len(members)
+            self._c_batched.inc(len(members))
+            self.stats["replays"] += len(members)
+            for (item, _m), raw in zip(members, raws):
+                j, _sub, _span, state = item
+                raw_end = float(raw)
+                outs[j] = self._step_commit(
+                    state, (raw_end * ratio, None, raw_end))
 
     # -- (d) parallel merge-back -------------------------------------------
     def absorb_stats(self, delta: Dict[str, int]):
@@ -1685,10 +1930,34 @@ def predict_goodput(
 
 def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
                   reduce, max_restarts, _cache, ctx) -> GoodputReport:
+    """Drive one scenario's walk generator serially, answering each
+    step request as it arrives — behaviorally identical to the
+    pre-generator inline walk. The generator split exists so the
+    lockstep driver (:func:`_predict_goodput_batch`) can advance many
+    walks in rounds and feed whole miss batches to the batched replay
+    backend."""
+    cache = _cache if _cache is not None else {}
+    gen = _walk_gen(scenario, spec, ckpt, healthy, max_restarts)
+    ans = None
+    while True:
+        try:
+            sub, span = gen.send(ans)
+        except StopIteration as stop:
+            return stop.value
+        if ctx is not None:
+            ans = ctx.simulate_step(sub, span)
+        else:
+            ans = _simulate_step(perf, sub, cache, granularity, reduce)
+
+
+def _walk_gen(scenario, spec, ckpt, healthy, max_restarts):
+    """The goodput walk as a coroutine: yields ``(sub, span_s)`` step
+    requests, receives ``(dur, death)`` answers, and returns the
+    finished :class:`GoodputReport` (via ``StopIteration.value``).
+    Pure bookkeeping — every simulation happens in the driver."""
     h = healthy["end_time"]
     horizon = scenario.horizon_steps
     interval = spec.interval_steps
-    cache = _cache if _cache is not None else {}
     b = GoodputBuckets()
     wall = 0.0
     committed = 0
@@ -1741,12 +2010,7 @@ def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
             if sub.empty:
                 dur, death = h, None
                 break
-            if ctx is not None:
-                dur, death = ctx.simulate_step(sub, span)
-            else:
-                dur, death = _simulate_step(
-                    perf, sub, cache, granularity, reduce
-                )
+            dur, death = yield (sub, span)
             if death is not None or dur <= span * (1 + 1e-12):
                 break
             span = dur
@@ -1794,6 +2058,51 @@ def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
         checkpoint=ckpt.to_dict(),
         truncated=truncated,
     )
+
+
+def _predict_goodput_batch(ctx: ReplayContext,
+                           tasks: List[Tuple[FaultScenario,
+                                             CheckpointSpec]],
+                           max_restarts: int = 1000
+                           ) -> List[GoodputReport]:
+    """Lockstep twin of calling :func:`predict_goodput` serially on
+    ``tasks`` with a shared context: every walk advances one step per
+    round, and the round's step requests are answered together by
+    :meth:`ReplayContext.simulate_step_batch`, so the batched replay
+    backend sees whole miss batches instead of one miss at a time.
+    Reports are bit-identical to the serial loop — every cache layer
+    guarantees cached == computed, so answer order cannot change a
+    number, only which request pays for the simulation."""
+    from simumax_tpu.observe.telemetry import get_tracer
+
+    healthy = ctx.healthy()
+    results: List[Any] = [None] * len(tasks)
+    walks = []
+    with get_tracer().span("predict_goodput_batch", walks=len(tasks),
+                           incremental=True):
+        for scenario, spec in tasks:
+            ctx.validate_scenario(scenario)
+            ctx.stats["scenarios"] += 1
+            ctx._c_scenarios.inc()
+            ckpt = ctx.checkpoint_model(spec)
+            walks.append(_walk_gen(scenario, spec, ckpt, healthy,
+                                   max_restarts))
+        pend: Dict[int, tuple] = {}
+        for i, gen in enumerate(walks):
+            try:
+                pend[i] = gen.send(None)
+            except StopIteration as stop:
+                results[i] = stop.value
+        while pend:
+            order = sorted(pend)
+            answers = ctx.simulate_step_batch([pend[i] for i in order])
+            for i, ans in zip(order, answers):
+                try:
+                    pend[i] = walks[i].send(ans)
+                except StopIteration as stop:
+                    results[i] = stop.value
+                    del pend[i]
+    return results
 
 
 # --------------------------------------------------------------------------
@@ -1923,6 +2232,14 @@ def analyze_faults(
         for _ in range(n_scenarios)
     ]
     parallel = ctx is not None and jobs > 1 and len(scenarios) > 1
+    # lockstep batching: advance every scenario walk in rounds so the
+    # batched replay backend sees whole miss batches. Off under a
+    # per-scenario deadline (SIGALRM scopes one walk, not a round) and
+    # under replay_backend="numpy" (nothing to batch)
+    lockstep = (ctx is not None and not parallel
+                and scenario_timeout is None
+                and ctx.options.replay_backend != "numpy"
+                and len(scenarios) > 1)
     env = None
     if parallel:
         env = (perf.strategy, perf.model_config, perf.system,
@@ -1943,6 +2260,11 @@ def analyze_faults(
                  for i, s in enumerate(scenarios)],
             )
             report_dicts = [got[i] for i in range(len(scenarios))]
+        elif lockstep:
+            report_dicts = [
+                r.to_dict() for r in _predict_goodput_batch(
+                    ctx, [(s, spec) for s in scenarios])
+            ]
         else:
             report_dicts = []
             for i, s in enumerate(scenarios):
@@ -1995,19 +2317,31 @@ def analyze_faults(
                 )
                 for k in pending
             }
-            for i, s in enumerate(scenarios):
-                per: Dict[int, float] = {}
-                for k in pending:
-                    k_spec = k_specs[k]
-                    with _deadline(scenario_timeout,
-                                   f"scenario[{i}]@interval{k}"):
-                        per[int(k)] = predict_goodput(
-                            perf, s, spec=k_spec,
-                            granularity=granularity, reduce=reduce,
-                            _cache=cache,
-                            incremental=ctx is not None, _ctx=ctx,
-                        ).goodput
-                grid_vals[i] = per
+            if lockstep:
+                reports = _predict_goodput_batch(
+                    ctx,
+                    [(s, k_specs[k]) for s in scenarios
+                     for k in pending],
+                )
+                for i in range(len(scenarios)):
+                    grid_vals[i] = {
+                        int(k): reports[i * len(pending) + p].goodput
+                        for p, k in enumerate(pending)
+                    }
+            else:
+                for i, s in enumerate(scenarios):
+                    per: Dict[int, float] = {}
+                    for k in pending:
+                        k_spec = k_specs[k]
+                        with _deadline(scenario_timeout,
+                                       f"scenario[{i}]@interval{k}"):
+                            per[int(k)] = predict_goodput(
+                                perf, s, spec=k_spec,
+                                granularity=granularity, reduce=reduce,
+                                _cache=cache,
+                                incremental=ctx is not None, _ctx=ctx,
+                            ).goodput
+                    grid_vals[i] = per
         by_interval: Dict[int, float] = {}
         for k in intervals:
             k = int(k)

@@ -35,6 +35,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from simumax_tpu.parallel.mesh import rank_coords, rank_groups
 
 
@@ -225,45 +227,72 @@ def build_reduction(st, perturbation: Optional[dict] = None,
         structure = reduction_structure(st)
     memberships, stages, nxt, prv, dims = structure
 
-    # color refinement to fixpoint. Group color tuples are computed
-    # once per shared group object per iteration (members reference
-    # the same list), not once per member — at pod scale the dp_cp
-    # buckets alone are 16+ members wide and this is the refinement's
-    # dominant cost.
-    color = [
-        (stages[r], float(perturbation.get(r, 1.0)), signatures.get(r))
-        for r in range(n)
-    ]
-    canon: Dict[tuple, int] = {}
-    colors_out: List[int] = [0] * n
+    # color refinement to fixpoint, vectorized. Color ids reach the
+    # next iteration only through EQUALITY (the final plan groups by
+    # partition and orders classes by smallest member), so any id
+    # labeling that induces the same partition yields the same plan —
+    # np.unique's sorted labeling is as good as first-occurrence, and
+    # the partition sequence (hence the stop iteration and the final
+    # partition) is identical to the scalar refinement's.
+    #
+    # Structure prep (per call, not per iteration): each dim becomes a
+    # per-rank group index plus a padded member matrix; a group's color
+    # signature is the row of member colors in group order, padded with
+    # -2 (never a color id), so ragged groups can't collide.
+    init: Dict[tuple, int] = {}
+    color = np.empty(n, dtype=np.int64)
+    for r in range(n):
+        key = (stages[r], float(perturbation.get(r, 1.0)),
+               signatures.get(r))
+        c = init.get(key)
+        if c is None:
+            c = init[key] = len(init)
+        color[r] = c
+    dim_gids: List[np.ndarray] = []
+    dim_members: List[np.ndarray] = []
+    for dim in dims:
+        byrank = memberships[dim]
+        gid = np.full(n, -1, dtype=np.int64)
+        groups_seen: Dict[int, int] = {}
+        rows: List[List[int]] = []
+        for r in range(n):
+            grp = byrank.get(r)
+            if grp is None:
+                continue
+            g = groups_seen.get(id(grp))
+            if g is None:
+                g = groups_seen[id(grp)] = len(rows)
+                rows.append(grp)
+            gid[r] = g
+        lmax = max((len(g) for g in rows), default=1)
+        members = np.full((max(len(rows), 1), lmax), n, dtype=np.int64)
+        for g, grp in enumerate(rows):
+            members[g, : len(grp)] = grp
+        dim_gids.append(gid)
+        dim_members.append(members)
+    nxt_a = np.asarray(nxt, dtype=np.int64) if pp > 1 else None
+    prv_a = np.asarray(prv, dtype=np.int64) if pp > 1 else None
+
     n_colors = 0
     while True:
-        canon.clear()
-        group_colors: Dict[int, tuple] = {}
-        for r in range(n):
-            sig = [color[r]]
-            for dim in dims:
-                grp = memberships[dim].get(r)
-                if grp is not None:
-                    gc = group_colors.get(id(grp))
-                    if gc is None:
-                        gc = tuple(color[p] for p in grp)
-                        group_colors[id(grp)] = gc
-                    sig.append(gc)
-                else:
-                    sig.append(None)
-            if pp > 1:
-                sig.append(color[nxt[r]])
-                sig.append(color[prv[r]])
-            key = tuple(sig)
-            c = canon.get(key)
-            if c is None:
-                c = canon[key] = len(canon)
-            colors_out[r] = c
-        if len(canon) == n_colors:
+        cols = [color]
+        color_ext = np.append(color, -2)  # pad slot n -> sentinel
+        for gid, members in zip(dim_gids, dim_members):
+            _, guid = np.unique(color_ext[members], axis=0,
+                                return_inverse=True)
+            # rank not in any group of this dim -> -1 (never equal to
+            # a group id), matching the scalar refinement's None
+            cols.append(np.append(guid.ravel(), -1)[gid])
+        if pp > 1:
+            cols.append(color[nxt_a])
+            cols.append(color[prv_a])
+        sig = np.stack(cols, axis=1)
+        uniq, inv = np.unique(sig, axis=0, return_inverse=True)
+        colors_out = inv.ravel()
+        if len(uniq) == n_colors:
             break
-        n_colors = len(canon)
-        color = list(colors_out)
+        n_colors = len(uniq)
+        color = colors_out
 
     # classes ordered by smallest member (deterministic representative)
     members_by_color: Dict[int, List[int]] = {}

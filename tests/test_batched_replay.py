@@ -1,0 +1,284 @@
+"""Batched scenario replay (ISSUE 17): the vmapped JAX array program
+that serves incremental-replay cache misses must be **byte-identical**
+to the scalar engine on the full chaos grid (dense/MoE/MLA x pp{1,2,4}
+x slowdown/preemption/link-degradation), every fallback path must be
+counted *and* land on the same numbers, and the padded-shape compile
+cache must actually be reused across calls."""
+
+import copy
+import json
+import random
+
+import pytest
+
+from simumax_tpu import PerfLLM
+from simumax_tpu.core.config import (
+    get_model_config,
+    get_strategy_config,
+)
+from simumax_tpu.simulator import batched_replay as br
+from simumax_tpu.simulator.faults import (
+    CheckpointSpec,
+    FaultEvent,
+    FaultScenario,
+    ReplayContext,
+    ReplayOptions,
+    _predict_goodput_batch,
+    predict_goodput,
+    sample_scenario,
+)
+
+needs_jax = pytest.mark.skipif(
+    not br.jax_available(),
+    reason="the batched backend needs an importable jax",
+)
+
+SIM = dict(world_ranks=True, granularity="chunk", track_memory=False)
+
+SPEC = CheckpointSpec(interval_steps=2, restart_overhead_s=2.0)
+
+#: the test_faults.py chaos grid, unchanged: dense / MoE / MLA x
+#: pp {1, 2, 4} at world 8-16
+GRID = {
+    "dense-pp1": dict(model="llama2-tiny", tp=2, pp=1, world=8),
+    "dense-pp2": dict(model="llama2-tiny", tp=2, pp=2, world=8, mbc=4),
+    "dense-pp4": dict(model="llama2-tiny", tp=2, pp=4, world=16,
+                      layers=4, mbc=4),
+    "moe-pp1": dict(model="mixtral-8x1b", ep=2, pp=1, world=8, layers=4),
+    "moe-pp2": dict(model="mixtral-8x1b", ep=2, pp=2, world=8, layers=4,
+                    mbc=4),
+    "moe-pp4": dict(model="mixtral-8x1b", ep=2, pp=4, world=8, layers=4,
+                    mbc=4),
+    "mla-pp1": dict(model="deepseekv2-lite", ep=2, pp=1, world=8,
+                    layers=4, dense_layers=0, system="tpu_v5p_256"),
+    "mla-pp2": dict(model="deepseekv2-lite", ep=2, pp=2, world=8,
+                    layers=4, dense_layers=0, mbc=4,
+                    system="tpu_v5p_256"),
+    "mla-pp4": dict(model="deepseekv2-lite", ep=2, pp=4, world=8,
+                    layers=4, dense_layers=0, mbc=4,
+                    system="tpu_v5p_256"),
+}
+
+
+def build_perf(model="llama2-tiny", tp=1, pp=2, ep=1, world=8, mbc=4,
+               layers=None, dense_layers=None, system="tpu_v5e_256"):
+    m = get_model_config(model)
+    if layers is not None or dense_layers is not None:
+        m = copy.deepcopy(m)
+        if layers is not None:
+            m.layer_num = layers
+        if dense_layers is not None:
+            m.dense_layers = dense_layers
+    st = get_strategy_config("tp1_pp1_dp8_mbs1")
+    st.world_size = world
+    st.tp_size = tp
+    st.pp_size = pp
+    st.ep_size = ep
+    st.micro_batch_num = mbc
+    st.__post_init__()
+    p = PerfLLM().configure(st, m, system)
+    p.run_estimate()
+    return p
+
+
+_cache = {}
+
+
+def _perf(key):
+    if key not in _cache:
+        p = build_perf(**GRID[key])
+        _cache[key] = (p, p.simulate(None, **SIM))
+    return _cache[key]
+
+
+def _report(p, sc, **kw):
+    return predict_goodput(p, sc, spec=SPEC, **kw).to_dict()
+
+
+@pytest.fixture(scope="module")
+def perf():
+    return _perf("dense-pp2")[0]
+
+
+@needs_jax
+class TestChaosGridByteEquality:
+    @pytest.mark.parametrize("key", sorted(GRID))
+    def test_backends_byte_identical(self, key):
+        """numpy backend == jax backend == exact (incremental=False)
+        on seeded random scenarios, byte-equal after a sorted json
+        round-trip. Both incremental backends run through the LOCKSTEP
+        batch driver (the analyze_faults/fleet path), so the jax
+        context sees whole miss batches — a serial walk would answer
+        misses one at a time and never exercise the vmapped kernel.
+        The exact path walks the full unreduced world, so equality
+        covers reduce=auto against reduce=exact too."""
+        p, healthy = _perf(key)
+        world = p.strategy.world_size
+        scs = []
+        for seed in range(3):
+            rng = random.Random(
+                sum(ord(c) for c in key) * 7919 + seed
+            )
+            scs.append(sample_scenario(
+                rng, world, healthy["end_time_ms"] * 6,
+                horizon_steps=4, seed=seed,
+            ))
+        exact = [_report(p, sc, incremental=False) for sc in scs]
+        exact_bytes = [json.dumps(e, sort_keys=True) for e in exact]
+        for name in ("numpy", "jax"):
+            ctx = ReplayContext(p, options=ReplayOptions(
+                replay_backend=name))
+            got = _predict_goodput_batch(
+                ctx, [(sc, SPEC) for sc in scs])
+            for seed, (g, eb) in enumerate(zip(got, exact_bytes)):
+                assert g.to_dict() == exact[seed], (key, seed, name)
+                assert json.dumps(
+                    g.to_dict(), sort_keys=True) == eb, \
+                    (key, seed, name)
+
+    @pytest.mark.parametrize("key", ("dense-pp2", "moe-pp2", "mla-pp2"))
+    @pytest.mark.parametrize("kind", ("slowdown", "preemption",
+                                      "link_degradation"))
+    def test_single_kind_padded_shapes(self, key, kind):
+        """One fault kind at a time pins the padded-shape edge cases:
+        slowdown/preemption-only scenarios lower with ZERO link
+        buckets (ep=0), link-only scenarios with ZERO per-rank window
+        buckets (wp=0) — the collapsed buckets must still replay
+        byte-identically."""
+        p, healthy = _perf(key)
+        h_ms = healthy["end_time_ms"]
+        if kind == "slowdown":
+            events = [FaultEvent("slowdown", h_ms * 0.1,
+                                 duration_ms=h_ms * 2.0, rank=1,
+                                 multiplier=2.5)]
+        elif kind == "preemption":
+            events = [FaultEvent("preemption", h_ms * 0.2,
+                                 duration_ms=h_ms * 0.7, rank=2)]
+        else:
+            events = [FaultEvent("link_degradation", 0.0,
+                                 duration_ms=h_ms * 3.0, dim="pp",
+                                 multiplier=4.0)]
+        sc = FaultScenario(events, horizon_steps=3)
+        exact = _report(p, sc, incremental=False)
+        got = _report(p, sc, _ctx=ReplayContext(
+            p, options=ReplayOptions(replay_backend="jax")))
+        assert got == exact, (key, kind)
+        assert json.dumps(got, sort_keys=True) == json.dumps(
+            exact, sort_keys=True), (key, kind)
+
+
+@needs_jax
+class TestFallbackPaths:
+    """Every fallback is (a) counted under its reason and (b) lands on
+    numbers identical to the numpy backend — a fallback is a perf
+    event, never a correctness event."""
+
+    def _scenarios(self, p, healthy, with_death=False, n=4):
+        """Distinct (non-symmetric) scenarios, so their misses cannot
+        dedupe into one and a whole batch reaches the dispatcher."""
+        h_ms = healthy["end_time_ms"]
+        out = []
+        for i in range(n):
+            events = [FaultEvent("slowdown", h_ms * 0.1 * (i + 1),
+                                 duration_ms=h_ms * 4.0, rank=1,
+                                 multiplier=2.0 + i)]
+            if with_death:
+                events.append(FaultEvent("rank_death",
+                                         h_ms * (1.5 + 0.3 * i),
+                                         rank=3))
+            out.append(FaultScenario(events, horizon_steps=4))
+        return out
+
+    def _batch(self, p, scenarios, options):
+        """Drive the miss-batch dispatcher the way analyze_faults and
+        the fleet do: every walk advances in lockstep, so the round's
+        misses arrive as one batch."""
+        ctx = ReplayContext(p, options=options)
+        reports = _predict_goodput_batch(
+            ctx, [(sc, SPEC) for sc in scenarios])
+        return ctx, [r.to_dict() for r in reports]
+
+    def _exact(self, p, scenarios):
+        return [_report(p, sc, incremental=False) for sc in scenarios]
+
+    def test_deaths_fall_back_per_scenario(self):
+        p, healthy = _perf("dense-pp2")
+        scs = self._scenarios(p, healthy, with_death=True)
+        ctx, got = self._batch(
+            p, scs, ReplayOptions(replay_backend="jax"))
+        assert got == self._exact(p, scs)
+        assert ctx.stats.get("fallback_deaths", 0) > 0
+
+    def test_backend_numpy_counts_and_never_batches(self):
+        p, healthy = _perf("dense-pp2")
+        scs = self._scenarios(p, healthy)
+        ctx, got = self._batch(
+            p, scs, ReplayOptions(replay_backend="numpy"))
+        assert got == self._exact(p, scs)
+        assert ctx.stats.get("batched", 0) == 0
+        assert ctx.stats.get("fallback_backend_numpy", 0) > 0
+
+    def test_auto_small_batch_floor(self):
+        """auto mode with an unreachable dispatch floor demotes every
+        would-be batch to the scalar engine with a counted
+        ``small_batch`` reason — and stays byte-identical."""
+        p, healthy = _perf("dense-pp2")
+        scs = self._scenarios(p, healthy)
+        ctx, got = self._batch(
+            p, scs,
+            ReplayOptions(replay_backend="auto", jit_batch_min=10**6))
+        assert got == self._exact(p, scs)
+        assert ctx.stats.get("batched", 0) == 0
+        assert ctx.stats.get("fallback_small_batch", 0) > 0
+
+    def test_jax_unavailable_counts(self, monkeypatch):
+        p, healthy = _perf("dense-pp2")
+        scs = self._scenarios(p, healthy)
+        monkeypatch.setattr(br, "jax_available", lambda: False)
+        ctx, got = self._batch(
+            p, scs, ReplayOptions(replay_backend="auto"))
+        assert got == self._exact(p, scs)
+        assert ctx.stats.get("batched", 0) == 0
+        assert ctx.stats.get("fallback_jax_unavailable", 0) > 0
+
+    def test_fallback_reasons_closed_catalogue(self):
+        """Every fallback_* stat key a context can emit is in the
+        published FALLBACK_REASONS catalogue (the telemetry label
+        vocabulary is closed)."""
+        for reason in ("deaths", "sendrecv", "unknown_kind",
+                       "no_streams", "lowering_error",
+                       "jax_unavailable", "small_batch",
+                       "backend_numpy"):
+            assert reason in br.FALLBACK_REASONS
+
+
+@needs_jax
+class TestBatchedLiveness:
+    def test_analyze_faults_batches_and_matches_exact(self, perf):
+        """End to end through analyze_faults: the jax backend must
+        actually serve misses batched (liveness, not a vacuous
+        all-fallback pass) and the analysis must equal the exact
+        scalar path."""
+        kw = dict(n_scenarios=6, seed=13, horizon_steps=5, spec=SPEC)
+        exact = perf.analyze_faults(incremental=False, **kw)
+        ctx = ReplayContext(perf, options=ReplayOptions(
+            replay_backend="jax"))
+        got = perf.analyze_faults(_ctx=ctx, **kw)
+        assert got == exact
+        assert ctx.stats.get("batched", 0) > 0
+
+    def test_compile_cache_reused_across_contexts(self, perf):
+        """The padded-shape compile cache is module-level: a second
+        analysis at the same workload shape must add ZERO newly
+        compiled shapes (recompilation would silently eat the batched
+        speedup)."""
+        kw = dict(n_scenarios=4, seed=21, horizon_steps=4, spec=SPEC)
+        opts = ReplayOptions(replay_backend="jax")
+        perf.analyze_faults(_ctx=ReplayContext(perf, options=opts),
+                            **kw)
+        before = br.compile_cache_info()["compiled_shapes"]
+        assert before >= 1
+        ctx = ReplayContext(perf, options=opts)
+        perf.analyze_faults(_ctx=ctx, **kw)
+        assert br.compile_cache_info()["compiled_shapes"] == before
+        assert ctx.stats.get("batched", 0) > 0

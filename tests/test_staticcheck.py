@@ -37,7 +37,7 @@ from tools.staticcheck.checkers import REGISTRY  # noqa: E402
 from tools import lint as lint_mod  # noqa: E402
 
 ALL_IDS = {"SIM001", "SIM002", "SIM003", "SIM004", "SIM005", "SIM006",
-           "SIM007"}
+           "SIM007", "SIM008"}
 
 
 # --------------------------------------------------------------------------
@@ -808,6 +808,92 @@ class TestSIM007Fixture:
                 "    registry.counter('rogue_total').inc()\n",
         })
         report = run(paths=["simumax_tpu"], select=["SIM007"],
+                     root=str(tmp_path))
+        assert report.findings == []
+
+
+SIM008_ENGINE = """\
+def _try_serve(self, rank):
+    req = self._pending[rank]
+    kind = req[0]
+    if kind == "compute":
+        return True
+    if kind == "sendrecv":
+        return True
+    return False
+"""
+
+SIM008_BATCHED = """\
+LOWERED_REQUEST_KINDS = {
+    "compute": (1,),
+}
+FALLBACK_REQUEST_KINDS = {
+    "sendrecv": "order-dependent completion",
+}
+"""
+
+
+class TestSIM008Fixture:
+    def _run(self, tmp_path, engine=SIM008_ENGINE,
+             batched=SIM008_BATCHED):
+        write_tree(tmp_path, {
+            "simumax_tpu/simulator/engine.py": engine,
+            "simumax_tpu/simulator/batched_replay.py": batched,
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM008"],
+                     root=str(tmp_path))
+        return report.findings
+
+    def test_covered_vocabulary_is_clean(self, tmp_path):
+        assert self._run(tmp_path) == []
+
+    def test_unlisted_served_kind_fires(self, tmp_path):
+        engine = SIM008_ENGINE + (
+            '    if kind == "barrier":\n'
+            "        return True\n"
+        )
+        found = self._run(tmp_path, engine=engine)
+        assert len(found) == 1
+        assert "'barrier'" in found[0].message
+        assert found[0].path == "simumax_tpu/simulator/engine.py"
+
+    def test_stream_head_comparison_counts_as_served(self, tmp_path):
+        # req[0] == "..." in the replay paths is part of the served
+        # vocabulary even without a `kind` binding
+        engine = SIM008_ENGINE + (
+            'def replay(req):\n'
+            '    return req[0] == "advance_rel"\n'
+        )
+        found = self._run(tmp_path, engine=engine)
+        assert len(found) == 1
+        assert "'advance_rel'" in found[0].message
+
+    def test_stale_table_entry_fires(self, tmp_path):
+        batched = SIM008_BATCHED.replace(
+            '    "compute": (1,),',
+            '    "compute": (1,),\n    "teleport": (2,),',
+        )
+        found = self._run(tmp_path, batched=batched)
+        assert len(found) == 1
+        assert "stale replay-drift entry 'teleport'" in found[0].message
+        assert found[0].path == "simumax_tpu/simulator/batched_replay.py"
+
+    def test_kind_in_both_tables_fires(self, tmp_path):
+        batched = SIM008_BATCHED.replace(
+            '    "sendrecv": "order-dependent completion",',
+            '    "sendrecv": "order-dependent completion",\n'
+            '    "compute": "shadowed",',
+        )
+        found = self._run(tmp_path, batched=batched)
+        assert len(found) == 1
+        assert "both LOWERED_REQUEST_KINDS and FALLBACK_REQUEST_KINDS" \
+            in found[0].message
+
+    def test_tree_without_batched_replay_is_out_of_scope(self, tmp_path):
+        write_tree(tmp_path, {
+            "simumax_tpu/simulator/engine.py": SIM008_ENGINE,
+        })
+        report = run(paths=["simumax_tpu"], select=["SIM008"],
                      root=str(tmp_path))
         assert report.findings == []
 

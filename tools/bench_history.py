@@ -80,7 +80,8 @@ LOWER_IS_BETTER = {
 VARIANT_KEYS = ("engine", "grid", "mode", "granularity", "world",
                 "mbc", "queries", "overlap", "threads", "trace",
                 "critical_path", "workers", "admission",
-                "client_procs", "pipeline", "n_jobs", "templates")
+                "client_procs", "pipeline", "n_jobs", "templates",
+                "replay_backend")
 
 
 def variant_of(result: Dict[str, Any]) -> str:

@@ -13,6 +13,7 @@ from tools.staticcheck.checkers import (
     discipline,
     error_taxonomy,
     metric_names,
+    replay_drift,
 )
 
 ALL_CHECKERS = (
@@ -23,6 +24,7 @@ ALL_CHECKERS = (
     discipline.CHECKER,      # SIM005
     collectives.CHECKER,     # SIM006
     metric_names.CHECKER,    # SIM007
+    replay_drift.CHECKER,    # SIM008
 )
 
 REGISTRY = {c.id: c for c in ALL_CHECKERS}

@@ -47,6 +47,7 @@ SCOPE = (
     "simumax_tpu/parallel/",
     "simumax_tpu/models/",
     "simumax_tpu/simulator/reduce.py",
+    "simumax_tpu/simulator/batched_replay.py",
 )
 
 #: module-level draws on the process-global RNG

@@ -1,0 +1,156 @@
+"""SIM008 — batched-replay kind drift.
+
+The batched replay backend (``simulator/batched_replay.py``) lowers the
+scalar engine's recorded request streams into a fixed-shape array
+program, and stays honest through two closed tables:
+``LOWERED_REQUEST_KINDS`` (kinds it compiles) and
+``FALLBACK_REQUEST_KINDS`` (kinds it deliberately routes back to the
+scalar engine, each with a written justification). A request kind the
+scalar engine starts serving that reaches *neither* table is the exact
+drift the bit-identity benches cannot catch cheaply: every scenario
+whose stream contains the new kind silently falls back with reason
+``unknown_kind``, the oracle still passes (the fallback IS the scalar
+engine), and the advertised batched speedup quietly erodes until
+someone reads the fallback histogram.
+
+The checker computes, purely from the ASTs:
+
+* the **served vocabulary** — every string literal the engine compares
+  against a request kind (``kind == "..."`` in ``_try_serve`` and the
+  dependency scan, ``req[0] == "..."`` in the replay-stream paths of
+  ``simulator/engine.py``);
+* the **lowering surface** — the string keys of the
+  ``LOWERED_REQUEST_KINDS`` and ``FALLBACK_REQUEST_KINDS`` dict
+  literals in ``simulator/batched_replay.py``.
+
+Every served kind must appear in exactly one of the two tables. A kind
+in neither is a drift finding; a table entry the engine no longer
+serves is a stale finding; a kind in both tables is ambiguous (the
+lowering would shadow the justified fallback) and is reported too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Tuple
+
+from tools.staticcheck.core import Finding, Project
+
+ID = "SIM008"
+
+ENGINE_REL = "simumax_tpu/simulator/engine.py"
+BATCHED_REL = "simumax_tpu/simulator/batched_replay.py"
+
+#: the dict literals that form the lowering surface
+TABLE_NAMES = ("LOWERED_REQUEST_KINDS", "FALLBACK_REQUEST_KINDS")
+
+
+def _is_kind_ref(node: ast.AST) -> bool:
+    """Whether an expression denotes a request kind: the ``kind``
+    binding itself, or the head slot of a request tuple (``req[0]``,
+    ``stream[i][0]`` — any subscript by literal 0)."""
+    if isinstance(node, ast.Name) and node.id == "kind":
+        return True
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and sl.value == 0
+    return False
+
+
+def _served_kinds(engine_tree: ast.AST) -> Dict[str, int]:
+    """kind string -> first line where the engine compares against it.
+    Receiver-shape-blind beyond the two forms above on purpose: a
+    same-shaped comparison elsewhere over-approximates, which can only
+    add coverage obligations, never hide one."""
+    served: Dict[str, int] = {}
+    for node in ast.walk(engine_tree):
+        if not (isinstance(node, ast.Compare)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Eq, ast.NotEq))):
+            continue
+        left, right = node.left, node.comparators[0]
+        for ref, lit in ((left, right), (right, left)):
+            if _is_kind_ref(ref) and isinstance(lit, ast.Constant) \
+                    and isinstance(lit.value, str):
+                line = served.get(lit.value)
+                if line is None or node.lineno < line:
+                    served[lit.value] = node.lineno
+    return served
+
+
+def _table_keys(batched_tree: ast.AST,
+                name: str) -> Dict[str, int]:
+    """String keys (with lines) of a module-level dict literal
+    assignment to ``name`` (plain or annotated assignment)."""
+    keys: Dict[str, int] = {}
+    for node in ast.walk(batched_tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str):
+                    keys.setdefault(k.value, k.lineno)
+    return keys
+
+
+class ReplayDriftChecker:
+    id = ID
+    name = "batched-replay-drift"
+    doc = ("every request kind the scalar engine serves appears in "
+           "batched_replay.py's lowering table or its justified "
+           "fallback list; stale or double entries are findings")
+
+    def check(self, project: Project):
+        engine = project.find(ENGINE_REL)
+        batched = project.find(BATCHED_REL)
+        if engine is None or engine.tree is None \
+                or batched is None or batched.tree is None:
+            return
+        served = _served_kinds(engine.tree)
+        tables: Dict[str, Dict[str, int]] = {
+            name: _table_keys(batched.tree, name)
+            for name in TABLE_NAMES
+        }
+        covered: Dict[str, Tuple[str, int]] = {}
+        for name in TABLE_NAMES:
+            for kind, lineno in tables[name].items():
+                if kind in covered:
+                    yield Finding(
+                        ID, BATCHED_REL, lineno,
+                        f"request kind {kind!r} appears in both "
+                        f"{covered[kind][0]} and {name} — the lowering "
+                        f"would shadow the justified fallback; keep "
+                        f"exactly one entry",
+                    )
+                else:
+                    covered[kind] = (name, lineno)
+        for kind in sorted(set(served) - set(covered)):
+            yield Finding(
+                ID, ENGINE_REL, served[kind],
+                f"request kind {kind!r} is served by the scalar engine "
+                f"but appears in neither LOWERED_REQUEST_KINDS nor "
+                f"FALLBACK_REQUEST_KINDS — the batched backend would "
+                f"silently fall back with reason 'unknown_kind' on "
+                f"every stream containing it. Lower it, or list it in "
+                f"FALLBACK_REQUEST_KINDS with a justification "
+                f"(simumax_tpu/simulator/batched_replay.py)",
+            )
+        for kind in sorted(set(covered) - set(served)):
+            name, lineno = covered[kind]
+            yield Finding(
+                ID, BATCHED_REL, lineno,
+                f"stale replay-drift entry {kind!r} in {name}: the "
+                f"scalar engine no longer serves this request kind — "
+                f"remove the entry",
+            )
+
+
+CHECKER = ReplayDriftChecker()
