@@ -68,10 +68,10 @@ from simumax_tpu.perf import PerfLLM
 from simumax_tpu.simulator.faults import ReplayContext, ReplayOptions
 
 
-def _compile_cache_shapes() -> int:
+def _compile_cache_info() -> dict:
     from simumax_tpu.simulator.batched_replay import compile_cache_info
 
-    return compile_cache_info()["compiled_shapes"]
+    return compile_cache_info()
 
 
 def build_perf(world: int, mbc: int):
@@ -207,7 +207,8 @@ def main(argv=None):
         "fallback_rate": round(
             fb_total / max(1, stats.get("batched", 0) + fb_total), 4
         ),
-        "compiled_shapes": _compile_cache_shapes(),
+        "compiled_shapes": _compile_cache_info()["compiled_shapes"],
+        "compile_cache_capacity": _compile_cache_info()["capacity"],
     }
     ok = True
     if args.max_fallback_rate:

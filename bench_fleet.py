@@ -127,6 +127,22 @@ def main(argv=None):
              "miss replays fell back to the scalar engine "
              "(0 disables; counted per reason in the JSON line)",
     )
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="also time an --explain walk (fleet forensics, "
+             "observe/fleetledger.py) and gate it: base payload "
+             "byte-identical to the plain walk, per-job + fleet "
+             "attribution buckets sum to wall within 1e-6, the fleet "
+             "Chrome trace passes the test_trace_validity checks, "
+             "and the attribution overhead stays bounded",
+    )
+    ap.add_argument(
+        "--max-explain-overhead", type=float, default=0.15,
+        metavar="FRAC",
+        help="with --explain: fail when the explain walk takes more "
+             "than this fraction longer than the plain walk "
+             "(default 0.15, the PR-7 observability discipline)",
+    )
     args = ap.parse_args(argv)
     options = ReplayOptions(replay_backend=args.replay_backend)
 
@@ -237,6 +253,68 @@ def main(argv=None):
         result["parallel_identical"] = report == par_report
         if not result["parallel_identical"]:
             ok = False
+    if args.explain:
+        from simumax_tpu.observe.fleetledger import (
+            FLEET_LEDGER_ORDER,
+            build_fleet_explain,
+            fleet_chrome_trace,
+        )
+
+        # same protocol as the plain measurement: fresh simulator per
+        # rep, prepare() untimed, fastest rep recorded — the delta
+        # isolates the attribution work, not process-cache warmup
+        ex_elapsed = None
+        ex_report = None
+        for _ in range(max(1, args.reps)):
+            ex_sim = FleetSimulator(copy.deepcopy(trace),
+                                    elastic=False, options=options)
+            ex_sim.prepare()
+            t0 = time.perf_counter()
+            rep_i = dict(ex_sim.run())
+            rep_i["explain"] = build_fleet_explain(ex_sim)
+            dt = time.perf_counter() - t0
+            if ex_elapsed is None or dt < ex_elapsed:
+                ex_elapsed, ex_report = dt, rep_i
+        result["explain_elapsed_s"] = round(ex_elapsed, 3)
+        overhead = (ex_elapsed / elapsed - 1.0) if elapsed else 0.0
+        result["explain_overhead"] = round(overhead, 4)
+        result["explain_overhead_ok"] = (
+            overhead <= args.max_explain_overhead
+        )
+        # bit-identity oracle: attaching forensics cannot change one
+        # byte of the base payload
+        base_payload = {k: v for k, v in ex_report.items()
+                        if k != "explain"}
+        result["explain_identical"] = base_payload == report
+        # bucket-sum oracle: per-job and fleet attribution each sum
+        # to their wall/occupancy total within 1e-6
+        ledger = ex_report["explain"]["ledger"]
+        sums_ok = all(
+            abs(sum(j["buckets"].values()) - j["wall_time_s"]) < 1e-6
+            for j in ledger["per_job"]
+        ) and abs(
+            sum(ledger["buckets"][k] for k in FLEET_LEDGER_ORDER)
+            - ledger["total_chip_s"]
+        ) < 1e-6 * max(1.0, ledger["total_chip_s"])
+        result["explain_bucket_sums_ok"] = sums_ok
+        # Chrome-trace validity via the shared test machinery
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"))
+        try:
+            from test_trace_validity import check_chrome_trace
+
+            check_chrome_trace(fleet_chrome_trace(ex_report))
+            result["explain_trace_valid"] = True
+        except (ImportError, AssertionError) as exc:
+            result["explain_trace_valid"] = False
+            result["explain_trace_error"] = str(exc)[:200]
+        result["explain_probes"] = len(
+            ex_report["explain"]["probes"]
+        )
+        ok = ok and all(result[k] for k in (
+            "explain_overhead_ok", "explain_identical",
+            "explain_bucket_sums_ok", "explain_trace_valid",
+        ))
     if args.elastic_demo:
         t0 = time.perf_counter()
         el_report = FleetSimulator(copy.deepcopy(trace)).run()

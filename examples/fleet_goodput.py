@@ -7,14 +7,20 @@ instead of rolling it back — then prints the fleet report and the
 scheduler-decision timeline, and contrasts elastic vs
 rollback-restart accounting for the reclaimed job.
 
-The reference 512-chip trace the bench gates lives at
-``configs/fleet/v5p512_reference.json``; walk it the same way (it
-takes a few seconds shared, ~30x longer with ``naive=True``):
+It then walks the reference 512-chip trace the bench gates
+(``configs/fleet/v5p512_reference.json``) with ``explain=True`` and
+prints the fleet forensics (docs/fleet.md "Explaining a fleet run"):
+the chip-second attribution waterfall, the top goodput-loss causes,
+and — for the missed-SLO jobs — the cheapest counterfactual
+intervention that provably recovers each SLO when re-simulated
+(``observe/fleetledger.py``). Skip it with ``--small`` if you only
+want the two-pod walk.
 
 CLI equivalent::
 
     python -m simumax_tpu fleet \
-        --trace configs/fleet/v5p512_reference.json
+        --trace configs/fleet/v5p512_reference.json \
+        --explain --chrome-trace fleet_trace.json
 """
 
 import os
@@ -67,6 +73,36 @@ TRACE = {
 }
 
 
+REFERENCE_TRACE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "configs", "fleet", "v5p512_reference.json",
+)
+
+
+def explain_reference():
+    """The v5p512 reference with forensics: attribution waterfall +
+    the cheapest SLO-recovering intervention per missed-SLO job."""
+    from simumax_tpu.observe.fleetledger import fleet_explain_lines
+
+    report = simulate_fleet(REFERENCE_TRACE, explain=True)
+    print()
+    print("== v5p512 reference trace, explained ==")
+    for line in fleet_explain_lines(report, top_causes=10,
+                                    top_probes=0):
+        print(line)
+    fixes = [p for p in report["explain"]["probes"]
+             if p.get("cheapest_fix")]
+    print(f"  -- cheapest SLO-recovering interventions "
+          f"({len(fixes)} of the missed-SLO jobs recoverable) --")
+    for p in fixes[:10]:
+        print(f"    {p['job']}: {p['change']} ({p['detail']}) — "
+              f"goodput {100.0 * p['baseline_goodput']:.2f}% -> "
+              f"{100.0 * p['goodput']:.2f}%, SLO "
+              f"{100.0 * p['slo']:.0f}% recovered")
+    if len(fixes) > 10:
+        print(f"    ... {len(fixes) - 10} more")
+
+
 def main():
     report = simulate_fleet(TRACE)
     for line in fleet_report_lines(report, top_decisions=20):
@@ -85,6 +121,9 @@ def main():
                      f"({rb['report']['n_restarts']} restarts)"
                      if rg is not None else
                      f"restart path starved ({rb['state']})"))
+
+    if "--small" not in sys.argv:
+        explain_reference()
 
 
 if __name__ == "__main__":

@@ -765,12 +765,33 @@ def cmd_diff(args):
         format_memory_diff_lines,
     )
 
-    if args.memory and args.critical_path:
+    if sum(map(bool, (args.memory, args.critical_path,
+                      args.fleet))) > 1:
         raise SystemExit(
-            "error: --memory and --critical-path are exclusive (pick "
-            "the ledger family the inputs belong to)"
+            "error: --memory, --critical-path and --fleet are "
+            "exclusive (pick the ledger family the inputs belong to)"
         )
-    if args.critical_path:
+
+    def load_fleet_report(path):
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    # fleet reports are self-describing (schema simumax-fleet-v1):
+    # auto-detect when no family flag narrows the choice
+    fleet = args.fleet
+    if not (args.memory or args.critical_path or fleet):
+        try:
+            fleet = all(
+                isinstance(r, dict)
+                and r.get("schema") == "simumax-fleet-v1"
+                for r in (load_fleet_report(args.ledger_a),
+                          load_fleet_report(args.ledger_b))
+            )
+        except (OSError, ValueError, json.JSONDecodeError):
+            fleet = False
+    if fleet:
+        loader = load_fleet_report
+    elif args.critical_path:
         loader = load_report
     elif args.memory:
         loader = MemoryLedger.load
@@ -781,7 +802,19 @@ def cmd_diff(args):
         b = loader(args.ledger_b)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         raise SystemExit(f"error: {exc}")
-    if args.critical_path:
+    if fleet:
+        from simumax_tpu.core.errors import ConfigError
+        from simumax_tpu.observe.fleetledger import (
+            diff_fleet_reports,
+            format_fleet_diff_lines,
+        )
+
+        try:
+            d = diff_fleet_reports(a, b, top=args.top)
+        except ConfigError as exc:
+            raise SystemExit(f"error: {exc}")
+        lines = format_fleet_diff_lines(d, top=args.top)
+    elif args.critical_path:
         d = diff_critpath(a, b, top=args.top)
         lines = format_critpath_diff_lines(d, top=args.top)
     elif args.memory:
@@ -903,10 +936,14 @@ def _run_faults(args, perf):
 
 def cmd_fleet(args):
     """Multi-job fleet walk (docs/fleet.md): fleet goodput, per-job
-    SLO attainment, scheduler-decision timeline."""
+    SLO attainment, scheduler-decision timeline — plus the causal
+    goodput ledger / SLO counterfactuals / fleet Chrome trace under
+    ``--explain`` / ``--chrome-trace`` (docs/fleet.md "Explaining a
+    fleet run")."""
     from simumax_tpu.fleet.report import fleet_report_lines
 
     log = _log()
+    explain = bool(args.explain or args.chrome_trace)
     if args.naive or not _cache_enabled(args):
         # the naive baseline (and cache-off runs) walk directly; the
         # default path routes through the planner so repeated
@@ -915,7 +952,7 @@ def cmd_fleet(args):
 
         report = simulate_fleet(
             args.trace, jobs=args.jobs or 0, elastic=args.elastic,
-            naive=args.naive,
+            naive=args.naive, explain=explain,
         )
     else:
         from simumax_tpu.service.planner import Planner
@@ -923,7 +960,7 @@ def cmd_fleet(args):
         planner = Planner(cache_dir=getattr(args, "cache_dir", None))
         report, meta = planner.fleet(
             args.trace, jobs=args.jobs or 0, elastic=args.elastic,
-            with_meta=True,
+            explain=explain, with_meta=True,
         )
         log.info(
             f"[cache {meta['cache']}] {meta['key'][:16]}",
@@ -932,6 +969,21 @@ def cmd_fleet(args):
         )
     for line in fleet_report_lines(report, top_decisions=args.top):
         log.info(line, event="fleet")
+    if explain:
+        from simumax_tpu.observe.fleetledger import fleet_explain_lines
+
+        for line in fleet_explain_lines(report):
+            log.info(line, event="fleet_explain")
+    if args.chrome_trace:
+        from simumax_tpu.observe.fleetledger import write_fleet_trace
+
+        write_fleet_trace(report, args.chrome_trace)
+        log.info(
+            f"fleet Chrome trace -> {args.chrome_trace} (pods as "
+            f"pids, job lanes, causal flow arrows, goodput/"
+            f"utilization counters)",
+            event="fleet_trace", path=args.chrome_trace,
+        )
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1)
@@ -1389,7 +1441,8 @@ def main(argv=None):
     pdf = sub.add_parser(
         "diff",
         help="compare two saved attribution ledgers (explain --json), "
-             "or two memory ledgers with --memory",
+             "two memory ledgers with --memory, or two fleet reports "
+             "with --fleet (auto-detected)",
     )
     pdf.add_argument("ledger_a", help="baseline ledger JSON")
     pdf.add_argument("ledger_b", help="comparison ledger JSON")
@@ -1405,6 +1458,14 @@ def main(argv=None):
         help="the inputs are critical-path reports (critical-path "
              "--json): diff DES makespans, simulated-waterfall buckets "
              "and slack headroom across two runs/scenarios",
+    )
+    pdf.add_argument(
+        "--fleet", action="store_true",
+        help="the inputs are fleet reports (fleet --json): diff "
+             "fleet goodput / utilization / makespan / SLO "
+             "attainment, per-job goodput movers, and — when both "
+             "carry an --explain ledger — the attribution buckets "
+             "(auto-detected from the schema when omitted)",
     )
     pdf.add_argument("--json", metavar="PATH",
                      help="also save the structured diff report")
@@ -1631,6 +1692,21 @@ def main(argv=None):
     pfl.add_argument("--top", type=int, default=12, metavar="N",
                      help="decision-timeline lines to print "
                           "(default 12)")
+    pfl.add_argument(
+        "--explain", action="store_true",
+        help="attach the causal goodput ledger + SLO counterfactual "
+             "probes (observe/fleetledger.py) and print the "
+             "chip-second waterfall, top loss causes, per-pod "
+             "utilization, and probe table; the base report stays "
+             "byte-identical",
+    )
+    pfl.add_argument(
+        "--chrome-trace", metavar="PATH",
+        help="write the fleet timeline as a Chrome trace (pods as "
+             "pids, job lanes with run/suspend/checkpoint/rollback/"
+             "reshape spans, causal flow arrows, goodput/utilization "
+             "counters; implies --explain)",
+    )
     pfl.add_argument("--json", metavar="PATH",
                      help="save the full fleet report JSON")
     pfl.add_argument("--cache-dir", metavar="DIR",

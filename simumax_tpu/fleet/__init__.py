@@ -3,7 +3,11 @@
 scheduler walk with cross-job replay amortization, ``report`` the
 payload + rendering."""
 
-from simumax_tpu.fleet.report import build_fleet_report, fleet_report_lines
+from simumax_tpu.fleet.report import (
+    build_fleet_report,
+    fleet_decision_lines,
+    fleet_report_lines,
+)
 from simumax_tpu.fleet.sim import (
     FleetSimulator,
     TemplateRuntime,
@@ -27,5 +31,6 @@ __all__ = [
     "simulate_fleet",
     "elastic_goodput_walk",
     "build_fleet_report",
+    "fleet_decision_lines",
     "fleet_report_lines",
 ]

@@ -154,6 +154,9 @@ def fleet_report_lines(report: Dict[str, Any],
             + ("  (" + ", ".join(extras) + ")" if extras else "")
         )
     decs = report["decisions"]
+    if report.get("explain"):
+        lines.extend(fleet_decision_lines(report))
+        return lines
     lines.append(f"  -- decisions ({len(decs)} total) --")
     for d in decs[:top_decisions]:
         extra = {
@@ -170,4 +173,62 @@ def fleet_report_lines(report: Dict[str, Any],
     return lines
 
 
-__all__ = ["build_fleet_report", "fleet_report_lines"]
+def fleet_decision_lines(report: Dict[str, Any],
+                         top_per_event: int = 6) -> List[str]:
+    """Decision timeline grouped by event kind, each group annotated
+    with the goodput cost the explain ledger attributes to its
+    causing events — the expensive tail the flat top-12 list
+    truncates. Needs the report's ``explain`` payload (satellite of
+    the fleet forensics PR; ``observe/fleetledger.py``)."""
+    explain = report.get("explain") or {}
+    ledger = explain.get("ledger") or {}
+    #: causing-event id -> loss chip-seconds (useful time excluded)
+    cause_cost = {
+        r["cause"]: r["chip_s"] - r["buckets"].get("useful_train", 0.0)
+        for r in ledger.get("causes", [])
+        if r["cause"] != "useful"
+    }
+    groups: Dict[str, List[dict]] = {}
+    order: List[str] = []
+    for d in report["decisions"]:
+        if d["event"] not in groups:
+            order.append(d["event"])
+        groups.setdefault(d["event"], []).append(d)
+    lines = [
+        f"  -- decisions ({len(report['decisions'])} total, "
+        f"grouped by event) --"
+    ]
+    for event in order:
+        ds = groups[event]
+        ev_causes = {d["cause"] for d in ds if "cause" in d}
+        cost = sum(cause_cost.get(c, 0.0) for c in ev_causes)
+        head = f"  {event} x{len(ds)}"
+        if cost > 0.0:
+            head += f"  [{cost:.1f} chip-s goodput loss attributed]"
+        lines.append(head)
+        # costliest decisions first inside each group; ties by time
+        ds_ranked = sorted(
+            ds, key=lambda d: (-cause_cost.get(d.get("cause", ""),
+                                              0.0), d["t_s"]),
+        )
+        for d in ds_ranked[:top_per_event]:
+            extra = {
+                k: v for k, v in d.items()
+                if k not in ("t_s", "event", "job", "cause")
+            }
+            who = f" {d['job']}" if "job" in d else ""
+            c = d.get("cause")
+            tag = ""
+            if c is not None and cause_cost.get(c, 0.0) > 0.0:
+                tag = f"  [{c}: {cause_cost[c]:.1f} chip-s]"
+            lines.append(
+                f"    t={d['t_s']:>10.1f}s {who or ' -'}"
+                + (f"  {extra}" if extra else "") + tag
+            )
+        if len(ds) > top_per_event:
+            lines.append(f"    ... {len(ds) - top_per_event} more")
+    return lines
+
+
+__all__ = ["build_fleet_report", "fleet_decision_lines",
+           "fleet_report_lines"]

@@ -275,6 +275,7 @@ def elastic_goodput_walk(
     reshapes: List[Tuple[float, int]],
     levels: Dict[int, Tuple[float, float]],
     max_restarts: int = 1000,
+    observer=None,
 ) -> GoodputReport:
     """The elastic twin of ``faults._goodput_walk``: identical
     step-by-step accounting (committed steps at the healthy step
@@ -297,6 +298,13 @@ def elastic_goodput_walk(
     used; the caller routes through ``predict_goodput`` outright, so
     reshape-disabled fleet accounting is bit-identical to the
     rollback-restart path by construction.
+
+    ``observer`` mirrors the :func:`~simumax_tpu.simulator.faults.
+    predict_goodput` hook (the fleet ledger's bucket provenance):
+    ``("step", wall, h, dur)`` / ``("checkpoint", wall, write_s)`` /
+    ``("restart", abort, extra, overhead, read)`` plus the elastic
+    ``("reshape", wall, partial_s, cost_s, level)`` event. Pure
+    notification — observed and unobserved walks are bit-identical.
     """
     from simumax_tpu.core.records import GoodputBuckets
 
@@ -344,6 +352,9 @@ def elastic_goodput_walk(
         b.restart_overhead += spec.restart_overhead_s
         b.restore_read += ckpt.read_s
         n_restart += 1
+        if observer is not None:
+            observer(("restart", abort_wall_s, extra_lost_s,
+                      spec.restart_overhead_s, ckpt.read_s))
 
     def fire_reshape(t_r: float, replicas: int):
         nonlocal wall, lost, h
@@ -351,6 +362,8 @@ def elastic_goodput_walk(
         lost += replicas
         h_level, cost = levels[lost]
         b.reshape += partial + cost
+        if observer is not None:
+            observer(("reshape", wall, partial, cost, lost))
         wall = max(t_r, wall) + cost
         h = h_level
 
@@ -382,6 +395,8 @@ def elastic_goodput_walk(
             fire_reshape(t_r, reps)
             continue
         if death is None:
+            if observer is not None:
+                observer(("step", wall, h, dur))
             wall += dur
             b.useful_train += h
             b.fault_stall += dur - h
@@ -395,6 +410,8 @@ def elastic_goodput_walk(
                         truncated = True
                         break
                     continue
+                if observer is not None:
+                    observer(("checkpoint", wall, ckpt.write_s))
                 wall += ckpt.write_s
                 b.checkpoint_write += ckpt.write_s
                 n_ckpt += 1
@@ -534,6 +551,11 @@ class _Job:
     timeline: List[dict] = field(default_factory=list)
     #: (t_rel_s, replicas) elastic reshapes, job-relative
     reshapes: List[Tuple[float, int]] = field(default_factory=list)
+    #: causing-event ids parallel to ``reshapes`` (``spot:{ri}``)
+    reshape_causes: List[str] = field(default_factory=list)
+    #: causing-event id of the live suspension (the resume freeze
+    #: inherits it, so the wait is attributed to what evicted the job)
+    suspend_cause: Optional[str] = None
     lost_replicas: int = 0
     n_suspensions: int = 0
     version: int = 0
@@ -579,6 +601,10 @@ class FleetSimulator:
             _Job(spec=j, idx=i) for i, j in enumerate(self.trace.jobs)
         ]
         self.decisions: List[dict] = []
+        #: per-pod chip-occupancy deltas (``used`` = chips held by a
+        #: job, ``cap`` = reclaimed capacity), recorded unconditionally
+        #: for the explain/trace surfaces (never in the base payload)
+        self.occupancy: List[dict] = []
         self.report: Optional[dict] = None
         self.stats: Dict[str, int] = {
             "costings": 0, "templates_built": 0, "ctx_shared": 0,
@@ -684,11 +710,19 @@ class FleetSimulator:
                 break
         for name, ranks in placement.items():
             self._pod_free[name] -= len(ranks)
+            self.occupancy.append({
+                "t": t, "pod": name, "used": len(ranks),
+                "job": job.spec.name,
+            })
         return placement
 
-    def _release(self, job: _Job):
+    def _release(self, job: _Job, t: float):
         for name, ranks in job.placement.items():
             self._pod_free[name] += len(ranks)
+            self.occupancy.append({
+                "t": t, "pod": name, "used": -len(ranks),
+                "job": job.spec.name,
+            })
         job.placement = {}
 
     # -- fault-event derivation --------------------------------------------
@@ -741,10 +775,15 @@ class FleetSimulator:
                     "src": f"link:{wi}",
                 })
 
-    def _materialize(self, job: _Job) -> FaultScenario:
+    def _materialize(self, job: _Job, with_causes: bool = False):
         """The job's scenario in its own frame (ms from first
-        admission), deterministically ordered."""
+        admission), deterministically ordered. ``with_causes=True``
+        additionally returns the causing-event id of each scenario
+        event, index-parallel (window events carry their window id,
+        scheduler events the recorded eviction cause) — the fleet
+        ledger's event -> job causality."""
         events: List[FaultEvent] = []
+        causes: List[str] = []
         for e in sorted(
             job.timeline,
             key=lambda e: (e["t"], e["kind"], e.get("rank", -1),
@@ -770,30 +809,39 @@ class FleetSimulator:
                 events.append(FaultEvent(
                     "rank_death", start_ms=start_ms, rank=e["rank"],
                 ))
-        return FaultScenario(
+            else:
+                continue
+            causes.append(e.get("cause", e["src"]))
+        scenario = FaultScenario(
             events=events, horizon_steps=job.spec.horizon_steps,
             checkpoint=job.spec.checkpoint,
         )
+        if with_causes:
+            return scenario, causes
+        return scenario
 
     # -- scheduler actions -------------------------------------------------
-    def _suspend(self, job: _Job, t: float, reason: str):
+    def _suspend(self, job: _Job, t: float, reason: str, cause: str):
         """Kill + park a running job: its chips free immediately, a
         death event enters its scenario, and the wait until resume
-        becomes an all-rank freeze appended at resume time."""
+        becomes an all-rank freeze appended at resume time. ``cause``
+        names the evicting trace event (``preempt:{job}`` /
+        ``spot:{ri}``) for the attribution ledger."""
         tpl = self._runtime(job.spec.template)
         victim_rank = job.live_ranks[0]
         job.timeline.append({
             "t": t, "kind": "rank_death", "rank": victim_rank,
-            "src": "sched",
+            "src": "sched", "cause": cause,
         })
-        self._release(job)
+        self._release(job, t)
         job.state = "suspended"
         job.suspended_at = t
+        job.suspend_cause = cause
         job.n_suspensions += 1
         job.version += 1
         job.report = None
         self._log(t, reason, job, rank=victim_rank,
-                  orbit=tpl.orbit(victim_rank))
+                  orbit=tpl.orbit(victim_rank), cause=cause)
 
     def _admit(self, t: float):
         """Admission pass: scan the wait queue in policy order, place
@@ -844,7 +892,8 @@ class FleetSimulator:
                         freeable += v.chips
                     if freeable >= need:
                         for v in chosen:
-                            self._suspend(v, t, "preempted")
+                            self._suspend(v, t, "preempted",
+                                          f"preempt:{job.spec.name}")
                         placement = self._allocate(
                             job, tpl, t, job.live_ranks, pens=pens,
                         )
@@ -870,13 +919,17 @@ class FleetSimulator:
                             "kind": "preemption",
                             "ranks": list(job.live_ranks),
                             "dur": waited, "src": "sched",
+                            "cause": job.suspend_cause or "sched",
                         })
                     event = "resumed"
                 self._derive_window_events(job, t)
-                job.suspended_at = None
                 detail = {"pods": sorted(placement)}
                 if resumed:
                     detail["waited_s"] = round(waited, 6)
+                    if job.suspend_cause:
+                        detail["cause"] = job.suspend_cause
+                job.suspended_at = None
+                job.suspend_cause = None
                 absorbed = [
                     p for p in sorted(placement) if pens[p][1] > 0.0
                 ]
@@ -892,21 +945,28 @@ class FleetSimulator:
             if not admitted_one:
                 return
 
-    def _apply_reclaim(self, t: float, rec):
+    def _apply_reclaim(self, t: float, ri: int, rec):
         """Spot reclaim: chips leave the pod; free chips go first,
         then spot jobs on the pod — lowest priority first, cascading
         to further victims while chips remain to be taken — each
         reshaping (elastic) or being killed (restart on backfill /
         suspension). A remainder no spot job can cover is logged as
-        ``shortfall`` (non-spot capacity is never reclaimed)."""
+        ``shortfall`` (non-spot capacity is never reclaimed).
+        ``ri`` is the reclaim's index in the deterministic
+        ``materialize_spot()`` enumeration — the ``spot:{ri}`` cause
+        id every consequence of this reclaim is attributed to."""
         pod = rec.pod
+        cause = f"spot:{ri}"
         take_free = min(self._pod_free[pod], rec.chips)
         self._pod_free[pod] -= take_free
         self._pod_total[pod] -= take_free
+        if take_free:
+            self.occupancy.append({"t": t, "pod": pod,
+                                   "cap": -take_free})
         rem = rec.chips - take_free
         if rem <= 0:
             self._log(t, "reclaimed", None, pod=pod,
-                      chips=rec.chips, idle=take_free)
+                      chips=rec.chips, idle=take_free, cause=cause)
             return
         while rem > 0:
             victims = [
@@ -921,7 +981,7 @@ class FleetSimulator:
                 # only spot capacity is reclaimable; the rest stays
                 self._log(t, "reclaimed", None, pod=pod,
                           chips=rec.chips, idle=take_free,
-                          shortfall=rem)
+                          shortfall=rem, cause=cause)
                 return
             job = victims[0]
             tpl = self._runtime(job.spec.template)
@@ -929,22 +989,25 @@ class FleetSimulator:
             take = min(len(on_pod), rem)
             taken_ranks = on_pod[-take:]
             self._pod_total[pod] -= take
+            self.occupancy.append({"t": t, "pod": pod, "cap": -take})
             rem -= take
             self._log(t, "reclaimed", job, pod=pod, chips=rec.chips,
-                      idle=take_free, taken=take)
+                      idle=take_free, taken=take, cause=cause)
             handled = False
             if self.elastic:
                 replicas = -(-take // tpl.replica_chips)
                 total = job.lost_replicas + replicas
                 if tpl.reshape_feasible(total):
                     self._reshape(job, tpl, t, pod, taken_ranks,
-                                  replicas)
+                                  replicas, cause)
                     handled = True
             if not handled:
-                self._kill_for_reclaim(job, tpl, t, pod, taken_ranks)
+                self._kill_for_reclaim(job, tpl, t, pod, taken_ranks,
+                                       cause)
 
     def _reshape(self, job: _Job, tpl: TemplateRuntime, t: float,
-                 pod: str, taken_ranks: List[int], replicas: int):
+                 pod: str, taken_ranks: List[int], replicas: int,
+                 cause: str):
         """Elastic dp shrink: drop whole replicas covering the taken
         chips; surplus chips return to their pods' free pools; the
         job continues at the shrunk level without rollback."""
@@ -972,12 +1035,19 @@ class FleetSimulator:
             )
             if freed:
                 self._pod_free[name] += freed
+            if len(kept) != len(ranks):
+                self.occupancy.append({
+                    "t": t, "pod": name,
+                    "used": len(kept) - len(ranks),
+                    "job": job.spec.name,
+                })
             if kept:
                 job.placement[name] = kept
             else:
                 del job.placement[name]
         dropped = sorted(dropped)
         job.reshapes.append((t - job.start_s, replicas))
+        job.reshape_causes.append(cause)
         # window events for ranks that no longer exist are harmless
         # (they target dropped ranks the walk never consults), but
         # re-derive for cleanliness on the shrunk placement
@@ -987,12 +1057,13 @@ class FleetSimulator:
                   level=job.lost_replicas,
                   chips=len(job.live_ranks),
                   orbit=tpl.orbit(dropped[0]),
-                  step_scale=round(h_level / tpl.healthy_step_s, 6))
+                  step_scale=round(h_level / tpl.healthy_step_s, 6),
+                  cause=cause)
         self._request_cost(job)
 
     def _kill_for_reclaim(self, job: _Job, tpl: TemplateRuntime,
                           t: float, pod: str,
-                          taken_ranks: List[int]):
+                          taken_ranks: List[int], cause: str):
         """Non-elastic reclaim: the job dies at the reclaim and
         restarts from its last checkpoint — on backfilled chips when
         the fleet has them, suspended until capacity frees
@@ -1002,13 +1073,17 @@ class FleetSimulator:
         # fleet); the rest of the job's chips stay held for backfill
         kept = [r for r in job.placement[pod] if r not in
                 set(taken_ranks)]
+        self.occupancy.append({
+            "t": t, "pod": pod, "used": -len(taken_ranks),
+            "job": job.spec.name,
+        })
         if kept:
             job.placement[pod] = kept
         else:
             del job.placement[pod]
         job.timeline.append({
             "t": t, "kind": "rank_death", "rank": victim,
-            "src": "sched",
+            "src": "sched", "cause": cause,
         })
         backfill = self._allocate(job, tpl, t, taken_ranks)
         if backfill is not None:
@@ -1020,22 +1095,40 @@ class FleetSimulator:
             job.version += 1
             self._log(t, "restarted", job, rank=victim,
                       orbit=tpl.orbit(victim),
-                      backfill=sorted(backfill))
+                      backfill=sorted(backfill), cause=cause)
             self._request_cost(job)
         else:
-            self._release(job)
+            self._release(job, t)
             job.state = "suspended"
             job.suspended_at = t
+            job.suspend_cause = cause
             job.n_suspensions += 1
             job.version += 1
             job.report = None
             self._log(t, "frozen", job, rank=victim,
-                      orbit=tpl.orbit(victim))
+                      orbit=tpl.orbit(victim), cause=cause)
 
     # -- costing -----------------------------------------------------------
     def _request_cost(self, job: _Job):
         if job.idx not in self._requests:
             self._requests.append(job.idx)
+
+    def _job_levels(self, job: _Job,
+                    rt: TemplateRuntime) -> Dict[int, Tuple[float, float]]:
+        """The job's elastic shrink-level table for costing:
+        ``{cumulative_replicas: (healthy_step_s, reshape_cost_s)}``
+        with one redistribution collective per replica lost at each
+        event plus the scheduler's fixed re-init overhead. Shared by
+        the walk's flush and the attribution ledger's re-drive."""
+        levels: Dict[int, Tuple[float, float]] = {}
+        if job.reshapes:
+            overhead = self.fleet.scheduler.reshape_overhead_s
+            lost = 0
+            for (_tr, reps) in job.reshapes:
+                lost += reps
+                h_l, redist = rt.level(lost)
+                levels[lost] = (h_l, redist * reps + overhead)
+        return levels
 
     def _cost_serial(self, batch: List[tuple]) -> Dict[int, dict]:
         out: Dict[int, dict] = {}
@@ -1124,16 +1217,7 @@ class FleetSimulator:
             key = job.spec.template
             rt = self._runtimes[key]
             scenario = self._materialize(job)
-            levels = {}
-            if job.reshapes:
-                overhead = self.fleet.scheduler.reshape_overhead_s
-                lost = 0
-                for (_tr, reps) in job.reshapes:
-                    lost += reps
-                    h_l, redist = rt.level(lost)
-                    # one redistribution collective per replica lost
-                    # at THIS event, plus the fixed re-init overhead
-                    levels[lost] = (h_l, redist * reps + overhead)
+            levels = self._job_levels(job, rt)
             batch.append((idx, key, scenario,
                           list(job.reshapes), levels))
             self.stats["costings"] += 1
@@ -1179,8 +1263,8 @@ class FleetSimulator:
         self.prepare()
         for j in self._jobs:
             self._push(j.spec.arrival_s, "arrive", j.idx)
-        for rec in self.fleet.materialize_spot():
-            self._push(rec.start_s, "reclaim", rec)
+        for ri, rec in enumerate(self.fleet.materialize_spot()):
+            self._push(rec.start_s, "reclaim", (ri, rec))
         makespan = 0.0
         try:
             with get_tracer().span(
@@ -1201,7 +1285,7 @@ class FleetSimulator:
                                       template=job.spec.template,
                                       priority=job.spec.priority)
                         elif kind == "reclaim":
-                            self._apply_reclaim(t, payload)
+                            self._apply_reclaim(t, *payload)
                         elif kind == "complete":
                             idx, version = payload
                             job = self._jobs[idx]
@@ -1211,7 +1295,7 @@ class FleetSimulator:
                             job.state = "done"
                             job.completed_s = t
                             makespan = max(makespan, t)
-                            self._release(job)
+                            self._release(job, t)
                             self._log(t, "completed", job,
                                       goodput=round(
                                           job.report["goodput"], 9))
@@ -1236,16 +1320,28 @@ def simulate_fleet(trace, jobs: int = 0,
                    elastic: Optional[bool] = None,
                    naive: bool = False,
                    scenario_timeout: Optional[float] = None,
-                   options: Optional[ReplayOptions] = None) -> dict:
+                   options: Optional[ReplayOptions] = None,
+                   explain: bool = False) -> dict:
     """Walk a fleet trace and return the fleet report (docs/fleet.md
     schema ``simumax-fleet-v1``). ``jobs=N`` fans job costings across
     a worker pool (serial == parallel bit-for-bit); ``naive=True``
     re-pays replay state per costing call — the bench baseline;
-    ``elastic`` overrides the trace's scheduler setting."""
-    return FleetSimulator(
+    ``elastic`` overrides the trace's scheduler setting.
+    ``explain=True`` attaches the causal attribution ledger, the SLO
+    counterfactual probe table and the Chrome-trace span records
+    under an ``explain`` key (``observe/fleetledger.py``); the rest
+    of the payload is byte-identical to an ``explain=False`` run."""
+    sim = FleetSimulator(
         trace, jobs=jobs, elastic=elastic, naive=naive,
         scenario_timeout=scenario_timeout, options=options,
-    ).run()
+    )
+    report = sim.run()
+    if explain:
+        from simumax_tpu.observe.fleetledger import build_fleet_explain
+
+        report = dict(report)
+        report["explain"] = build_fleet_explain(sim)
+    return report
 
 
 __all__ = [

@@ -15,6 +15,15 @@ from simumax_tpu.observe.critpath import (
     diff_critpath,
     diverge,
 )
+from simumax_tpu.observe.fleetledger import (
+    build_fleet_explain,
+    build_fleet_ledger,
+    diff_fleet_reports,
+    fleet_chrome_trace,
+    fleet_explain_lines,
+    format_fleet_diff_lines,
+    slo_counterfactuals,
+)
 from simumax_tpu.observe.ledger import Ledger, attribution_line, build_waterfall, diff_ledgers
 from simumax_tpu.observe.memledger import (
     MemoryLedger,
@@ -32,8 +41,15 @@ __all__ = [
     "MemoryLedger",
     "Reporter",
     "attribution_line",
+    "build_fleet_explain",
+    "build_fleet_ledger",
     "build_memory_waterfall",
     "build_waterfall",
+    "diff_fleet_reports",
+    "fleet_chrome_trace",
+    "fleet_explain_lines",
+    "format_fleet_diff_lines",
+    "slo_counterfactuals",
     "configure_reporter",
     "diff_critpath",
     "diff_ledgers",

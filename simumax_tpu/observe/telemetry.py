@@ -227,6 +227,27 @@ METRICS: Dict[str, Dict[str, str]] = {
         "help": "Fraction of SLO-carrying jobs meeting their goodput "
                 "SLO in the most recent fleet trace walk.",
     },
+    "replay_compile_cache_shapes": {
+        "type": "gauge",
+        "help": "Distinct (backend, shape) array programs currently "
+                "held by the batched-replay compile cache.",
+    },
+    "replay_compile_cache_capacity": {
+        "type": "gauge",
+        "help": "Entry bound of the batched-replay compile cache "
+                "(the cache is cleared when it would be exceeded).",
+    },
+    "fleet_explain_jobs_total": {
+        "type": "counter",
+        "help": "Completed jobs attributed by the fleet goodput "
+                "ledger (one observer re-drive each, "
+                "observe/fleetledger.py).",
+    },
+    "fleet_probes_total": {
+        "type": "counter",
+        "help": "SLO counterfactual probes re-costed by the fleet "
+                "ledger, by outcome (recovers/no/error/starved).",
+    },
 }
 
 #: default bounded-reservoir size for histograms: big enough for stable

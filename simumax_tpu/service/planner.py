@@ -513,7 +513,7 @@ class Planner:
         return payload
 
     def fleet(self, trace, jobs: int = 0,
-              elastic: Optional[bool] = None,
+              elastic: Optional[bool] = None, explain: bool = False,
               with_meta: bool = False, raw: bool = False):
         """Multi-job fleet-trace walk (``fleet/sim.py``,
         docs/fleet.md): deterministic in the trace, hence cacheable
@@ -521,7 +521,11 @@ class Planner:
         loader so an edited registry config or recalibration
         invalidates the key; ``jobs`` (costing fan-out) is a serving
         detail and never part of the identity — serial and parallel
-        walks are bit-identical by the fleet contract."""
+        walks are bit-identical by the fleet contract. ``explain``
+        attaches the fleet forensics payload
+        (``observe/fleetledger.py``) and IS part of the identity:
+        the base report stays byte-identical either way, but the
+        cached payloads differ by the ``explain`` key."""
         import copy as _copy
 
         from simumax_tpu.fleet.trace import FleetTrace
@@ -549,13 +553,14 @@ class Planner:
             t.model, t.strategy, t.system = m, st, sysc
         identity = query_identity(
             "fleet", trace=canonical(trace_dict),
-            templates=resolved, elastic=elastic,
+            templates=resolved, elastic=elastic, explain=explain,
         )
 
         def compute():
             from simumax_tpu.fleet.sim import simulate_fleet
 
-            return simulate_fleet(tr, jobs=jobs, elastic=elastic)
+            return simulate_fleet(tr, jobs=jobs, elastic=elastic,
+                                  explain=explain)
 
         payload, hit, key = self._cached("fleet", identity, compute,
                                          raw=raw)

@@ -401,6 +401,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(code, {"error": message})
 
     def _send_metrics(self):
+        # the batched-replay compile-cache gauges mirror module state,
+        # not an event stream — refresh them per scrape so they appear
+        # even when no walk in this process touched the cache
+        from simumax_tpu.simulator.batched_replay import (
+            compile_cache_info,
+        )
+
+        compile_cache_info(self.server.registry)
         body = render_prometheus(self.server.registry).encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
@@ -882,6 +890,7 @@ class _Handler(BaseHTTPRequestHandler):
                 q["trace"],
                 jobs=int(q.get("jobs") or 0),
                 elastic=q.get("elastic"),
+                explain=bool(q.get("explain")),
                 with_meta=True, raw=True,
             )
             self._send_json(200, payload, meta)

@@ -503,10 +503,26 @@ def prepare_scenario(prog: LoweredProgram, model, wp: int, wep: int,
 
 _PROGRAM_CACHE: Dict[tuple, Any] = {}
 
+#: entry bound: crossing it clears the whole cache (shape churn past
+#: this point means the workload isn't bucketing — start over)
+_PROGRAM_CACHE_CAPACITY = 64
 
-def compile_cache_info() -> Dict[str, int]:
-    """Observability hook: compiled-shape count (bench forensics)."""
-    return {"compiled_shapes": len(_PROGRAM_CACHE)}
+
+def compile_cache_info(registry=None) -> Dict[str, int]:
+    """Observability hook: compiled-shape count + the entry bound
+    (bench forensics, and the ``replay_compile_cache_*`` gauges in
+    ``/metrics``). Collect-on-scrape: the gauges mirror module state
+    rather than an event stream, so callers refresh them by calling
+    this — the server does it per ``/metrics`` scrape against its
+    own registry."""
+    from simumax_tpu.observe.telemetry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    reg.gauge("replay_compile_cache_shapes").set(len(_PROGRAM_CACHE))
+    reg.gauge("replay_compile_cache_capacity").set(
+        _PROGRAM_CACHE_CAPACITY)
+    return {"compiled_shapes": len(_PROGRAM_CACHE),
+            "capacity": _PROGRAM_CACHE_CAPACITY}
 
 
 def _compiled(lp: int, kp: int, gp: int, cp: int, wp: int, wep: int,
@@ -655,9 +671,13 @@ def _compiled(lp: int, kp: int, gp: int, cp: int, wp: int, wep: int,
         in_axes=(None, None, None, None, None, None, None,
                  0, 0, 0, 0, 0, 0, 0, 0, 0),
     ))
-    if len(_PROGRAM_CACHE) > 64:
+    if len(_PROGRAM_CACHE) > _PROGRAM_CACHE_CAPACITY:
         _PROGRAM_CACHE.clear()
     _PROGRAM_CACHE[key] = fn
+    from simumax_tpu.observe.telemetry import get_registry
+
+    get_registry().gauge("replay_compile_cache_shapes").set(
+        len(_PROGRAM_CACHE))
     return fn
 
 

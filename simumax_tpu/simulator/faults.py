@@ -1857,6 +1857,7 @@ def predict_goodput(
     incremental: bool = True,
     options: Optional[ReplayOptions] = None,
     _ctx: Optional[ReplayContext] = None,
+    observer=None,
 ) -> GoodputReport:
     """Predict goodput of ``scenario`` over its ``horizon_steps``.
 
@@ -1877,6 +1878,14 @@ def predict_goodput(
     (or ``reduce=False``) keeps the pre-incremental exact walk.
     ``options`` tunes the individual optimizations; ``_ctx`` shares
     one replay context across calls (``analyze_faults`` does).
+    ``observer`` (optional callable) receives the walk's accounting
+    events — ``("step", wall_s, healthy_s, dur_s)``,
+    ``("checkpoint", wall_s, write_s)`` and ``("restart",
+    abort_wall_s, extra_lost_s, overhead_s, read_s)`` — the bucket
+    provenance the fleet ledger attributes to causing trace events
+    (``observe/fleetledger.py``). Pure notification: an observer
+    cannot change a single number, so observed and unobserved walks
+    are bit-identical by construction.
     """
     from simumax_tpu.observe.telemetry import get_registry, get_tracer
 
@@ -1925,11 +1934,12 @@ def predict_goodput(
             )
         return _goodput_walk(perf, scenario, spec, ckpt, healthy,
                              granularity, reduce, max_restarts, _cache,
-                             ctx)
+                             ctx, observer=observer)
 
 
 def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
-                  reduce, max_restarts, _cache, ctx) -> GoodputReport:
+                  reduce, max_restarts, _cache, ctx,
+                  observer=None) -> GoodputReport:
     """Drive one scenario's walk generator serially, answering each
     step request as it arrives — behaviorally identical to the
     pre-generator inline walk. The generator split exists so the
@@ -1937,7 +1947,8 @@ def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
     walks in rounds and feed whole miss batches to the batched replay
     backend."""
     cache = _cache if _cache is not None else {}
-    gen = _walk_gen(scenario, spec, ckpt, healthy, max_restarts)
+    gen = _walk_gen(scenario, spec, ckpt, healthy, max_restarts,
+                    observer=observer)
     ans = None
     while True:
         try:
@@ -1950,11 +1961,14 @@ def _goodput_walk(perf, scenario, spec, ckpt, healthy, granularity,
             ans = _simulate_step(perf, sub, cache, granularity, reduce)
 
 
-def _walk_gen(scenario, spec, ckpt, healthy, max_restarts):
+def _walk_gen(scenario, spec, ckpt, healthy, max_restarts,
+              observer=None):
     """The goodput walk as a coroutine: yields ``(sub, span_s)`` step
     requests, receives ``(dur, death)`` answers, and returns the
     finished :class:`GoodputReport` (via ``StopIteration.value``).
-    Pure bookkeeping — every simulation happens in the driver."""
+    Pure bookkeeping — every simulation happens in the driver.
+    ``observer`` (see :func:`predict_goodput`) is notified of each
+    accounting event; it never feeds back into the walk."""
     h = healthy["end_time"]
     horizon = scenario.horizon_steps
     interval = spec.interval_steps
@@ -1999,6 +2013,9 @@ def _walk_gen(scenario, spec, ckpt, healthy, max_restarts):
         b.restart_overhead += spec.restart_overhead_s
         b.restore_read += ckpt.read_s
         n_restart += 1
+        if observer is not None:
+            observer(("restart", abort_wall_s, extra_lost_s,
+                      spec.restart_overhead_s, ckpt.read_s))
 
     while committed < horizon:
         # fixpoint window growth: a step stretched by faults may pull
@@ -2015,6 +2032,8 @@ def _walk_gen(scenario, spec, ckpt, healthy, max_restarts):
                 break
             span = dur
         if death is None:
+            if observer is not None:
+                observer(("step", wall, h, dur))
             wall += dur
             b.useful_train += h
             b.fault_stall += dur - h
@@ -2030,6 +2049,8 @@ def _walk_gen(scenario, spec, ckpt, healthy, max_restarts):
                         truncated = True
                         break
                     continue
+                if observer is not None:
+                    observer(("checkpoint", wall, ckpt.write_s))
                 wall += ckpt.write_s
                 b.checkpoint_write += ckpt.write_s
                 n_ckpt += 1

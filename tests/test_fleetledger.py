@@ -1,0 +1,400 @@
+"""Fleet goodput-attribution tests (ISSUE 18): the causal ledger's
+conservation + byte-identity oracles over the fleet chaos grid,
+causality-id resolution, the golden round-trip for the explain
+payload, SLO counterfactual probes (including the provable-recovery
+re-simulation and bound pruning), the fleet Chrome-trace export, the
+diff/report renderings, and the planner/server/CLI explain surfaces."""
+
+import copy
+import http.client
+import json
+import threading
+
+import pytest
+
+from simumax_tpu.fleet import (
+    FleetSimulator,
+    fleet_decision_lines,
+    simulate_fleet,
+)
+from simumax_tpu.observe.fleetledger import (
+    FLEET_LEDGER_ORDER,
+    build_fleet_explain,
+    diff_fleet_reports,
+    fleet_chrome_trace,
+    fleet_explain_lines,
+    format_fleet_diff_lines,
+)
+from test_fleet import base_trace, churn_trace
+from test_trace_validity import check_chrome_trace
+
+TOL = 1e-6
+
+# the PR-15 chaos grid: every scheduler path x both walk modes
+GRID = [
+    ("base", False), ("base", True),
+    ("churn", False), ("churn", True),
+]
+
+
+def grid_trace(name):
+    return base_trace() if name == "base" else churn_trace()
+
+
+def explained(trace, **kw):
+    return simulate_fleet(trace, explain=True, **kw)
+
+
+# --------------------------------------------------------------------------
+# Conservation + byte identity (the ledger discipline)
+# --------------------------------------------------------------------------
+
+
+class TestLedgerInvariants:
+    @pytest.mark.parametrize("name,elastic", GRID)
+    def test_explain_on_equals_explain_off(self, name, elastic):
+        """collect-on == collect-off: the base payload is
+        byte-identical; explain only ADDS the ``explain`` key."""
+        plain = simulate_fleet(grid_trace(name), elastic=elastic)
+        rich = explained(grid_trace(name), elastic=elastic)
+        assert set(rich) - set(plain) == {"explain"}
+        stripped = {k: v for k, v in rich.items() if k != "explain"}
+        assert json.dumps(stripped, sort_keys=True) \
+            == json.dumps(plain, sort_keys=True)
+
+    @pytest.mark.parametrize("name,elastic", GRID)
+    def test_buckets_sum_to_wall(self, name, elastic):
+        """Per-job buckets sum to the job's wall clock within 1e-6;
+        fleet buckets sum to the occupied chip-seconds."""
+        ledger = explained(grid_trace(name),
+                           elastic=elastic)["explain"]["ledger"]
+        for rec in ledger["per_job"]:
+            if rec["state"] != "done":
+                continue
+            assert sum(rec["buckets"].values()) \
+                == pytest.approx(rec["wall_time_s"], abs=TOL)
+        total = ledger["total_chip_s"]
+        assert sum(ledger["buckets"].values()) \
+            == pytest.approx(total, rel=TOL)
+        # template roll-ups conserve too
+        for tpl in ledger["per_template"].values():
+            assert sum(tpl["buckets"].values()) \
+                == pytest.approx(tpl["chip_s"], rel=TOL)
+
+    @pytest.mark.parametrize("name,elastic", GRID)
+    def test_cause_ids_resolve(self, name, elastic):
+        """Every causality id the ledger charged is a foreign key
+        into the events table, and every charged chip-second lands
+        in a catalogued bucket."""
+        ex = explained(grid_trace(name), elastic=elastic)["explain"]
+        events = ex["events"]
+        for row in ex["ledger"]["causes"]:
+            assert row["cause"] in events, row["cause"]
+            assert row["event"]["kind"] != "unknown"
+            assert set(row["buckets"]) <= set(FLEET_LEDGER_ORDER)
+        for rec in ex["ledger"]["per_job"]:
+            for row in rec["causes"]:
+                assert row["cause"] in events, row["cause"]
+
+    def test_golden_explain_field_set(self):
+        """The round-trip golden: schema + exact top-level field
+        sets, per-job record shape, JSON round-trip stability."""
+        report = explained(churn_trace())
+        ex = report["explain"]
+        assert ex["schema"] == "simumax-fleet-explain-v1"
+        assert set(ex) == {"schema", "ledger", "probes", "events"}
+        ledger = ex["ledger"]
+        assert set(ledger) == {
+            "order", "buckets", "total_chip_s", "makespan_s",
+            "per_job", "per_template", "per_pod", "causes",
+        }
+        assert ledger["order"] == list(FLEET_LEDGER_ORDER)
+        assert set(ledger["buckets"]) == set(FLEET_LEDGER_ORDER)
+        done = [r for r in ledger["per_job"] if r["state"] == "done"]
+        assert done
+        for rec in done:
+            assert {"name", "template", "state", "chips", "start_s",
+                    "wall_time_s", "queue_wait_s", "goodput",
+                    "buckets", "causes", "spans"} <= set(rec)
+        back = json.loads(json.dumps(report, sort_keys=True))
+        assert json.dumps(back, sort_keys=True) \
+            == json.dumps(report, sort_keys=True)
+
+    def test_explain_deterministic(self):
+        a = explained(churn_trace())
+        b = explained(churn_trace())
+        assert json.dumps(a, sort_keys=True) \
+            == json.dumps(b, sort_keys=True)
+
+
+# --------------------------------------------------------------------------
+# SLO counterfactual probes
+# --------------------------------------------------------------------------
+
+
+class TestProbes:
+    def test_recovering_probe_provably_recovers(self):
+        """The probe's claim re-simulated independently: apply the
+        named intervention to the TRACE and re-walk the fleet — the
+        job must actually reach its SLO."""
+        d = base_trace()
+        report = explained(copy.deepcopy(d))
+        fixes = [p for p in report["explain"]["probes"]
+                 if p.get("cheapest_fix")]
+        fix = next(p for p in fixes if p["job"] == "a")
+        assert fix["change"] == "checkpoint=young-daly"
+        assert fix["recovers"] is True
+        # parse "interval 10 -> N steps" and re-simulate with it
+        yd = int(fix["detail"].split("-> ")[1].split()[0])
+        d2 = copy.deepcopy(d)
+        d2["jobs"][0]["checkpoint"]["interval_steps"] = yd
+        rerun = simulate_fleet(d2)
+        job_a = next(j for j in rerun["jobs"] if j["name"] == "a")
+        assert job_a["report"]["goodput"] >= fix["slo"]
+        assert job_a["report"]["goodput"] \
+            == pytest.approx(fix["goodput"], abs=TOL)
+
+    def test_probe_rows_for_every_missed_slo_job(self):
+        report = explained(churn_trace())
+        missed = {j["name"] for j in report["jobs"]
+                  if j.get("slo_attained") is False}
+        assert missed
+        probed = {p["job"] for p in report["explain"]["probes"]}
+        assert missed <= probed
+
+    def test_bound_pruned_probes_are_provably_non_recovering(self):
+        """A pruned row carries the exact upper bound instead of a
+        re-cost, and the bound is below the SLO by construction."""
+        report = explained(churn_trace())
+        rows = report["explain"]["probes"]
+        pruned = [p for p in rows if "goodput_bound" in p]
+        for p in pruned:
+            assert p["recovers"] is False
+            assert "goodput" not in p
+            assert p["goodput_bound"] < p["slo"]
+
+    def test_cheapest_fix_is_first_recovering_probe(self):
+        report = explained(churn_trace())
+        by_job = {}
+        for p in report["explain"]["probes"]:
+            by_job.setdefault(p["job"], []).append(p)
+        for job_rows in by_job.values():
+            recovering = [p for p in job_rows if p.get("recovers")]
+            if recovering:
+                assert recovering[0].get("cheapest_fix") is True
+                # early exit: nothing re-costed after the fix
+                assert job_rows[-1] is recovering[0]
+
+
+# --------------------------------------------------------------------------
+# Chrome-trace export
+# --------------------------------------------------------------------------
+
+
+class TestFleetTrace:
+    @pytest.mark.parametrize("name,elastic", GRID)
+    def test_trace_structurally_valid(self, name, elastic):
+        report = explained(grid_trace(name), elastic=elastic)
+        check_chrome_trace(fleet_chrome_trace(report))
+
+    def test_trace_has_job_lanes_flows_counters(self):
+        trace = fleet_chrome_trace(explained(churn_trace()))
+        events = trace["traceEvents"]
+        lanes = {e["args"]["name"] for e in events
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"job a", "job b", "job hi"} <= lanes
+        assert any(e["ph"] == "s" for e in events), \
+            "churn trace must carry causal flow arrows"
+        counters = {e["name"] for e in events if e["ph"] == "C"}
+        assert "fleet_goodput_pct" in counters
+        assert any(c == "used_chips" for c in counters)
+
+    def test_write_fleet_trace(self, tmp_path):
+        from simumax_tpu.observe.fleetledger import write_fleet_trace
+
+        report = explained(base_trace())
+        path = write_fleet_trace(report,
+                                 str(tmp_path / "fleet_trace.json"))
+        check_chrome_trace(json.load(open(path)))
+
+    def test_trace_requires_explain(self):
+        from simumax_tpu.core.errors import ConfigError
+
+        with pytest.raises(ConfigError):
+            fleet_chrome_trace(simulate_fleet(base_trace()))
+
+
+# --------------------------------------------------------------------------
+# Renderings: explain lines, decision grouping, fleet diff
+# --------------------------------------------------------------------------
+
+
+class TestRenderings:
+    def test_explain_lines(self):
+        out = "\n".join(
+            fleet_explain_lines(explained(churn_trace())))
+        assert "fleet goodput waterfall" in out
+        assert "top loss causes" in out
+        assert "SLO counterfactual probes" in out
+
+    def test_decision_lines_group_and_annotate(self):
+        from simumax_tpu.fleet import fleet_report_lines
+
+        report = explained(churn_trace())
+        out = "\n".join(fleet_decision_lines(report))
+        assert "chip-s goodput loss attributed" in out
+        assert "[preempt:hi:" in out  # per-decision cause cost tag
+        # the ungrouped rendering still works without explain
+        plain = simulate_fleet(churn_trace())
+        assert "decisions" in "\n".join(fleet_report_lines(plain))
+
+    def test_diff_fleet_reports(self):
+        a = explained(base_trace())
+        b = explained(churn_trace())
+        diff = diff_fleet_reports(a, b)
+        assert "fleet_goodput" in diff["headline"]
+        out = "\n".join(format_fleet_diff_lines(diff))
+        assert "fleet goodput" in out
+        assert "only in B: hi" in out
+
+    def test_diff_rejects_non_fleet_payload(self):
+        from simumax_tpu.core.errors import ConfigError
+
+        with pytest.raises(ConfigError):
+            diff_fleet_reports({"schema": "nope"},
+                               explained(base_trace()))
+
+
+# --------------------------------------------------------------------------
+# Service + telemetry surfaces
+# --------------------------------------------------------------------------
+
+
+class TestExplainSurfaces:
+    def test_planner_explain_is_part_of_identity(self, tmp_path):
+        from simumax_tpu.service.planner import Planner
+
+        planner = Planner(cache_dir=str(tmp_path / "store"))
+        d = base_trace()
+        p1, m1 = planner.fleet(copy.deepcopy(d), with_meta=True)
+        p2, m2 = planner.fleet(copy.deepcopy(d), explain=True,
+                               with_meta=True)
+        assert m2["key"] != m1["key"]
+        assert "explain" in p2 and "explain" not in p1
+        stripped = {k: v for k, v in p2.items() if k != "explain"}
+        assert stripped == p1
+        _p3, m3 = planner.fleet(copy.deepcopy(d), explain=True,
+                                with_meta=True)
+        assert m3["cache"] == "hit" and m3["key"] == m2["key"]
+
+    def test_server_fleet_explain_param(self, tmp_path):
+        from simumax_tpu.service.planner import Planner
+        from simumax_tpu.service.server import make_server
+
+        srv = make_server(
+            Planner(cache_dir=str(tmp_path / "srv-store")),
+            "127.0.0.1", 0)
+        thread = threading.Thread(target=srv.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            port = srv.server_address[1]
+
+            def post(body):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=300)
+                conn.request("POST", "/v1/fleet", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                conn.close()
+                return resp.status, data
+
+            status, plain = post({"trace": base_trace()})
+            assert status == 200
+            status, rich = post({"trace": base_trace(),
+                                 "explain": True})
+            assert status == 200
+            rep = json.loads(rich)
+            assert rep["explain"]["schema"] \
+                == "simumax-fleet-explain-v1"
+            stripped = {k: v for k, v in rep.items()
+                        if k != "explain"}
+            assert stripped == json.loads(plain)
+            # /metrics carries the collect-on-scrape compile-cache
+            # gauges even when no walk batched anything
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=30)
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read().decode()
+            conn.close()
+            assert "replay_compile_cache_shapes" in body
+            assert "replay_compile_cache_capacity" in body
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_cli_fleet_explain_and_trace(self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        trace_path = tmp_path / "trace.json"
+        trace_path.write_text(json.dumps(churn_trace()))
+        out_trace = tmp_path / "chrome.json"
+        main(["fleet", "--trace", str(trace_path), "--no-cache",
+              "--chrome-trace", str(out_trace)])
+        out = capsys.readouterr().out
+        assert "fleet goodput waterfall" in out
+        check_chrome_trace(json.load(open(out_trace)))
+
+    def test_cli_diff_autodetects_fleet_reports(
+            self, tmp_path, capsys):
+        from simumax_tpu.cli import main
+
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(explained(base_trace())))
+        pb.write_text(json.dumps(explained(churn_trace())))
+        main(["diff", str(pa), str(pb)])
+        out = capsys.readouterr().out
+        assert "fleet diff" in out and "fleet goodput" in out
+
+    def test_compile_cache_gauges_cataloged_and_set(self):
+        from simumax_tpu.observe.telemetry import (
+            METRICS,
+            get_registry,
+        )
+        from simumax_tpu.simulator.batched_replay import (
+            _PROGRAM_CACHE_CAPACITY,
+            compile_cache_info,
+        )
+
+        assert METRICS["replay_compile_cache_shapes"]["type"] \
+            == "gauge"
+        assert METRICS["replay_compile_cache_capacity"]["type"] \
+            == "gauge"
+        info = compile_cache_info()
+        assert set(info) == {"compiled_shapes", "capacity"}
+        assert info["capacity"] == _PROGRAM_CACHE_CAPACITY
+        reg = get_registry()
+        assert reg.gauge("replay_compile_cache_capacity").value \
+            == _PROGRAM_CACHE_CAPACITY
+        assert reg.gauge("replay_compile_cache_shapes").value \
+            == info["compiled_shapes"]
+
+    def test_explain_metrics_cataloged(self):
+        from simumax_tpu.observe.telemetry import METRICS
+
+        assert METRICS["fleet_explain_jobs_total"]["type"] \
+            == "counter"
+        assert METRICS["fleet_probes_total"]["type"] == "counter"
+        simulate_fleet(base_trace(), explain=True)
+        from simumax_tpu.observe.telemetry import get_registry
+
+        snap = get_registry().snapshot()
+        assert snap["fleet_explain_jobs_total"][0]["value"] > 0
+
+    def test_build_fleet_explain_needs_finished_walk(self):
+        from simumax_tpu.core.errors import ConfigError
+
+        sim = FleetSimulator(base_trace())
+        with pytest.raises(ConfigError):
+            build_fleet_explain(sim)
