@@ -52,6 +52,29 @@ same-machine single-process (``--workers 0``) siege baseline —
 re-record it on the same box, never compare against another machine's
 number. ``--dump-forensics DIR`` writes the final ``/stats`` and
 ``/metrics`` bodies for CI artifact upload.
+
+Fleet siege (L19)::
+
+    python bench_service.py --siege --nodes 3 --workers 2 \
+        --admission 16 --queries 30000 \
+        --vs-node ci-siege-single.json --min-fleet-speedup 2.4
+
+forks ``--nodes`` fleet node *processes* on localhost ports joined in
+one consistent-hash ring (the ``serve --nodes`` topology) and replays
+the same Zipf burst with client-side affinity routing: every query
+goes to the node that owns its route key — PR 13's affinity routing
+one level up — so the store shards stay disjoint and the fleet scales
+near-linearly where cores allow. The parity sample is deliberately
+sent to NON-owner nodes: the bytes must cross the router hop and
+still be bit-identical to direct cache-off evaluation. The overload
+phase hammers node n0 alone, so admission has to compose across the
+router and the owner's pool (relayed 429s pass through verbatim).
+``--vs-node`` + ``--min-fleet-speedup`` gate fleet qps against a
+same-machine single-node siege recorded with matching traffic flags
+(the CI gate asks >=0.8*N on multi-core runners; the gate is
+meaningful only with >= nodes+1 cores — the recorded baseline
+annotates ``cores``). ``--dump-forensics`` writes per-node
+``/stats`` + ``/metrics`` + ``/ring/state``.
 """
 
 import argparse
@@ -60,6 +83,7 @@ import os
 import queue
 import random
 import shutil
+import signal
 import sys
 import tempfile
 import threading
@@ -492,6 +516,175 @@ def start_server(args):
     return srv, port, cleanup
 
 
+def _fleet_node_proc(idx: int, ports, cache_root: str, workers: int,
+                     admission_n: int):
+    """One forked fleet node: planner (+ optional worker pool wired
+    into the fleet flight table), admission, ring surface — exactly
+    the ``serve --ring ... --join n<idx>`` topology."""
+    from simumax_tpu.service.node import attach_fleet
+    from simumax_tpu.service.planner import Planner
+    from simumax_tpu.service.ring import format_ring_spec
+    from simumax_tpu.service.server import (
+        AdmissionController,
+        make_server,
+    )
+
+    members = {f"n{i}": ("127.0.0.1", p) for i, p in enumerate(ports)}
+    spec = format_ring_spec(members)
+    node_id = f"n{idx}"
+    cache_dir = os.path.join(cache_root, node_id)
+    pool = None
+    if workers:
+        from simumax_tpu.service.pool import WorkerPool
+
+        pool = WorkerPool(cache_dir=cache_dir, workers=workers,
+                          fleet_spec=(node_id, spec))
+        planner = Planner(store=pool.store)
+    else:
+        planner = Planner(cache_dir=cache_dir)
+    admission = AdmissionController(admission_n, pool=pool) \
+        if admission_n else None
+    srv = make_server(planner, "127.0.0.1", ports[idx], pool=pool,
+                      admission=admission)
+    attach_fleet(srv, node_id, spec)
+
+    def _term(signum, frame):
+        # cleanup() SIGTERMs this node: reap the daemon pool workers
+        # before dying — a SIGTERM'd parent skips Python cleanup, and
+        # an orphaned worker inherits (and holds open) the bench's
+        # stdout/stderr pipes forever, so the run looks hung
+        if pool is not None:
+            pool.close()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
+    srv.serve_forever()
+
+
+def start_fleet(args):
+    """Fork ``--nodes`` fleet node processes on free localhost ports;
+    returns ``(ports, cleanup)`` once every /healthz answers."""
+    import multiprocessing
+    import socket as _socket
+
+    ctx = multiprocessing.get_context("fork")
+    socks = []
+    for _ in range(args.nodes):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    tmp = None
+    cache_root = args.cache_dir
+    if not cache_root:
+        tmp = tempfile.mkdtemp(prefix="simumax-bench-fleet-")
+        cache_root = tmp
+    # NOT daemonic: a pooled node must fork its own worker processes
+    # (daemons may not have children); cleanup() reaps them instead
+    procs = [
+        ctx.Process(target=_fleet_node_proc,
+                    args=(i, ports, cache_root, args.workers,
+                          args.admission),
+                    daemon=False, name=f"bench-node-n{i}")
+        for i in range(args.nodes)
+    ]
+    for p in procs:
+        p.start()
+    deadline = time.monotonic() + 60.0
+    for port in ports:
+        while True:
+            try:
+                if get_json(port, "/healthz").get("status") == "ok":
+                    break
+            except (OSError, ValueError, http.client.HTTPException):
+                pass
+            if time.monotonic() > deadline:
+                for p in procs:
+                    p.terminate()
+                raise SystemExit(
+                    f"fleet node on port {port} never became healthy")
+            time.sleep(0.1)
+
+    def cleanup():
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join(5)
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    return ports, cleanup
+
+
+def partition_by_owner(burst, n_nodes: int):
+    """Client-side affinity routing: split ``(endpoint, body)`` items
+    by ring owner of each request's route key — the same deterministic
+    placement every node's router computes, so a partitioned client
+    hits only owners and no request pays a forwarding hop."""
+    from simumax_tpu.service.ring import HashRing
+    from simumax_tpu.service.router import route_key
+
+    ring = HashRing([f"n{i}" for i in range(n_nodes)])
+    shards = [[] for _ in range(n_nodes)]
+    for ep, body in burst:
+        owner = ring.owner(route_key(ep, resolve_strategy_body(body)))
+        shards[int(owner[1:])].append((ep, body))
+    return shards
+
+
+def replay_fleet(ports, burst, threads: int, depth: int = 1):
+    """Partitioned fleet replay: one forked client process per node
+    drains that node's owner shard with ``threads`` pipelined
+    connections. Returns ``(elapsed_s, sorted 2xx latencies, counts,
+    shard_sizes)`` — elapsed is wall clock over ALL nodes, so q/s
+    reflects true fleet throughput, not a per-node average."""
+    import multiprocessing
+
+    ctx = multiprocessing.get_context("fork")
+    shards = partition_by_owner(burst, len(ports))
+    out_q = ctx.Queue()
+    ps = []
+    for port, shard in zip(ports, shards):
+        if not shard:
+            continue
+        ps.append(ctx.Process(
+            target=_client_proc,
+            args=(port, serialize_burst(shard), threads, depth, out_q),
+            daemon=True))
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    lat = []
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    for _ in ps:
+        plat, pcounts = out_q.get()
+        lat.extend(plat)
+        for k, v in pcounts.items():
+            counts[k] += v
+    elapsed = time.perf_counter() - t0
+    for p in ps:
+        p.join()
+    return elapsed, sorted(lat), counts, [len(s) for s in shards]
+
+
+def _non_owner_port(ports):
+    """Port selector for the fleet parity sample: always a node that
+    does NOT own the request, so the compared bytes crossed the
+    router hop."""
+    from simumax_tpu.service.ring import HashRing
+    from simumax_tpu.service.router import route_key
+
+    ring = HashRing([f"n{i}" for i in range(len(ports))])
+
+    def pick(ep, body):
+        owner = int(ring.owner(route_key(ep, body))[1:])
+        return ports[(owner + 1) % len(ports)]
+
+    return pick
+
+
 def dump_forensics(port: int, out_dir: str):
     """Write the final /stats and /metrics bodies — plus, when
     ``--trace`` armed the tracer, the retained request span trees as
@@ -535,12 +728,15 @@ def pct(sorted_vals, q: float) -> float:
     return percentile(sorted_vals, q)
 
 
-def check_parity(port: int, unique, seed: int = 0, samples: int = 4):
+def check_parity(port: int, unique, seed: int = 0, samples: int = 4,
+                 port_for=None):
     """A seeded sample of responses must be byte-identical to direct
     cache-off evaluation. The search probe is pinned to a grid known to
     *evaluate* cells (llama3-8b fits on v5p, nothing prunes), so the
     warm per-cell-served path is genuinely exercised — a fully-pruned
-    sample would compare two trivially identical payloads."""
+    sample would compare two trivially identical payloads. The fleet
+    passes ``port_for`` to aim every sample at a NON-owner node: the
+    compared bytes then crossed the router hop."""
     from simumax_tpu.service.planner import Planner
     from simumax_tpu.service.server import response_bytes
 
@@ -557,7 +753,9 @@ def check_parity(port: int, unique, seed: int = 0, samples: int = 4):
     off = Planner(enabled=False)
     for ep, body in picks:
         body = resolve_strategy_body(body)
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        target = port_for(ep, body) if port_for else port
+        conn = http.client.HTTPConnection("127.0.0.1", target,
+                                          timeout=300)
         conn.request("POST", ep, json.dumps(body),
                      {"Content-Type": "application/json"})
         served = conn.getresponse().read()
@@ -736,6 +934,179 @@ def run_siege(args) -> int:
     return 0 if ok else 1
 
 
+def dump_fleet_forensics(ports, out_dir: str):
+    """Per-node /stats + /metrics + /ring/state under ``out_dir/n<i>``
+    — a failed fleet gate ships every node's serving- and ring-side
+    evidence."""
+    for i, port in enumerate(ports):
+        sub = os.path.join(out_dir, f"n{i}")
+        os.makedirs(sub, exist_ok=True)
+        with open(os.path.join(sub, "stats.json"), "w") as f:
+            json.dump(get_json(port, "/stats"), f, indent=2,
+                      default=str)
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read()
+        conn.close()
+        with open(os.path.join(sub, "metrics.txt"), "wb") as f:
+            f.write(body)
+        with open(os.path.join(sub, "ring_state.json"), "w") as f:
+            json.dump(get_json(port, "/ring/state"), f, indent=2,
+                      default=str)
+
+
+def run_fleet_siege(args) -> int:
+    """The multi-node siege: fill + Zipf replay with client-side
+    affinity routing across ``--nodes`` forked fleet nodes, a
+    NON-owner parity sample (bytes must survive the router hop),
+    an overload phase hammering n0 alone (admission composes across
+    router and pool), and a fleet-speedup gate vs a same-machine
+    single-node baseline. One JSON line, exit 1 on any gate."""
+    ports, cleanup = start_fleet(args)
+    overload = None
+    try:
+        _burst, unique = build_burst(args.siege_pool, 0.0, args.seed)
+        fill_s, _fill_lat, fill_counts, _fs = replay_fleet(
+            ports, unique, args.threads, depth=args.pipeline)
+        siege = zipf_burst(unique, args.queries, args.zipf, args.seed)
+        siege_s, siege_lat, siege_counts, shard_sizes = replay_fleet(
+            ports, siege, args.threads, depth=args.pipeline)
+        if args.admission and args.overload_queries:
+            # all-cold hammer on ONE node: n0 sheds what it cannot
+            # take, forwards what it does not own, and relays the
+            # owners' 429s verbatim — admission composes end to end
+            oburst = overload_burst(args.overload_queries, args.seed)
+            overload = replay_counted(ports[0], oburst,
+                                      args.overload_threads,
+                                      procs=args.client_procs)
+        parity_ok, parity_ep = (True, None) if args.skip_parity \
+            else check_parity(ports[0], unique, args.seed,
+                              port_for=_non_owner_port(ports))
+        ring_states = [get_json(p, "/ring/state") for p in ports]
+        if args.dump_forensics:
+            dump_fleet_forensics(ports, args.dump_forensics)
+    finally:
+        cleanup()
+
+    qps_siege = len(siege) / siege_s if siege_s else 0.0
+    qps_fill = len(unique) / fill_s if fill_s else 0.0
+    routers = [rs.get("router", {}) for rs in ring_states]
+    remotes = [(rs.get("flights", {}) or {}).get("remote", {})
+               for rs in ring_states]
+    result = {
+        "metric": "service_qps_siege",
+        "value": round(qps_siege, 2),
+        "unit": "q/s",
+        "mode": f"siege-pool{args.siege_pool}-z{args.zipf}",
+        "queries": len(siege),
+        "threads": args.threads,
+        "client_procs": args.client_procs,
+        "pipeline": args.pipeline,
+        "workers": args.workers,
+        "admission": args.admission,
+        "nodes": args.nodes,
+        # the scaling gate is meaningful only with >= nodes+1 cores;
+        # recorded baselines carry the recording machine's count
+        "cores": os.cpu_count(),
+        "qps_fill": round(qps_fill, 2),
+        "fill_queries": len(unique),
+        "p50_siege_ms": round(pct(siege_lat, 0.50) * 1e3, 2),
+        "p99_siege_ms": round(pct(siege_lat, 0.99) * 1e3, 2),
+        "fill_elapsed_s": round(fill_s, 3),
+        "siege_elapsed_s": round(siege_s, 3),
+        "shards": shard_sizes,
+        "errors": fill_counts["error"] + siege_counts["error"],
+        "shed_outside_overload": fill_counts["shed"]
+        + siege_counts["shed"],
+        "parity_ok": parity_ok,
+        "router_forwards": sum(r.get("forwards", 0) for r in routers),
+        "router_local": sum(r.get("local", 0) for r in routers),
+        "router_retries": sum(r.get("retries", 0) for r in routers),
+        "remote_follows": sum(r.get("remote_follows", 0)
+                              for r in remotes),
+    }
+    ok = True
+    if result["errors"]:
+        result["errors_ok"] = ok = False
+    if result["shed_outside_overload"]:
+        result["shed_ok"] = ok = False
+    if not parity_ok:
+        result["parity_endpoint"] = parity_ep
+        ok = False
+    if overload is not None:
+        o_s, o_lat, o_counts = overload
+        answered = sum(o_counts.values())
+        o_p99_ms = pct(o_lat, 0.99) * 1e3 if o_lat else 0.0
+        result.update({
+            "overload_queries": len(oburst),
+            "overload_threads": args.overload_threads,
+            "overload_elapsed_s": round(o_s, 3),
+            "overload_admitted": o_counts["ok"],
+            "overload_shed": o_counts["shed"],
+            "overload_errors": o_counts["error"],
+            "overload_p99_ms": round(o_p99_ms, 2),
+        })
+        if answered != len(oburst) or o_counts["error"]:
+            result["overload_answered_ok"] = ok = False
+        if not o_counts["shed"]:
+            result["overload_shed_ok"] = ok = False
+        if o_p99_ms > args.max_overload_p99_ms:
+            result["overload_p99_ok"] = ok = False
+    if args.vs_node:
+        with open(args.vs_node) as f:
+            base = json.load(f)
+        if base.get("metric") != "service_qps_siege" \
+                or base.get("nodes"):
+            print(json.dumps({
+                "error": f"--vs-node {args.vs_node} is not a "
+                         f"single-node siege baseline (need "
+                         f"metric=service_qps_siege without a "
+                         f"'nodes' key); record one on this machine "
+                         f"with --siege (no --nodes)",
+            }))
+            return 2
+        for key in ("mode", "queries", "pipeline"):
+            if base.get(key) != result[key]:
+                print(json.dumps({
+                    "error": f"--vs-node {key} {base.get(key)!r} != "
+                             f"this run's {result[key]!r}; not "
+                             f"comparable — re-record with matching "
+                             f"flags",
+                }))
+                return 2
+        speedup = qps_siege / base["value"] if base["value"] else 0.0
+        result["single_node_qps"] = base["value"]
+        result["fleet_speedup"] = round(speedup, 2)
+        if args.min_fleet_speedup and speedup < args.min_fleet_speedup:
+            result["fleet_speedup_ok"] = ok = False
+    if args.baseline:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        if not isinstance(base.get("value"), (int, float)):
+            print(json.dumps({
+                "error": f"baseline {args.baseline} has no numeric "
+                         f"'value' field",
+            }))
+            return 2
+        for key in ("mode", "queries", "threads", "workers",
+                    "admission", "nodes"):
+            if base.get(key, result[key]) != result[key]:
+                print(json.dumps({
+                    "error": f"baseline {key} {base.get(key)!r} != "
+                             f"this run's {result[key]!r}; not "
+                             f"comparable",
+                }))
+                return 2
+        floor = base["value"] * (1.0 - args.max_regression)
+        result["baseline_value"] = base["value"]
+        result["regression_ok"] = qps_siege >= floor
+        ok = ok and result["regression_ok"]
+    print(json.dumps(result))
+    record_safely(result)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--queries", type=int, default=1000,
@@ -824,6 +1195,22 @@ def main(argv=None):
     ap.add_argument("--min-pool-speedup", type=float, default=10.0,
                     help="min pooled-vs-single siege qps ratio "
                          "(default 10)")
+    ap.add_argument("--nodes", type=int, default=0, metavar="N",
+                    help="siege only: fork N fleet node processes "
+                         "(consistent-hash ring on localhost ports) "
+                         "and replay with client-side affinity "
+                         "routing; parity samples cross the router "
+                         "hop via non-owner nodes")
+    ap.add_argument("--vs-node", metavar="JSON",
+                    help="single-node siege JSON line recorded on "
+                         "THIS machine with matching traffic flags; "
+                         "gates --min-fleet-speedup against it")
+    ap.add_argument("--min-fleet-speedup", type=float, default=0.0,
+                    metavar="X",
+                    help="min fleet-vs-single-node siege qps ratio "
+                         "(0 = record without gating; CI passes "
+                         "0.8*N on multi-core runners — the gate "
+                         "needs >= nodes+1 cores to mean anything)")
     ap.add_argument("--dump-forensics", metavar="DIR",
                     help="write the final /stats + /metrics bodies "
                          "to DIR (CI uploads them on gate failure)")
@@ -835,7 +1222,14 @@ def main(argv=None):
         get_tracer().configure(enabled=True)
 
     if args.siege:
+        if args.nodes and args.nodes > 1:
+            return run_fleet_siege(args)
         return run_siege(args)
+    if args.nodes:
+        print(json.dumps({
+            "error": "--nodes is a siege-mode flag (add --siege)",
+        }))
+        return 2
     if args.workers or args.admission:
         print(json.dumps({
             "error": "--workers/--admission are siege-mode flags; "

@@ -1143,6 +1143,71 @@ def cmd_serve(args):
         trace_log = os.path.join(args.trace_requests, "requests.jsonl")
         get_tracer().configure(enabled=True)
     enabled = _cache_enabled(args)
+    node_id = ring_spec = None
+    if args.ring or args.join:
+        from simumax_tpu.core.errors import ConfigError
+        from simumax_tpu.service.ring import (
+            format_ring_spec,
+            parse_ring_spec,
+        )
+
+        if not (args.ring and args.join):
+            raise ConfigError("--ring and --join go together: the "
+                              "spec names the fleet, --join picks "
+                              "which member this process is")
+        members = parse_ring_spec(args.ring)
+        if args.join not in members:
+            raise ConfigError(
+                f"--join {args.join!r} is not a member of "
+                f"--ring {args.ring!r}")
+        node_id = args.join
+        ring_spec = format_ring_spec(members)
+        # bind where the ring says this member lives
+        args.host, args.port = members[node_id]
+    elif args.nodes and args.nodes > 1:
+        from simumax_tpu.core.errors import ConfigError
+        from simumax_tpu.search.executor import _mp_context
+        from simumax_tpu.service.ring import format_ring_spec
+
+        if not args.port:
+            raise ConfigError("--nodes needs a concrete --port base "
+                              "(ports are consecutive from it), not "
+                              "an ephemeral 0")
+        members = {f"n{i}": (args.host, args.port + i)
+                   for i in range(args.nodes)}
+        node_id, ring_spec = "n0", format_ring_spec(members)
+        # fork the sibling nodes; each re-enters this command as an
+        # explicit --ring/--join member. Not daemonic (a pooled node
+        # forks its own workers, and daemons may not have children) —
+        # atexit reaps the fleet when this (n0) process exits.
+        import atexit
+
+        ctx = _mp_context()
+        siblings = []
+        for i in range(1, args.nodes):
+            child = argparse.Namespace(**vars(args))
+            child.nodes = 0
+            child.ring = ring_spec
+            child.join = f"n{i}"
+            p = ctx.Process(target=cmd_serve, args=(child,),
+                            daemon=False, name=f"planner-node-n{i}")
+            p.start()
+            siblings.append(p)
+
+        def _reap():
+            for p in siblings:
+                p.terminate()
+            for p in siblings:
+                p.join(5)
+
+        atexit.register(_reap)
+    if node_id is not None and enabled:
+        # one store shard per fleet member: each node is the single
+        # writer of its own root (peers replicate read-only)
+        from simumax_tpu.service.store import default_cache_dir
+
+        args.cache_dir = os.path.join(
+            args.cache_dir or default_cache_dir(), f"fleet-{node_id}")
     pool = None
     if args.workers:
         from simumax_tpu.service.pool import WorkerPool
@@ -1152,6 +1217,8 @@ def cmd_serve(args):
             workers=args.workers, max_bytes=max_bytes,
             request_timeout=args.request_timeout or None,
             trace=bool(args.trace_requests),
+            fleet_spec=(node_id, ring_spec)
+            if node_id is not None else None,
         )
         # the in-process planner still serves streaming sweeps and
         # /stats; it shares the pool's single-writer store (same
@@ -1186,6 +1253,11 @@ def cmd_serve(args):
     srv = make_server(planner, args.host, args.port,
                       trace_log=trace_log, pool=pool,
                       admission=admission, warmer=warmer)
+    if node_id is not None:
+        from simumax_tpu.service.node import attach_fleet
+
+        attach_fleet(srv, node_id, ring_spec,
+                     replicate_s=args.replicate_s)
     host, port = srv.server_address[:2]
     cache_desc = (
         planner.store.root if planner.enabled else "disabled"
@@ -1201,10 +1273,12 @@ def cmd_serve(args):
         + (f"; admission backlog {args.admission}" if admission
            else "")
         + (f"; warm queue {args.warm}" if warmer else "")
+        + (f"; fleet node {node_id} of ring {ring_spec}"
+           if node_id is not None else "")
         + (f"; request traces -> {trace_log}" if trace_log else ""),
         event="serve_start", host=host, port=port, cache=cache_desc,
         workers=args.workers, admission=args.admission,
-        warm=args.warm,
+        warm=args.warm, node=node_id or "",
     )
     serve_forever(srv)
 
@@ -1791,6 +1865,32 @@ def main(argv=None):
         help="pooled mode: per-request SIGALRM deadline on the worker "
              "(plus the 5x+30s hard kill backstop). Default 0: no "
              "deadline",
+    )
+    psv.add_argument(
+        "--nodes", type=int, default=0, metavar="N",
+        help="fleet convenience mode: fork N-1 sibling nodes on "
+             "consecutive ports from --port (this process serves "
+             "node n0) joined in one consistent-hash ring — sharded "
+             "store, affinity routing, fleet-wide cell coalescing "
+             "(docs/service.md 'Planner fleet'). Default 0: single "
+             "node",
+    )
+    psv.add_argument(
+        "--ring", metavar="SPEC",
+        help="explicit fleet membership 'id=host:port,id=host:port,"
+             "...' — start every member with the same SPEC; requires "
+             "--join",
+    )
+    psv.add_argument(
+        "--join", metavar="ID",
+        help="this process's node id within --ring (bind host/port "
+             "come from the matching SPEC entry)",
+    )
+    psv.add_argument(
+        "--replicate-s", type=float, default=0, metavar="SEC",
+        help="fleet mode: pull read-only replicas of peer-owned "
+             "store entries every SEC seconds (default 0: replicate "
+             "only on POST /ring/replicate)",
     )
     _add_cache_args(psv)
     _add_log_args(psv)

@@ -163,7 +163,34 @@ METRICS: Dict[str, Dict[str, str]] = {
     "warmer_jobs_total": {
         "type": "counter",
         "help": "Speculative cache-warming jobs, by outcome (warmed/"
-                "duplicate/dropped/skipped_headroom/error).",
+                "duplicate/dropped/skipped_headroom/skipped_remote/"
+                "error).",
+    },
+    "ring_nodes": {
+        "type": "gauge",
+        "help": "Fleet members in this node's consistent-hash ring "
+                "view.",
+    },
+    "router_forwards_total": {
+        "type": "counter",
+        "help": "Requests relayed to their ring owner, by destination "
+                "node.",
+    },
+    "router_local_hits_total": {
+        "type": "counter",
+        "help": "Requests whose route key this node already owned "
+                "(served locally, no fleet hop).",
+    },
+    "coalesce_remote_follows_total": {
+        "type": "counter",
+        "help": "Sweep cells served by following another node's "
+                "in-flight evaluation over the wire instead of "
+                "re-evaluating.",
+    },
+    "replica_pulls_total": {
+        "type": "counter",
+        "help": "Store entries copied from a peer's shard by the "
+                "read-only replica pull loop.",
     },
     "warmer_cells_total": {
         "type": "counter",

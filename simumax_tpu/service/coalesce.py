@@ -108,6 +108,15 @@ class CellFlightTable:
             return None
         return flight.outcome
 
+    def flight(self, key: str) -> Optional[CellFlight]:
+        """The in-flight record of ``key``, or None once it settled —
+        the lookup behind the fleet's wire-level wait endpoint
+        (``service/node.py``): a remote follower that arrives after
+        the publish finds no flight and falls back to the owner's
+        store, where the publish already landed."""
+        with self._lock:
+            return self._flights.get(key)
+
     def inflight(self) -> int:
         with self._lock:
             return len(self._flights)

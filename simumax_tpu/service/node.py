@@ -1,0 +1,615 @@
+"""Fleet node wiring: wire-level cell coalescing + replica pull (L19).
+
+A fleet node is an ordinary ``serve`` process (planner, optional pool,
+warmer, admission) plus three fleet attachments, assembled by
+:func:`attach_fleet`:
+
+* a :class:`~simumax_tpu.service.router.Router` — requests this node
+  does not own forward to the owner with raw-byte pass-through
+  (``service/router.py``);
+* a :class:`FleetCellFlightTable` — PR 13's per-process
+  ``CellFlightTable`` generalized over the wire. Every sweep cell's
+  content-addressed store key has one ring owner; the first sweep
+  anywhere in the fleet to want a missing cell claims it *at the
+  owner* (``POST /ring/cells/claim``) and every other node touching
+  the same grid follows (``/ring/cells/wait`` long-poll) instead of
+  re-evaluating. A leader publishes through the owner
+  (``/ring/cells/publish``), which writes the outcome into the
+  owner's store shard *before* releasing the flight — so the cell
+  lands exactly where every future claim looks first, and the
+  fleet's evaluated-cells total equals the union of demanded cells
+  (pinned by ``tests/test_service_fleet.py``). Warm jobs ride the
+  same table, so a cell warmed on one node is never re-warmed on
+  another;
+* a :class:`Replicator` — read-only shard replication under the
+  single-writer rule: every node writes only its own store; replicas
+  *pull* (``/ring/entries`` manifest + ``/ring/entry`` raw bytes),
+  keyed by the store's ``(path, mtime, size)`` stamps, installing
+  entries whose ring placement names them owner or successor. The
+  wire format is the disk format (header + payload, digest
+  re-verified on import), so a replicated entry is byte-identical.
+
+Failure semantics are fail-open everywhere: an unreachable owner means
+this node leads the cell itself (claim RPC error), a follower of a
+dead leader re-evaluates (lease expiry abandons the claim; abandoning
+wakes waiters with ``outcome=None``), and a dead owner's requests
+retry down the ring successors (``router.py``) — correctness never
+depends on another node being alive, only deduplication does.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from simumax_tpu.observe.telemetry import get_registry
+from simumax_tpu.service.coalesce import CellFlightTable
+from simumax_tpu.service.ring import (
+    DEFAULT_VNODES,
+    HashRing,
+    format_ring_spec,
+    parse_ring_spec,
+)
+from simumax_tpu.service.router import Router, route_key
+
+#: control-plane RPC budget (claim / publish / abandon / manifest):
+#: these are single dict round-trips; a peer that cannot answer in
+#: this window is treated as down and the caller fails open
+RPC_TIMEOUT_S = 10.0
+
+#: longest one /ring/cells/wait long-poll blocks server-side; the
+#: client re-enters the wait until outcome, abandon, or lease expiry
+REMOTE_WAIT_S = 60.0
+
+#: total seconds a follower waits on a remotely-claimed cell before
+#: giving up and evaluating it itself — strictly longer than the
+#: owner-side lease, so lease expiry (not this deadline) is the normal
+#: dead-leader exit
+REMOTE_WAIT_TOTAL_S = 300.0
+
+#: seconds the owner holds a claim granted to a *remote* leader before
+#: abandoning it (waking all followers to self-evaluate) — the no-hang
+#: backstop for a leader whose whole process died mid-sweep
+REMOTE_LEASE_S = 240.0
+
+#: replicas per key beyond the owner (owner + 1 successor)
+REPLICA_COUNT = 1
+
+RING_CLAIM = "/ring/cells/claim"
+RING_PUBLISH = "/ring/cells/publish"
+RING_ABANDON = "/ring/cells/abandon"
+RING_WAIT = "/ring/cells/wait"
+RING_ENTRIES = "/ring/entries"
+RING_ENTRY = "/ring/entry"
+RING_REPLICATE = "/ring/replicate"
+RING_STATE = "/ring/state"
+
+
+def _rpc(members: Dict[str, Tuple[str, int]], node: str, path: str,
+         payload: dict, timeout: float) -> Optional[dict]:
+    """One JSON round-trip to a peer's ring surface; None on any
+    transport or status failure (callers fail open)."""
+    host, port = members[node]
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf-8")
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            return None
+        out = json.loads(data.decode("utf-8"))
+        return out if isinstance(out, dict) else None
+    except (OSError, http.client.HTTPException, ValueError):
+        return None
+    finally:
+        conn.close()
+
+
+def _rpc_bytes(members: Dict[str, Tuple[str, int]], node: str,
+               path: str, payload: dict,
+               timeout: float) -> Optional[bytes]:
+    """Like :func:`_rpc` but returns the raw response body (the
+    replica-pull entry transfer)."""
+    host, port = members[node]
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload).encode("utf-8")
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        return data if resp.status == 200 else None
+    except (OSError, http.client.HTTPException):
+        return None
+    finally:
+        conn.close()
+
+
+class _RemoteFollow:
+    """A cell this process locally leads but fleet-follows: the wire
+    flight handle ``FleetCellFlightTable.wait`` resolves. Carries the
+    local flight so local followers of this process wake with the
+    remote outcome too."""
+
+    __slots__ = ("key", "local_flight", "owner", "outcome")
+
+    def __init__(self, key, local_flight, owner, outcome=None):
+        self.key = key
+        self.local_flight = local_flight
+        self.owner = owner
+        #: pre-resolved outcome (the owner's store already held the
+        #: cell at claim time) — wait() returns it without an RPC
+        self.outcome = outcome
+
+
+class FleetCellFlightTable:
+    """The wire-level :class:`CellFlightTable`: same
+    claim/publish/abandon/wait contract the sweep path speaks
+    (``search/searcher.py``), coordinating through each cell's ring
+    owner.
+
+    ``authoritative=True`` (a node's parent planner): cells this node
+    owns are claimed on the embedded local table directly — it IS the
+    owner-side table remote peers claim against. ``False`` (a pool
+    worker): every claim goes over the wire, including to this
+    worker's own parent node — which makes the parent table
+    coordinate the node's workers with each other as well as with
+    the rest of the fleet."""
+
+    def __init__(self, node_id: str,
+                 members: Dict[str, Tuple[str, int]],
+                 local: Optional[CellFlightTable] = None,
+                 registry=None, authoritative: bool = True,
+                 vnodes: int = DEFAULT_VNODES):
+        self.node_id = node_id
+        self.members = dict(members)
+        self.ring = HashRing(sorted(members), vnodes=vnodes)
+        self.registry = registry or get_registry()
+        self.local = local if local is not None \
+            else CellFlightTable(registry=self.registry)
+        self.authoritative = authoritative
+        self._lock = threading.Lock()
+        #: keys this process fleet-leads at a remote owner — publish
+        #: and abandon must also release the owner-side claim
+        self._remote_led: set = set()
+        self.counters = {"remote_leads": 0, "remote_follows": 0,
+                         "remote_abandoned": 0, "rpc_errors": 0}
+
+    def _count(self, name: str):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    # -- the CellFlightTable contract --------------------------------------
+    def claim(self, key: str):
+        flight, leader = self.local.claim(key)
+        if not leader:
+            # another sweep in this process already coordinates this
+            # cell (fleet-leading or fleet-following it)
+            return flight, False
+        owner = self.ring.owner(key)
+        if self.authoritative and owner == self.node_id:
+            return flight, True
+        resp = _rpc(self.members, owner, RING_CLAIM, {"key": key},
+                    RPC_TIMEOUT_S)
+        if resp is None:
+            # owner unreachable: lead locally — dedup degrades, the
+            # sweep never blocks on a dead peer
+            self._count("rpc_errors")
+            return flight, True
+        if resp.get("leader"):
+            with self._lock:
+                self._remote_led.add(key)
+            self._count("remote_leads")
+            return flight, True
+        return _RemoteFollow(key, flight, owner,
+                             outcome=resp.get("outcome")), False
+
+    def publish(self, key: str, outcome: dict):
+        self.local.publish(key, outcome)
+        with self._lock:
+            led = key in self._remote_led
+            self._remote_led.discard(key)
+        if led:
+            owner = self.ring.owner(key)
+            if _rpc(self.members, owner, RING_PUBLISH,
+                    {"key": key, "outcome": outcome},
+                    RPC_TIMEOUT_S) is None:
+                self._count("rpc_errors")
+
+    def abandon(self, key: str):
+        self.local.abandon(key)
+        with self._lock:
+            led = key in self._remote_led
+            self._remote_led.discard(key)
+        if led:
+            owner = self.ring.owner(key)
+            if _rpc(self.members, owner, RING_ABANDON, {"key": key},
+                    RPC_TIMEOUT_S) is None:
+                self._count("rpc_errors")
+
+    def wait(self, flight, timeout: Optional[float] = None
+             ) -> Optional[dict]:
+        if not isinstance(flight, _RemoteFollow):
+            return self.local.wait(flight, timeout)
+        outcome = flight.outcome
+        if outcome is None:
+            budget = REMOTE_WAIT_TOTAL_S if timeout is None \
+                else min(timeout, REMOTE_WAIT_TOTAL_S)
+            spent = 0.0
+            while spent < budget and outcome is None:
+                step = min(REMOTE_WAIT_S, budget - spent)
+                resp = _rpc(self.members, flight.owner, RING_WAIT,
+                            {"key": flight.key, "timeout": step},
+                            step + RPC_TIMEOUT_S)
+                if resp is None:
+                    self._count("rpc_errors")
+                    break
+                outcome = resp.get("outcome")
+                if outcome is None and not resp.get("pending"):
+                    break  # abandoned (or settled as a non-persisted
+                    # error) at the owner: evaluate it ourselves
+                spent += step
+        if outcome is None:
+            # wake this process's local followers to self-evaluate —
+            # a dead fleet leader must never hang a whole node
+            self.local.abandon(flight.key)
+            self._count("remote_abandoned")
+            return None
+        # deliver to local followers BEFORE returning (same
+        # publish-then-return order a local leader gives them)
+        self.local.publish(flight.key, outcome)
+        self._count("remote_follows")
+        self.registry.counter("coalesce_remote_follows_total").inc()
+        return outcome
+
+    def inflight(self) -> int:
+        return self.local.inflight()
+
+    def stats(self) -> dict:
+        out = self.local.stats()
+        with self._lock:
+            out["remote"] = dict(self.counters)
+        out["remote"]["node_id"] = self.node_id
+        return out
+
+
+def build_worker_flights(node_id: str, ring_spec: str,
+                         registry=None) -> FleetCellFlightTable:
+    """The pool-worker constructor (``pool._worker_main``): a
+    non-authoritative table that claims every cell over the wire —
+    through its own parent node for self-owned cells, so all of a
+    node's workers coordinate through the one parent table."""
+    return FleetCellFlightTable(
+        node_id, parse_ring_spec(ring_spec), registry=registry,
+        authoritative=False,
+    )
+
+
+class Replicator:
+    """Pull-side shard replication. The single-writer rule holds:
+    this node's parent process is the only writer of this node's
+    store; it *pulls* raw entries from peers and installs them
+    atomically. Freshness is the peer's ``(path, mtime, size)`` stamp
+    — re-pull exactly when the peer replaced the file."""
+
+    def __init__(self, node_id: str,
+                 members: Dict[str, Tuple[str, int]],
+                 ring: HashRing, store, registry=None):
+        self.node_id = node_id
+        self.members = dict(members)
+        self.ring = ring
+        self.store = store
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        #: (peer, namespace, key) -> last-pulled stamp
+        self._seen: Dict[tuple, list] = {}
+        self.counters = {"rounds": 0, "checked": 0, "pulled": 0,
+                         "skipped_same": 0, "peer_errors": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _wants(self, key: str) -> bool:
+        """This node replicates the keys whose ring placement names it
+        owner or one of the ``REPLICA_COUNT`` successors."""
+        return self.node_id in self.ring.successors(
+            key, REPLICA_COUNT + 1)
+
+    def pull_once(self) -> dict:
+        """One full pull round over every peer; returns the round's
+        accounting (the ``POST /ring/replicate`` response)."""
+        if self.store is None:
+            return {"checked": 0, "pulled": 0, "disabled": True}
+        checked = pulled = skipped = 0
+        for peer in sorted(self.members):
+            if peer == self.node_id:
+                continue
+            resp = _rpc(self.members, peer, RING_ENTRIES, {},
+                        RPC_TIMEOUT_S)
+            if resp is None:
+                with self._lock:
+                    self.counters["peer_errors"] += 1
+                continue
+            for row in resp.get("entries", ()):
+                ns = row.get("namespace")
+                key = row.get("key")
+                if not ns or not key or not self._wants(key):
+                    continue
+                checked += 1
+                stamp = row.get("stamp")
+                seen_key = (peer, ns, key)
+                with self._lock:
+                    fresh = self._seen.get(seen_key) == stamp
+                if fresh:
+                    continue
+                sha = row.get("sha256")
+                if sha and self.store.entry_sha(ns, key) == sha:
+                    # we already hold these bytes (evaluated here, or
+                    # pulled from another peer): stamp it seen
+                    skipped += 1
+                    with self._lock:
+                        self._seen[seen_key] = stamp
+                    continue
+                raw = _rpc_bytes(self.members, peer, RING_ENTRY,
+                                 {"namespace": ns, "key": key},
+                                 RPC_TIMEOUT_S)
+                if raw is None:
+                    with self._lock:
+                        self.counters["peer_errors"] += 1
+                    continue
+                if self.store.import_entry(ns, key, raw):
+                    pulled += 1
+                    with self._lock:
+                        self._seen[seen_key] = stamp
+                    self.registry.counter("replica_pulls_total").inc()
+        with self._lock:
+            self.counters["rounds"] += 1
+            self.counters["checked"] += checked
+            self.counters["pulled"] += pulled
+            self.counters["skipped_same"] += skipped
+        return {"checked": checked, "pulled": pulled,
+                "skipped_same": skipped}
+
+    def start(self, interval_s: float):
+        """Background pull loop (``serve --replicate-s``); off by
+        default — tests and the bench drive rounds synchronously via
+        ``POST /ring/replicate``."""
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.pull_once()
+                except Exception:
+                    with self._lock:
+                        self.counters["peer_errors"] += 1
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="planner-replicator")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self.counters, seen=len(self._seen))
+
+
+class FleetNode:
+    """One node's fleet state: ring + router + owner-side flight
+    surface + replicator, attached to a ``PlannerHTTPServer`` by
+    :func:`attach_fleet` (the server dispatches ``/ring/*`` here)."""
+
+    def __init__(self, node_id: str,
+                 members: Dict[str, Tuple[str, int]],
+                 planner, registry=None,
+                 vnodes: int = DEFAULT_VNODES):
+        self.node_id = node_id
+        self.members = dict(members)
+        self.registry = registry or get_registry()
+        self.ring = HashRing(sorted(members), vnodes=vnodes)
+        self.router = Router(self.ring, node_id, members,
+                             registry=self.registry)
+        self.planner = planner
+        self.store = planner.store if planner.enabled else None
+        #: the node's one authoritative flight table: the planner's
+        #: existing local table, wrapped for the wire — remote peers
+        #: claim against it (handle_ring), local sweeps through the
+        #: planner, and the node's pool workers through loopback RPC
+        local = getattr(planner.cell_flights, "local",
+                        planner.cell_flights)
+        self.flights = FleetCellFlightTable(
+            node_id, members, local=local, registry=self.registry,
+            authoritative=True, vnodes=vnodes)
+        planner.cell_flights = self.flights
+        self.replicator = Replicator(node_id, members, self.ring,
+                                     self.store,
+                                     registry=self.registry)
+        #: owner-side leases on claims granted to remote leaders
+        self._leases: Dict[str, threading.Timer] = {}
+        self._lease_lock = threading.Lock()
+        self.registry.gauge("ring_nodes").set(len(self.ring))
+
+    @property
+    def local_flights(self) -> CellFlightTable:
+        return self.flights.local
+
+    # -- owner-side lease --------------------------------------------------
+    def _arm_lease(self, key: str):
+        def expire():
+            with self._lease_lock:
+                self._leases.pop(key, None)
+            # the remote leader never published: wake every waiter
+            # (local sweeps, long-polls, this node's workers) to
+            # re-evaluate — no follower hangs on a dead leader
+            self.local_flights.abandon(key)
+
+        timer = threading.Timer(REMOTE_LEASE_S, expire)
+        timer.daemon = True
+        with self._lease_lock:
+            old = self._leases.pop(key, None)
+            self._leases[key] = timer
+        if old is not None:
+            old.cancel()
+        timer.start()
+
+    def _release_lease(self, key: str):
+        with self._lease_lock:
+            timer = self._leases.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+
+    # -- the /ring/* surface -----------------------------------------------
+    def handle_ring(self, path: str, q: dict):
+        """Serve one ring RPC; returns ``(status, payload)`` where
+        payload is a JSON-safe dict — or raw bytes for
+        ``/ring/entry`` (the wire format is the disk format)."""
+        if path == RING_CLAIM:
+            return self._claim(q)
+        if path == RING_PUBLISH:
+            return self._publish(q)
+        if path == RING_ABANDON:
+            self._release_lease(q["key"])
+            self.local_flights.abandon(q["key"])
+            return 200, {"ok": True}
+        if path == RING_WAIT:
+            return self._wait(q)
+        if path == RING_ENTRIES:
+            if self.store is None:
+                return 200, {"entries": []}
+            return 200, {"entries":
+                         self.store.manifest(q.get("namespace"))}
+        if path == RING_ENTRY:
+            raw = self.store.export_entry(q["namespace"], q["key"]) \
+                if self.store is not None else None
+            if raw is None:
+                return 404, {"error": "no such entry"}
+            return 200, raw
+        if path == RING_REPLICATE:
+            return 200, self.replicator.pull_once()
+        if path == RING_STATE:
+            return 200, self.state()
+        return 404, {"error": f"unknown ring path {path}"}
+
+    def _claim(self, q: dict):
+        key = q["key"]
+        # the owner's store is the first authority: a settled cell is
+        # served, never re-claimed (this is also how a whole sweep
+        # previously evaluated elsewhere in the fleet comes back as
+        # pure follows)
+        if self.store is not None:
+            entry = self.store.get("sweep", key)
+            if isinstance(entry, dict) \
+                    and entry.get("status") in ("ok", "empty"):
+                return 200, {"leader": False, "outcome": entry}
+        _flight, leader = self.local_flights.claim(key)
+        if leader:
+            # remote leader: lease the claim so its death cannot hang
+            # the fleet's followers
+            self._arm_lease(key)
+        return 200, {"leader": leader}
+
+    def _publish(self, q: dict):
+        key, outcome = q["key"], q.get("outcome") or {}
+        self._release_lease(key)
+        # store BEFORE publish (the CellFlightTable contract): a
+        # late claim that missed the flight finds the entry in this
+        # shard. Error outcomes publish but never persist — same rule
+        # as the local sweep path.
+        if self.store is not None \
+                and outcome.get("status") in ("ok", "empty"):
+            try:
+                self.store.put("sweep", key, {
+                    "status": outcome.get("status"),
+                    "row": outcome.get("row"),
+                    "error": outcome.get("error"),
+                })
+            except OSError:
+                pass
+        self.local_flights.publish(key, outcome)
+        return 200, {"ok": True}
+
+    def _wait(self, q: dict):
+        key = q["key"]
+        timeout = min(float(q.get("timeout") or REMOTE_WAIT_S),
+                      REMOTE_WAIT_S)
+        flight = self.local_flights.flight(key)
+        if flight is None:
+            # settled (or never claimed): the store is the answer
+            if self.store is not None:
+                entry = self.store.get("sweep", key)
+                if isinstance(entry, dict) \
+                        and entry.get("status") in ("ok", "empty"):
+                    return 200, {"outcome": entry, "pending": False}
+            return 200, {"outcome": None, "pending": False}
+        outcome = self.local_flights.wait(flight, timeout)
+        if outcome is None:
+            # timed out (still pending — the caller re-polls) or
+            # abandoned (event set with no outcome — the caller
+            # evaluates)
+            pending = not flight.event.is_set()
+            return 200, {"outcome": None, "pending": pending}
+        return 200, {"outcome": outcome, "pending": False}
+
+    # -- introspection -----------------------------------------------------
+    def state(self) -> dict:
+        """The ring-state forensics document (``GET /ring/state``)."""
+        return {
+            "node_id": self.node_id,
+            "members": {n: list(a)
+                        for n, a in sorted(self.members.items())},
+            "ring": self.ring.stats(),
+            "router": self.router.stats(),
+            "flights": self.flights.stats(),
+            "replicator": self.replicator.stats(),
+            "leases": len(self._leases),
+        }
+
+    def close(self):
+        self.replicator.close()
+        self.router.close()
+        with self._lease_lock:
+            timers = list(self._leases.values())
+            self._leases.clear()
+        for t in timers:
+            t.cancel()
+
+
+def warm_route_filter(node: FleetNode) -> Callable[[dict], bool]:
+    """Warmer gate: only warm the sweeps this node owns — the owner's
+    warmer warms them into the right shard, and two nodes never race
+    to warm the same neighborhood (``service/warmer.py``)."""
+    def owns(search_body: dict) -> bool:
+        return node.ring.owner(
+            route_key("/v1/search", search_body)) == node.node_id
+
+    return owns
+
+
+def attach_fleet(server, node_id: str, ring_spec: str,
+                 replicate_s: float = 0.0,
+                 vnodes: int = DEFAULT_VNODES) -> FleetNode:
+    """Turn one built ``PlannerHTTPServer`` into a fleet node: parse
+    the membership spec, wrap the planner's flight table for the
+    wire, mount the router and the ``/ring/*`` surface, gate the
+    warmer to owned sweeps, and (optionally) start the background
+    replica pull. Returns the :class:`FleetNode` (also at
+    ``server.fleet``)."""
+    members = parse_ring_spec(ring_spec)
+    if node_id not in members:
+        from simumax_tpu.core.errors import ConfigError
+
+        raise ConfigError(
+            f"--join {node_id!r} is not a member of the ring "
+            f"({format_ring_spec(members)})")
+    node = FleetNode(node_id, members, server.planner,
+                     registry=server.registry, vnodes=vnodes)
+    server.fleet = node
+    server.router = node.router
+    if server.warmer is not None:
+        server.warmer.route_filter = warm_route_filter(node)
+    if replicate_s > 0:
+        node.replicator.start(replicate_s)
+    return node

@@ -236,7 +236,8 @@ class Planner:
                  cache_dir: Optional[str] = None,
                  max_bytes: Optional[int] = None,
                  enabled: bool = True,
-                 registry=None):
+                 registry=None,
+                 cell_flights=None):
         from simumax_tpu.observe.telemetry import get_registry
 
         #: metrics registry this planner (and the store it builds)
@@ -258,10 +259,15 @@ class Planner:
         self._loader = ConfigLoader()
         #: in-flight sweep-cell coalescing across this planner's
         #: concurrent sweeps (service/coalesce.py): overlapping grids
-        #: share cells that are being evaluated, not just stored ones
+        #: share cells that are being evaluated, not just stored ones.
+        #: A fleet node swaps in the wire-level table
+        #: (service/node.py FleetCellFlightTable — same contract,
+        #: coordinated through each cell's ring owner); pool workers
+        #: in a fleet are built with one directly (``cell_flights=``).
         from simumax_tpu.service.coalesce import CellFlightTable
 
-        self.cell_flights = CellFlightTable(registry=self.registry)
+        self.cell_flights = cell_flights if cell_flights is not None \
+            else CellFlightTable(registry=self.registry)
 
     # -- plumbing ----------------------------------------------------------
     def _count(self, name: str, n: int = 1):

@@ -419,7 +419,7 @@ class ResponseCache:
 
 def _worker_main(slot: int, task_q, result_q, cache_dir: Optional[str],
                  enabled: bool, request_timeout: Optional[float],
-                 trace: bool):
+                 trace: bool, fleet_spec: Optional[tuple] = None):
     """Long-lived planner worker: evaluates one request at a time on
     its MAIN thread (so the SIGALRM per-request deadline is fully
     effective, like a sweep pool worker), over a read-only store
@@ -434,6 +434,17 @@ def _worker_main(slot: int, task_q, result_q, cache_dir: Optional[str],
         tracer.configure(enabled=True)
     replica = ReplicaStore(cache_dir) if enabled else None
     planner = Planner(store=replica, enabled=enabled)
+    if fleet_spec is not None:
+        # fleet member: this worker's cell claims go over the wire to
+        # each cell's ring owner (non-authoritative — even cells this
+        # NODE owns round-trip through the parent's flight table via
+        # loopback, which also coalesces sibling workers against each
+        # other)
+        from simumax_tpu.service.node import build_worker_flights
+
+        node_id, ring_spec = fleet_spec
+        planner.cell_flights = build_worker_flights(
+            node_id, ring_spec, registry=planner.registry)
 
     def totals() -> dict:
         out = {"planner": dict(planner.counters)}
@@ -515,7 +526,8 @@ class WorkerPool:
                  memcache_entries: int = MEMCACHE_ENTRIES,
                  memcache_bytes: int = MEMCACHE_BYTES,
                  max_bytes: Optional[int] = None,
-                 trace: bool = False):
+                 trace: bool = False,
+                 fleet_spec: Optional[tuple] = None):
         from simumax_tpu.observe.telemetry import get_registry
         from simumax_tpu.search.executor import _mp_context
 
@@ -524,6 +536,10 @@ class WorkerPool:
         self.workers = max(1, int(workers))
         self.request_timeout = request_timeout
         self.trace = trace
+        #: ``(node_id, ring_spec)`` when this pool serves a fleet node:
+        #: workers claim sweep cells at each cell's ring owner instead
+        #: of a per-process table (service/node.py)
+        self.fleet_spec = fleet_spec
         self._ctx = _mp_context()
         #: the parent-side store: THE single writer of the shared root
         store_kwargs = {} if max_bytes is None \
@@ -584,7 +600,8 @@ class WorkerPool:
         w.process = self._ctx.Process(
             target=_worker_main,
             args=(w.slot, w.task_q, w.result_q, self.cache_dir,
-                  self.enabled, self.request_timeout, self.trace),
+                  self.enabled, self.request_timeout, self.trace,
+                  self.fleet_spec),
             daemon=True, name=f"planner-worker-{w.slot}",
         )
         w.process.start()

@@ -1,0 +1,258 @@
+"""Affinity routing across the planner fleet (L19).
+
+PR 13's pool routes each search to a worker by an affinity hash
+(``pool.search_affinity``); the fleet applies the same idea one level
+up: every ``/v1/*`` request has a deterministic **route key** — the
+canonical JSON of its identity fields, the same fields that prefix its
+content-addressed store key — and the consistent-hash ring
+(``service/ring.py``) maps that key to the one node that owns the
+request's store shard. A node receiving a request it does not own
+forwards the raw request bytes to the owner and streams the owner's
+raw response bytes back — no re-parse, no re-serialize — so a routed
+response is bit-identical to asking the owner (or a cache-off planner)
+directly.
+
+Sweep grids route on their run identity *minus the grid dimensions*:
+two overlapping grids (``tp=1,2`` vs ``tp=1,2,4``) land on the same
+owner, where the node-local ``CellFlightTable`` coalesces their shared
+cells; clients that hit arbitrary nodes instead are coalesced by the
+wire-level flight table (``service/node.py``).
+
+Failure semantics: forwarding retries down ``ring.successors(key)``
+on connection-level errors (refused / reset / timeout before any
+response byte), so a dead owner degrades to its successor — which can
+always evaluate (every node holds the full config registries; the
+shard only decides where results are *cached*) and may already hold a
+replica (``service/node.py`` replica pull). Once response bytes have
+been relayed the request is never retried (no double-answer); a
+forwarded 429 passes through verbatim, so admission composes across
+the router hop and the owner's pool.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from simumax_tpu.observe.telemetry import get_registry, get_tracer
+from simumax_tpu.service.ring import HashRing
+from simumax_tpu.service.store import content_key
+
+#: request-body fields that never change which store shard a request
+#: belongs to: sweep grid dimensions (overlapping grids must share an
+#: owner for cell coalescing) and pure serving knobs
+SEARCH_VOLATILE_FIELDS = frozenset({
+    "tp", "pp", "ep", "cp", "zero", "recompute",
+    "topk", "verify_topk", "stream", "search_mode", "prune",
+})
+
+#: seconds a forwarded request may wait on the owner before the router
+#: gives up on that hop and tries the successor (covers connect +
+#: response head; generous — owners under load answer via admission
+#: control, not silence)
+FORWARD_TIMEOUT_S = 120.0
+
+#: response headers relayed verbatim from the owner — the serving
+#: metadata contract of docs/service.md (cache/key/served/cells ride
+#: headers, never the body) plus transport framing
+RELAY_HEADERS = (
+    "Content-Type", "Content-Encoding", "Retry-After",
+    "X-SimuMax-Cache", "X-SimuMax-Key", "X-SimuMax-Served",
+    "X-SimuMax-Cells", "X-SimuMax-Trace",
+)
+
+#: request headers relayed to the owner: body framing, priority (the
+#: owner's admission classes the request exactly as the client sent
+#: it), trace id (one span tree across the hop), and the client's
+#: transport-encoding opt-in
+FORWARD_REQ_HEADERS = (
+    "Content-Type", "Accept-Encoding",
+    "X-SimuMax-Priority", "X-SimuMax-Trace",
+)
+
+#: loop guard: a request that already took one router hop is served
+#: where it lands — two nodes with momentarily different ring views
+#: must never bounce a request between each other
+FORWARDED_HEADER = "X-SimuMax-Forwarded"
+
+
+def route_key(endpoint: str, q: dict) -> str:
+    """Deterministic route key of one request: the sha256 of the
+    canonical JSON of the endpoint + its shard-identity fields — the
+    same hash family (and for estimate/explain, the same identity
+    fields) that prefixes the request's content-addressed store key.
+    Every process (bench client, router, node) computes the same key
+    for the same request."""
+    if endpoint == "/v1/search":
+        ident = {k: v for k, v in q.items()
+                 if k not in SEARCH_VOLATILE_FIELDS}
+    else:
+        ident = q
+    return content_key({"endpoint": endpoint, "q": ident})
+
+
+class Forwarded:
+    """One relayed upstream response: status + header subset + the
+    live ``http.client`` response (the caller streams ``response`` and
+    then returns the connection via :meth:`Router.finish`)."""
+
+    __slots__ = ("status", "headers", "response", "conn", "node",
+                 "chunked")
+
+    def __init__(self, status, headers, response, conn, node, chunked):
+        self.status = status
+        self.headers = headers
+        self.response = response
+        self.conn = conn
+        self.node = node
+        self.chunked = chunked
+
+
+class Router:
+    """Forwarding tier of one fleet node (every node embeds one).
+
+    Holds the ring, this node's identity, and a per-peer pool of
+    keep-alive connections. Thread-safe: the ThreadingHTTPServer
+    forwards from many handler threads at once.
+    """
+
+    def __init__(self, ring: HashRing, node_id: str,
+                 members: Dict[str, Tuple[str, int]],
+                 registry=None):
+        self.ring = ring
+        self.node_id = node_id
+        self.members = dict(members)
+        self.registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._conns: Dict[str, List[http.client.HTTPConnection]] = {}
+        self.counters = {"forwards": 0, "local": 0, "retries": 0,
+                         "failed": 0}
+        self.registry.gauge("ring_nodes").set(len(ring))
+
+    # -- placement ---------------------------------------------------------
+    def owner_for(self, endpoint: str, q: dict) -> str:
+        return self.ring.owner(route_key(endpoint, q))
+
+    def is_local(self, endpoint: str, q: dict) -> bool:
+        """True when this node owns the request (or is the only node).
+        Counted: the local/forward split is the fleet's routing
+        efficiency signal (``router_local_hits_total``)."""
+        local = self.owner_for(endpoint, q) == self.node_id
+        if local:
+            with self._lock:
+                self.counters["local"] += 1
+            self.registry.counter("router_local_hits_total").inc()
+        return local
+
+    def candidates(self, endpoint: str, q: dict) -> List[str]:
+        """Forwarding order: the owner, then its distinct successors —
+        this node excluded (it is the caller; ending up here again
+        means serving locally, not another hop)."""
+        order = self.ring.successors(route_key(endpoint, q))
+        return [n for n in order if n != self.node_id]
+
+    # -- connection pool ---------------------------------------------------
+    def _checkout(self, node: str) -> http.client.HTTPConnection:
+        with self._lock:
+            pool = self._conns.get(node)
+            if pool:
+                return pool.pop()
+        host, port = self.members[node]
+        return http.client.HTTPConnection(
+            host, port, timeout=FORWARD_TIMEOUT_S)
+
+    def finish(self, fwd: Forwarded, reuse: bool):
+        """Return a relayed connection to the pool (fully-read
+        response, keep-alive) or close it."""
+        if not reuse or fwd.response.will_close:
+            fwd.conn.close()
+            return
+        with self._lock:
+            self._conns.setdefault(fwd.node, []).append(fwd.conn)
+
+    def close(self):
+        with self._lock:
+            conns = [c for pool in self._conns.values() for c in pool]
+            self._conns.clear()
+        for c in conns:
+            c.close()
+
+    # -- forwarding --------------------------------------------------------
+    def forward(self, endpoint: str, raw_body: bytes,
+                req_headers, q: Optional[dict] = None
+                ) -> Optional[Forwarded]:
+        """Relay one request to the first reachable candidate node.
+
+        Returns the open :class:`Forwarded` (the caller relays
+        ``response`` and calls :meth:`finish`), or None when every
+        candidate is unreachable — the caller serves locally (any node
+        can evaluate; the shard only places the cache)."""
+        headers = {FORWARDED_HEADER: self.node_id}
+        for name in FORWARD_REQ_HEADERS:
+            value = req_headers.get(name)
+            if value:
+                headers[name] = value
+        headers["Content-Length"] = str(len(raw_body))
+        tracer = get_tracer()
+        if "X-SimuMax-Trace" not in headers:
+            # the client sent no trace id: propagate THIS hop's active
+            # request trace so the owner's spans (and its pool
+            # worker's) join one fleet-wide span tree
+            tid = tracer.current_trace_id()
+            if tid:
+                headers["X-SimuMax-Trace"] = tid
+        body = q if q is not None else json_loads_safe(raw_body)
+        for attempt, node in enumerate(
+                self.candidates(endpoint, body)):
+            conn = self._checkout(node)
+            try:
+                with tracer.span("router_forward", node=node,
+                                 endpoint=endpoint, attempt=attempt):
+                    conn.request("POST", endpoint, body=raw_body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+            except (OSError, http.client.HTTPException):
+                # connection-level failure before any response byte:
+                # safe to retry on the successor
+                conn.close()
+                with self._lock:
+                    self.counters["retries"] += 1
+                continue
+            with self._lock:
+                self.counters["forwards"] += 1
+            self.registry.counter("router_forwards_total",
+                                  node=node).inc()
+            relay = {}
+            for name in RELAY_HEADERS:
+                value = resp.headers.get(name)
+                if value is not None:
+                    relay[name] = value
+            chunked = "chunked" in \
+                (resp.headers.get("Transfer-Encoding") or "").lower()
+            return Forwarded(resp.status, relay, resp, conn, node,
+                             chunked)
+        with self._lock:
+            self.counters["failed"] += 1
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+        out["node_id"] = self.node_id
+        out["ring"] = {"nodes": list(self.ring.nodes()),
+                       "vnodes": self.ring.vnodes}
+        return out
+
+
+def json_loads_safe(raw: bytes) -> dict:
+    """Parse a request body for routing; malformed bodies route as
+    empty identity (the owner answers the 400 — same node every
+    time, so even errors stay sticky)."""
+    import json
+
+    try:
+        q = json.loads(raw.decode("utf-8") or "{}")
+    except (ValueError, UnicodeDecodeError):
+        return {}
+    return q if isinstance(q, dict) else {}

@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import json
 import math
+import signal
 import socket
 import threading
 import time
@@ -295,6 +296,12 @@ class PlannerHTTPServer(ThreadingHTTPServer):
         #: append to ``<DIR>/requests.jsonl`` (one JSON line each)
         self.trace_log = trace_log
         self._trace_log_lock = threading.Lock()
+        #: fleet attachments (``serve --ring/--join``, service/node.py
+        #: ``attach_fleet``): the node state serving ``/ring/*`` and
+        #: the affinity router forwarding non-owned ``/v1/*`` requests
+        #: to their ring owner. None = a standalone (pre-L19) server.
+        self.fleet = None
+        self.router = None
 
     def server_close(self):
         super().server_close()
@@ -302,6 +309,8 @@ class PlannerHTTPServer(ThreadingHTTPServer):
             self.warmer.close()
         if self.pool is not None:
             self.pool.close()
+        if self.fleet is not None:
+            self.fleet.close()
 
     def write_trace(self, trace_id: str, endpoint: str):
         """Append the finished request's span tree to the trace log
@@ -356,6 +365,19 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(data, dict):
             raise ConfigError("request body must be a JSON object")
         return data
+
+    def _incoming_trace(self):
+        """The client- (or router-) supplied ``X-SimuMax-Trace`` id,
+        when plausible — honoring it joins this hop's spans to the
+        caller's trace, so one routed request's span tree covers the
+        whole fleet (router hop, owner node, pool worker). Bounded and
+        charset-checked: the id becomes a trace-log key and a response
+        header, never trusted further than that."""
+        tid = self.headers.get("X-SimuMax-Trace")
+        if tid and len(tid) <= 64 \
+                and all(c in "0123456789abcdef" for c in tid):
+            return tid
+        return None
 
     def _send_trace_header(self):
         """Stamp the active request trace id (every response path —
@@ -427,6 +449,10 @@ class _Handler(BaseHTTPRequestHandler):
         "/healthz", "/stats", "/metrics",
         "/v1/estimate", "/v1/explain", "/v1/faults",
         "/v1/simulate", "/v1/search",
+        # the fleet control plane (service/node.py; fleet nodes only)
+        "/ring/state", "/ring/cells/claim", "/ring/cells/publish",
+        "/ring/cells/abandon", "/ring/cells/wait", "/ring/entries",
+        "/ring/entry", "/ring/replicate",
     })
 
     def _metric_endpoint(self, endpoint: str) -> str:
@@ -438,7 +464,8 @@ class _Handler(BaseHTTPRequestHandler):
         endpoint = self.path.split("?")[0]
         err = False
         tracer = get_tracer()
-        with tracer.trace(f"GET {endpoint}", endpoint=endpoint) as tid:
+        with tracer.trace(f"GET {endpoint}", endpoint=endpoint,
+                          trace_id=self._incoming_trace()) as tid:
             try:
                 if self.path == "/healthz":
                     self._send_json(200, {
@@ -450,6 +477,9 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(200, self._stats_snapshot())
                 elif self.path == "/metrics":
                     self._send_metrics()
+                elif self.path == "/ring/state" \
+                        and self.server.fleet is not None:
+                    self._send_json(200, self.server.fleet.state())
                 else:
                     err = True
                     self._send_error_json(
@@ -695,6 +725,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         t0 = time.perf_counter()
         endpoint = self.path.split("?")[0]
+        if endpoint.startswith("/ring/"):
+            # fleet control plane (service/node.py): no admission (a
+            # shed claim RPC would deadlock the sweep it serves into
+            # re-evaluating), no routing (ring RPCs are already
+            # addressed to the right node by the caller)
+            self._ring_rpc(endpoint, t0)
+            return
         err = False
         tracer = get_tracer()
         adm = self.server.admission
@@ -759,7 +796,9 @@ class _Handler(BaseHTTPRequestHandler):
                 payload, meta = got
                 try:
                     with tracer.trace(f"POST {endpoint}",
-                                      endpoint=endpoint) as tid:
+                                      endpoint=endpoint,
+                                      trace_id=self._incoming_trace(),
+                                      ) as tid:
                         self._send_json(200, payload, meta)
                 except BrokenPipeError:
                     err = True
@@ -772,7 +811,8 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 self.server.write_trace(tid, endpoint)
                 return
-        with tracer.trace(f"POST {endpoint}", endpoint=endpoint) as tid:
+        with tracer.trace(f"POST {endpoint}", endpoint=endpoint,
+                          trace_id=self._incoming_trace()) as tid:
             try:
                 q = None
                 try:
@@ -781,6 +821,26 @@ class _Handler(BaseHTTPRequestHandler):
                     err = True
                     self._send_error_json(
                         400, f"bad request body: {exc}")
+                router = self.server.router
+                if q is not None and router is not None \
+                        and endpoint.startswith("/v1/") \
+                        and not self.headers.get(
+                            "X-SimuMax-Forwarded") \
+                        and not router.is_local(endpoint, q):
+                    # fleet affinity routing: this node doesn't own the
+                    # request's store key — relay it to the owner and
+                    # stream the owner's bytes back untouched (routed
+                    # responses stay bit-identical to direct serving).
+                    # The loop guard means a forwarded request is always
+                    # served where it lands, even mid-ring-change.
+                    try:
+                        relayed = self._relay_remote(endpoint, q)
+                        if relayed is not None:  # handled remotely
+                            err = err or relayed >= 400
+                            q = None
+                    except BrokenPipeError:
+                        err = True
+                        q = None
                 if q is not None:
                     try:
                         self._dispatch(endpoint, q)
@@ -811,6 +871,98 @@ class _Handler(BaseHTTPRequestHandler):
                     time.perf_counter() - t0, err,
                 )
         self.server.write_trace(tid, endpoint)
+
+    def _ring_rpc(self, endpoint: str, t0: float):
+        """Serve one fleet control-plane RPC (cell claim/publish/wait,
+        entry transfer, replication round) via
+        ``service/node.py:FleetNode.handle_ring``."""
+        err = False
+        self._raw_body = None
+        try:
+            fleet = self.server.fleet
+            if fleet is None:
+                err = True
+                self._send_error_json(404, "not a fleet node")
+                return
+            try:
+                q = self._body()
+            except (ValueError, json.JSONDecodeError) as exc:
+                err = True
+                self._send_error_json(
+                    400, f"bad request body: {exc}")
+                return
+            status, payload = fleet.handle_ring(endpoint, q)
+            err = status >= 400
+            if isinstance(payload, bytes):
+                # raw store-entry bytes (/ring/entry): the replica
+                # wire format IS the disk format — no re-encode
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self._send_json(status, payload)
+        except BrokenPipeError:
+            err = True
+        except Exception as exc:
+            err = True
+            code = 400 if self._is_config_error(exc) else 500
+            try:
+                self._send_error_json(
+                    code, f"{type(exc).__name__}: {exc}")
+            except BrokenPipeError:
+                pass
+        finally:
+            self.server.stats.record(
+                self._metric_endpoint(endpoint),
+                time.perf_counter() - t0, err,
+            )
+
+    def _relay_remote(self, endpoint: str, q: dict) -> Optional[int]:
+        """Relay this request to its ring owner and copy the owner's
+        response back byte-for-byte (identity bodies, relayed serving
+        headers). Returns the upstream status, or None when no peer
+        answered — the caller serves locally (any node can evaluate;
+        the ring only places the cache)."""
+        router = self.server.router
+        raw = getattr(self, "_raw_body", None) or b"{}"
+        fwd = router.forward(endpoint, raw, self.headers, q=q)
+        if fwd is None:
+            return None
+        try:
+            self.send_response(fwd.status)
+            for name, value in fwd.headers.items():
+                self.send_header(name, value)
+            if fwd.chunked:
+                # re-chunk the owner's NDJSON stream as it arrives:
+                # http.client strips the upstream framing, so each
+                # read is re-framed (cell lines keep flowing live)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                while True:
+                    piece = fwd.response.read(65536)
+                    if not piece:
+                        break
+                    self.wfile.write(
+                        f"{len(piece):x}\r\n".encode("ascii")
+                        + piece + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            else:
+                body = fwd.response.read()
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        except BrokenPipeError:
+            # client went away mid-relay: the upstream response is
+            # part-read, the connection can't be pooled
+            router.finish(fwd, reuse=False)
+            raise
+        router.finish(fwd, reuse=True)
+        return fwd.status
 
     @staticmethod
     def _is_config_error(exc: Exception) -> bool:
@@ -981,10 +1133,23 @@ def make_server(planner: Optional[Planner] = None,
 
 
 def serve_forever(server: PlannerHTTPServer):
-    """Run until interrupted, closing the socket on the way out."""
+    """Run until interrupted, closing the socket (and reaping the
+    pool's daemon workers via ``server_close``) on the way out.
+
+    SIGTERM gets the same graceful path as Ctrl-C: a terminated
+    parent that skips ``pool.close()`` orphans its daemon workers,
+    which then hold the parent's inherited stdout/stderr pipes open
+    forever — fleet reaping (``serve --nodes``) relies on this."""
+    def _term(signum, frame):
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass  # not the main thread (embedded use): keep default
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         pass
     finally:
         server.server_close()

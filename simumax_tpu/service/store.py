@@ -348,6 +348,91 @@ class ContentStore:
         out.sort(key=lambda e: (e["namespace"], -e["last_used"]))
         return out
 
+    # -- replication (service/node.py replica pull) ------------------------
+    def manifest(self, namespace: Optional[str] = None) -> List[dict]:
+        """Replication inventory: one row per entry with its
+        ``(path, mtime, size)`` stamp — the freshness key a fleet
+        replica pulls against (``docs/service.md`` "Planner fleet").
+        The path is root-relative (peers have different roots); the
+        stamp changes whenever the file is replaced, so a replica that
+        recorded a stamp re-pulls exactly when the owner rewrote the
+        entry. Sorted by (namespace, key): deterministic across
+        processes (SIM003)."""
+        out = []
+        for path in self._walk(namespace):
+            try:
+                header = self._read_header(path)
+                st = os.stat(path)
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+            out.append({
+                "namespace": header.get("ns", ""),
+                "key": header.get("key", ""),
+                "fmt": header.get("fmt", ""),
+                "sha256": header.get("sha256", ""),
+                "stamp": [os.path.relpath(path, self.root),
+                          st.st_mtime, st.st_size],
+            })
+        out.sort(key=lambda e: (e["namespace"], e["key"]))
+        return out
+
+    def entry_sha(self, namespace: str, key: str) -> Optional[str]:
+        """The payload digest of one held entry (header-only read), or
+        None — the replica puller's already-have check."""
+        try:
+            header = self._read_header(self._path(namespace, key))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return None
+        return header.get("sha256")
+
+    def export_entry(self, namespace: str, key: str
+                     ) -> Optional[bytes]:
+        """The raw entry file bytes (header line + payload) for
+        replication — the receiving replica re-verifies the digest, so
+        the wire format IS the disk format and a replicated entry is
+        byte-identical to the original."""
+        try:
+            with open(self._path(namespace, key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def import_entry(self, namespace: str, key: str,
+                     raw: bytes) -> bool:
+        """Atomically install one replicated raw entry after verifying
+        its header/digest and that it actually is (namespace, key) —
+        a replica never trusts the wire. Returns False (and installs
+        nothing) on any mismatch."""
+        nl = raw.find(b"\n")
+        if nl < 0:
+            return False
+        try:
+            header = json.loads(raw[:nl].decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return False
+        body = raw[nl + 1:]
+        if (header.get("ns") != namespace or header.get("key") != key
+                or header.get("sha256")
+                != hashlib.sha256(body).hexdigest()):
+            return False
+        path = self._path(namespace, key)
+        parent = os.path.dirname(path)
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self._count("puts")
+        self._evict_if_needed(len(raw))
+        return True
+
     def stats(self) -> dict:
         """Per-namespace entry/byte totals plus the live counters."""
         namespaces: Dict[str, Dict[str, int]] = {}

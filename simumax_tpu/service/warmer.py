@@ -240,7 +240,11 @@ class Warmer:
         self.counters = {"offered": 0, "warmed_jobs": 0,
                          "warmed_cells": 0, "duplicate": 0,
                          "dropped": 0, "skipped_headroom": 0,
-                         "errors": 0}
+                         "skipped_remote": 0, "errors": 0}
+        #: fleet gate (service/node.py): when set, only sweeps this
+        #: node OWNS are warmed — warming a remote shard would guess
+        #: into a store the owner never reads
+        self.route_filter: Optional[Callable[[dict], bool]] = None
         #: True while the loop is executing a dequeued job — drain()
         #: must wait this out, not just an empty queue
         self._busy = False
@@ -276,6 +280,14 @@ class Warmer:
     def offer(self, search_body: dict):
         """Queue the neighbor-warming job of one served sweep query.
         Never blocks and never raises into the serving path."""
+        if self.route_filter is not None:
+            try:
+                owned = bool(self.route_filter(search_body))
+            except Exception:
+                owned = True  # never let routing break serving
+            if not owned:
+                self._count("skipped_remote", outcome="skipped_remote")
+                return
         try:
             spec = neighbor_spec(search_body)
         except Exception:

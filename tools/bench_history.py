@@ -81,7 +81,7 @@ VARIANT_KEYS = ("engine", "grid", "mode", "granularity", "world",
                 "mbc", "queries", "overlap", "threads", "trace",
                 "critical_path", "workers", "admission",
                 "client_procs", "pipeline", "n_jobs", "templates",
-                "replay_backend")
+                "replay_backend", "nodes")
 
 
 def variant_of(result: Dict[str, Any]) -> str:

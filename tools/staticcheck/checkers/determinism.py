@@ -42,6 +42,9 @@ SCOPE = (
     "simumax_tpu/search/",
     "simumax_tpu/service/store.py",
     "simumax_tpu/service/planner.py",
+    "simumax_tpu/service/ring.py",
+    "simumax_tpu/service/router.py",
+    "simumax_tpu/service/node.py",
     "simumax_tpu/core/",
     "simumax_tpu/perf.py",
     "simumax_tpu/parallel/",
