@@ -75,6 +75,25 @@ same-machine single-node siege recorded with matching traffic flags
 meaningful only with >= nodes+1 cores — the recorded baseline
 annotates ``cores``). ``--dump-forensics`` writes per-node
 ``/stats`` + ``/metrics`` + ``/ring/state``.
+
+Chaos siege (L20)::
+
+    python bench_service.py --siege --nodes 3 --queries 2000 \
+        --chaos service_chaos_killrejoin --dump-forensics out/
+
+replays a declarative fault scenario (``configs/faults/*.json``,
+schema ``simumax-service-chaos-v1``) against the live fleet: seeded
+SIGSTOP/SIGKILL of node processes, store-shard corruption, and
+drop/delay injection at the router socket layer, while the Zipf burst
+keeps cycling with client-side failover. The gates are the
+self-healing invariants, not throughput: no admitted request lost or
+answered wrong (parity sampled *during* the outage), membership
+convergence within the failure detector's probe bound after both the
+kill and the scripted rejoin, quarantine of every corrupted entry by
+the respawned node's recovery sweep, re-replication restoring its
+owner coverage, and (with ``--admission``) an overload p99 within 2x
+the chaos-free ``--max-overload-p99-ms`` bound even with the net
+faults still armed. See ``docs/service.md`` "Failure semantics".
 """
 
 import argparse
@@ -517,10 +536,13 @@ def start_server(args):
 
 
 def _fleet_node_proc(idx: int, ports, cache_root: str, workers: int,
-                     admission_n: int):
+                     admission_n: int, probe_s: float = 0.0,
+                     probe_seed: int = 0):
     """One forked fleet node: planner (+ optional worker pool wired
     into the fleet flight table), admission, ring surface — exactly
-    the ``serve --ring ... --join n<idx>`` topology."""
+    the ``serve --ring ... --join n<idx>`` topology. ``probe_s``
+    arms the failure detector (the chaos bench runs with it on, the
+    plain fleet siege without)."""
     from simumax_tpu.service.node import attach_fleet
     from simumax_tpu.service.planner import Planner
     from simumax_tpu.service.ring import format_ring_spec
@@ -546,7 +568,8 @@ def _fleet_node_proc(idx: int, ports, cache_root: str, workers: int,
         if admission_n else None
     srv = make_server(planner, "127.0.0.1", ports[idx], pool=pool,
                       admission=admission)
-    attach_fleet(srv, node_id, spec)
+    attach_fleet(srv, node_id, spec, probe_s=probe_s,
+                 probe_seed=probe_seed)
 
     def _term(signum, frame):
         # cleanup() SIGTERMs this node: reap the daemon pool workers
@@ -561,9 +584,69 @@ def _fleet_node_proc(idx: int, ports, cache_root: str, workers: int,
     srv.serve_forever()
 
 
-def start_fleet(args):
+def _wait_healthy(port: int, deadline_s: float, on_fail=None):
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            if get_json(port, "/healthz").get("status") == "ok":
+                return
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+        if time.monotonic() > deadline:
+            if on_fail is not None:
+                on_fail()
+            raise SystemExit(
+                f"fleet node on port {port} never became healthy")
+        time.sleep(0.1)
+
+
+class FleetHandle:
+    """The forked fleet plus the process-level hooks the chaos
+    injector drives: pid lookup (changes across a kill+start cycle),
+    respawn on the *same* port and store shard (the rejoin path), and
+    per-node shard roots (the corruption target)."""
+
+    def __init__(self, ports, procs, spawn, cache_root, tmp):
+        self.ports = ports
+        self.procs = procs
+        self._spawn = spawn
+        self.cache_root = cache_root
+        self._tmp = tmp
+
+    def pid_of(self, idx: int):
+        p = self.procs[idx]
+        return p.pid if p.is_alive() else None
+
+    def store_root(self, idx: int) -> str:
+        return os.path.join(self.cache_root, f"n{idx}")
+
+    def respawn(self, idx: int):
+        """Restart a killed node on its original port and shard — the
+        rejoin the surviving detectors must observe. The respawned
+        process re-runs the store's crash-recovery sweep on whatever
+        the SIGKILL (and any corruption event) left on disk."""
+        old = self.procs[idx]
+        if old.is_alive():
+            return
+        old.join(5)
+        p = self._spawn(idx)
+        p.start()
+        self.procs[idx] = p
+        _wait_healthy(self.ports[idx], 60.0)
+
+    def cleanup(self):
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            p.join(5)
+        if self._tmp:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+def start_fleet(args, probe_s: float = 0.0, probe_seed: int = 0):
     """Fork ``--nodes`` fleet node processes on free localhost ports;
-    returns ``(ports, cleanup)`` once every /healthz answers."""
+    returns a :class:`FleetHandle` once every /healthz answers."""
     import multiprocessing
     import socket as _socket
 
@@ -581,41 +664,27 @@ def start_fleet(args):
     if not cache_root:
         tmp = tempfile.mkdtemp(prefix="simumax-bench-fleet-")
         cache_root = tmp
-    # NOT daemonic: a pooled node must fork its own worker processes
-    # (daemons may not have children); cleanup() reaps them instead
-    procs = [
-        ctx.Process(target=_fleet_node_proc,
-                    args=(i, ports, cache_root, args.workers,
-                          args.admission),
-                    daemon=False, name=f"bench-node-n{i}")
-        for i in range(args.nodes)
-    ]
+
+    def spawn(i):
+        # NOT daemonic: a pooled node must fork its own worker
+        # processes (daemons may not have children); cleanup() — or a
+        # chaos SIGKILL plus respawn — reaps them instead
+        return ctx.Process(target=_fleet_node_proc,
+                           args=(i, ports, cache_root, args.workers,
+                                 args.admission, probe_s, probe_seed),
+                           daemon=False, name=f"bench-node-n{i}")
+
+    procs = [spawn(i) for i in range(args.nodes)]
     for p in procs:
         p.start()
-    deadline = time.monotonic() + 60.0
-    for port in ports:
-        while True:
-            try:
-                if get_json(port, "/healthz").get("status") == "ok":
-                    break
-            except (OSError, ValueError, http.client.HTTPException):
-                pass
-            if time.monotonic() > deadline:
-                for p in procs:
-                    p.terminate()
-                raise SystemExit(
-                    f"fleet node on port {port} never became healthy")
-            time.sleep(0.1)
 
-    def cleanup():
+    def on_fail():
         for p in procs:
             p.terminate()
-        for p in procs:
-            p.join(5)
-        if tmp:
-            shutil.rmtree(tmp, ignore_errors=True)
 
-    return ports, cleanup
+    for port in ports:
+        _wait_healthy(port, 60.0, on_fail=on_fail)
+    return FleetHandle(ports, procs, spawn, cache_root, tmp)
 
 
 def partition_by_owner(burst, n_nodes: int):
@@ -712,9 +781,21 @@ def dump_forensics(port: int, out_dir: str):
                 spans, os.path.join(out_dir, "trace.json"))
 
 
-def get_json(port: int, path: str) -> dict:
-    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+def get_json(port: int, path: str, timeout: float = 60) -> dict:
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
     conn.request("GET", path)
+    data = json.loads(conn.getresponse().read())
+    conn.close()
+    return data
+
+
+def post_json(port: int, path: str, body: dict,
+              timeout: float = 60) -> dict:
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
     data = json.loads(conn.getresponse().read())
     conn.close()
     return data
@@ -935,24 +1016,35 @@ def run_siege(args) -> int:
 
 
 def dump_fleet_forensics(ports, out_dir: str):
-    """Per-node /stats + /metrics + /ring/state under ``out_dir/n<i>``
-    — a failed fleet gate ships every node's serving- and ring-side
-    evidence."""
+    """Per-node /stats + /metrics + /ring/state (which carries the
+    recovery report and the quarantine listing) under ``out_dir/n<i>``
+    — a failed fleet or chaos gate ships every node's serving- and
+    ring-side evidence. A node the chaos scenario left dead gets an
+    ``unreachable.txt`` marker instead of a crash."""
     for i, port in enumerate(ports):
         sub = os.path.join(out_dir, f"n{i}")
         os.makedirs(sub, exist_ok=True)
+        try:
+            stats = get_json(port, "/stats")
+            ring_state = get_json(port, "/ring/state")
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.request("GET", "/metrics")
+            body = conn.getresponse().read()
+            conn.close()
+        except (OSError, ValueError,
+                http.client.HTTPException) as exc:
+            with open(os.path.join(sub, "unreachable.txt"), "w") as f:
+                f.write(f"n{i} on port {port}: {exc}\n")
+            continue
         with open(os.path.join(sub, "stats.json"), "w") as f:
-            json.dump(get_json(port, "/stats"), f, indent=2,
-                      default=str)
-        conn = http.client.HTTPConnection("127.0.0.1", port,
-                                          timeout=60)
-        conn.request("GET", "/metrics")
-        body = conn.getresponse().read()
-        conn.close()
+            json.dump(stats, f, indent=2, default=str)
         with open(os.path.join(sub, "metrics.txt"), "wb") as f:
             f.write(body)
         with open(os.path.join(sub, "ring_state.json"), "w") as f:
-            json.dump(get_json(port, "/ring/state"), f, indent=2,
+            json.dump(ring_state, f, indent=2, default=str)
+        with open(os.path.join(sub, "quarantine.json"), "w") as f:
+            json.dump(ring_state.get("quarantine", []), f, indent=2,
                       default=str)
 
 
@@ -963,7 +1055,8 @@ def run_fleet_siege(args) -> int:
     an overload phase hammering n0 alone (admission composes across
     router and pool), and a fleet-speedup gate vs a same-machine
     single-node baseline. One JSON line, exit 1 on any gate."""
-    ports, cleanup = start_fleet(args)
+    fleet = start_fleet(args)
+    ports, cleanup = fleet.ports, fleet.cleanup
     overload = None
     try:
         _burst, unique = build_burst(args.siege_pool, 0.0, args.seed)
@@ -1107,6 +1200,390 @@ def run_fleet_siege(args) -> int:
     return 0 if ok else 1
 
 
+def replay_chaos(ports, burst, threads: int, stop,
+                 deadline_ms: int = 8000):
+    """Chaos-phase replay: ``threads`` client threads cycle the
+    owner-routed burst until ``stop`` is set, so traffic is in flight
+    across every scheduled injection. Every request carries an
+    ``X-SimuMax-Deadline`` budget (a wedged SIGSTOPped peer costs one
+    bounded hop, not a 120 s stall) and **fails over in ring order**:
+    owner first, then successors — exactly the retry a production
+    client performs against a sick fleet. A request is *admitted* the
+    moment any node answers it; the "no admitted request lost" oracle
+    then counts any non-2xx/429 answer as ``error`` and
+    every-node-unreachable as ``lost`` (with one node down out of
+    three, both must stay zero)."""
+    from simumax_tpu.service.ring import HashRing
+    from simumax_tpu.service.router import DEADLINE_HEADER, route_key
+
+    ring = HashRing([f"n{i}" for i in range(len(ports))])
+    n = len(ports)
+    items = []
+    for ep, body in burst:
+        body = resolve_strategy_body(body)
+        owner = int(ring.owner(route_key(ep, body))[1:])
+        order = [(owner + k) % n for k in range(n)]
+        items.append((ep, json.dumps(body), order))
+    lock = threading.Lock()
+    counts = {"ok": 0, "shed": 0, "error": 0, "lost": 0,
+              "failovers": 0, "requests": 0}
+    lat = []
+    conn_timeout = deadline_ms / 1000.0 + 4.0
+    headers = {"Content-Type": "application/json",
+               DEADLINE_HEADER: str(deadline_ms)}
+
+    def worker(tid):
+        mine = items[tid::threads]
+        while mine and not stop.is_set():
+            for ep, raw, order in mine:
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                status = None
+                for pidx in order:
+                    try:
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", ports[pidx],
+                            timeout=conn_timeout)
+                        conn.request("POST", ep, raw, headers)
+                        resp = conn.getresponse()
+                        resp.read()
+                        status = resp.status
+                        conn.close()
+                        break
+                    except (OSError, http.client.HTTPException):
+                        with lock:
+                            counts["failovers"] += 1
+                with lock:
+                    counts["requests"] += 1
+                    if status is None:
+                        counts["lost"] += 1
+                    elif status == 200:
+                        counts["ok"] += 1
+                        lat.append(time.perf_counter() - t0)
+                    elif status == 429:
+                        counts["shed"] += 1
+                    else:
+                        counts["error"] += 1
+
+    ts = [threading.Thread(target=worker, args=(t,), daemon=True)
+          for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+
+    def finish():
+        stop.set()
+        for t in ts:
+            t.join(2 * conn_timeout)
+        with lock:
+            return (time.perf_counter() - t0, sorted(lat),
+                    dict(counts))
+
+    return finish
+
+
+def _await_fired(injector, n_events: int, deadline_s: float) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while len(injector.report()) < n_events:
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.05)
+    return True
+
+
+def _await_membership(ports, live, expect, deadline_s: float):
+    """Poll the live nodes' /ring/state until every one reports
+    exactly ``expect`` as its ring membership; returns (elapsed_s,
+    per-node detector round counters at convergence) or (None, {})."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        views = {}
+        rounds = {}
+        for i in live:
+            try:
+                rs = get_json(ports[i], "/ring/state", timeout=5)
+            except (OSError, ValueError, http.client.HTTPException):
+                break
+            views[i] = sorted(rs.get("ring", {}).get("nodes", ()))
+            rounds[i] = rs.get("detector", {}).get("rounds", 0)
+        if len(views) == len(live) \
+                and all(v == sorted(expect) for v in views.values()):
+            return time.monotonic() - t0, rounds
+        time.sleep(0.05)
+    return None, {}
+
+
+def run_chaos_siege(args) -> int:
+    """``--siege --nodes N --chaos SCENARIO``: the fleet siege under
+    scheduled faults, gated on the self-healing invariants instead of
+    throughput. Flow: fill the fleet cold, seed replicas with explicit
+    ``/ring/replicate`` rounds, then start the injector clock and keep
+    the Zipf burst cycling (with client failover) across every event.
+    The main thread follows the scenario timeline and checks the
+    oracles: after a ``kill``, the survivors must converge on the
+    shrunk membership within the probe bound (wall clock AND detector
+    rounds); a parity sample taken **during the outage** must still be
+    bit-identical across the forwarding hop; after the scripted
+    ``start``, the full membership must converge back, the respawned
+    node's recovery sweep must have quarantined the scenario's
+    corrupted entries, and replicate rounds must restore its owner
+    coverage (every corrupted key present in its manifest again). An
+    optional overload phase then runs with the chaos-era net faults
+    still armed, gated at 2x the chaos-free p99 bound. One JSON line,
+    exit 1 on any gate."""
+    from simumax_tpu.service.chaos import (
+        NET_ENV,
+        ChaosInjector,
+        load_scenario,
+    )
+    from simumax_tpu.service.node import DOWN_AFTER
+
+    scenario = load_scenario(args.chaos)
+    net = scenario.net_env()
+    if net:
+        # inherited by the forked fleet nodes: each router's _send
+        # gets the seeded drop/delay schedule installed
+        os.environ[NET_ENV] = net
+    fleet = start_fleet(args, probe_s=scenario.probe_s,
+                        probe_seed=scenario.seed)
+    ports = fleet.ports
+    all_nodes = [f"n{i}" for i in range(len(ports))]
+    injector = ChaosInjector(scenario, fleet.pid_of, fleet.respawn,
+                             fleet.store_root)
+    ok = True
+    result = {
+        "metric": "service_chaos_siege",
+        "unit": "q/s",
+        "mode": f"chaos-{os.path.splitext(scenario.name)[0]}"
+                f"-pool{args.siege_pool}-z{args.zipf}",
+        "nodes": args.nodes,
+        "workers": args.workers,
+        "admission": args.admission,
+        "probe_s": scenario.probe_s,
+        "seed": scenario.seed,
+        "cores": os.cpu_count(),
+    }
+    try:
+        # -- fill cold, then seed replicas so every entry survives
+        # losing its owner (two rounds: owner -> first successor ->
+        # second successor needs the transitive hop)
+        _burst, unique = build_burst(args.siege_pool, 0.0, args.seed)
+        fill_s, _fl, fill_counts, _fs = replay_fleet(
+            ports, unique, args.threads, depth=args.pipeline)
+        for _ in range(2):
+            for port in ports:
+                post_json(port, "/ring/replicate", {}, timeout=120)
+        result["qps_fill"] = round(
+            len(unique) / fill_s if fill_s else 0.0, 2)
+        result["fill_errors"] = fill_counts["error"]
+        ok = ok and not fill_counts["error"]
+
+        # -- chaos: burst cycles in background threads while the main
+        # thread walks the scenario timeline checking oracles
+        siege = zipf_burst(unique, args.queries, args.zipf, args.seed)
+        stop = threading.Event()
+        finish = replay_chaos(ports, siege, args.threads, stop)
+        injector.start()
+        last_at = scenario.events[-1]["at_s"] if scenario.events \
+            else 0.0
+        for n_fired, event in enumerate(scenario.events, start=1):
+            if not _await_fired(injector, n_fired,
+                                event["at_s"] + 30.0):
+                result["injector_stalled_at"] = event
+                ok = False
+                break
+            idx = event["node"]
+            if event["kind"] == "kill":
+                live = [i for i in range(len(ports)) if i != idx]
+                expect = [f"n{i}" for i in live]
+                r0 = {}
+                for i in live:
+                    try:
+                        r0[i] = get_json(
+                            ports[i], "/ring/state",
+                            timeout=5).get("detector",
+                                           {}).get("rounds", 0)
+                    except (OSError, ValueError,
+                            http.client.HTTPException):
+                        r0[i] = 0
+                dt, r1 = _await_membership(ports, live, expect,
+                                           args.max_converge_s)
+                key = f"converge_down_n{idx}_s"
+                result[key] = round(dt, 3) if dt is not None else None
+                if dt is None:
+                    result[f"converge_down_n{idx}_ok"] = ok = False
+                    continue
+                rounds = max((r1.get(i, 0) - r0.get(i, 0)
+                              for i in live), default=0)
+                result[f"converge_down_n{idx}_rounds"] = rounds
+                # bound: DOWN_AFTER consecutive misses plus the
+                # probe that was already in flight and jitter slack
+                if rounds > 2 * DOWN_AFTER + 2:
+                    result[f"converge_rounds_n{idx}_ok"] = ok = False
+                # bit-identity through forwarding **during the
+                # outage**: every sample aimed at a live node that
+                # does not own it, so the bytes cross the degraded
+                # ring's router hop
+                live_ports = [ports[i] for i in live]
+
+                def pick(ep, body, _lp=live_ports):
+                    k = route_key_for(ep, body)
+                    return _lp[sum(ord(c) for c in k) % len(_lp)]
+
+                churn_ok, churn_ep = check_parity(
+                    live_ports[0], unique, args.seed,
+                    port_for=pick)
+                result["parity_churn_ok"] = churn_ok
+                if not churn_ok:
+                    result["parity_churn_endpoint"] = churn_ep
+                    ok = False
+            elif event["kind"] == "start":
+                live = list(range(len(ports)))
+                dt, _r = _await_membership(ports, live, all_nodes,
+                                           args.max_converge_s)
+                key = f"converge_rejoin_n{idx}_s"
+                result[key] = round(dt, 3) if dt is not None else None
+                if dt is None:
+                    result[f"converge_rejoin_n{idx}_ok"] = ok = False
+        injector.join(last_at + 90.0)
+        elapsed, lat, counts = finish()
+
+        result.update({
+            "value": round(counts["requests"] / elapsed
+                           if elapsed else 0.0, 2),
+            "chaos_requests": counts["requests"],
+            "chaos_failovers": counts["failovers"],
+            "chaos_elapsed_s": round(elapsed, 3),
+            "p50_chaos_ms": round(pct(lat, 0.50) * 1e3, 2)
+            if lat else 0.0,
+            "p99_chaos_ms": round(pct(lat, 0.99) * 1e3, 2)
+            if lat else 0.0,
+            "lost_admitted": counts["error"] + counts["lost"],
+            "injections": injector.report(),
+        })
+        if counts["error"] or counts["lost"]:
+            result["lost_admitted_ok"] = ok = False
+
+        # -- epoch accounting: every live ring observed the churn
+        ring_states = {}
+        for i, port in enumerate(ports):
+            try:
+                ring_states[i] = get_json(port, "/ring/state",
+                                          timeout=10)
+            except (OSError, ValueError, http.client.HTTPException):
+                pass
+        epochs = {i: rs.get("ring", {}).get("epoch", 0)
+                  for i, rs in ring_states.items()}
+        result["epochs"] = epochs
+        survivors = [i for i in epochs
+                     if i not in scenario.killed_nodes]
+        if scenario.killed_nodes and not all(
+                epochs.get(i, 0) >= 2 for i in survivors):
+            # each kill+rejoin cycle is >= 2 bumps on a survivor
+            result["epoch_ok"] = ok = False
+
+        # -- corruption -> quarantine -> re-pull restores coverage
+        corrupted = []
+        for rec in injector.report():
+            for path in rec.get("corrupted", ()):
+                rel = os.path.relpath(
+                    path, fleet.store_root(rec["node"]))
+                parts = rel.split(os.sep)
+                corrupted.append(
+                    (rec["node"], parts[0],
+                     os.path.basename(path)[:-len(".entry")]))
+        result["corrupted_entries"] = len(corrupted)
+        if corrupted:
+            by_node = sorted({c[0] for c in corrupted})
+            quarantined = 0
+            for i in by_node:
+                rec = ring_states.get(i, {}).get("recovery", {})
+                quarantined += len(rec.get("quarantined", ()))
+            result["recovery_quarantined"] = quarantined
+            if quarantined < len(corrupted):
+                result["quarantine_ok"] = ok = False
+            deadline = time.monotonic() + args.max_converge_s
+            missing = list(corrupted)
+            while missing and time.monotonic() < deadline:
+                for port in ports:
+                    try:
+                        post_json(port, "/ring/replicate", {},
+                                  timeout=120)
+                    except (OSError, ValueError,
+                            http.client.HTTPException):
+                        pass
+                still = []
+                for i, ns, key in missing:
+                    try:
+                        rows = post_json(
+                            ports[i], "/ring/entries",
+                            {"namespace": ns},
+                            timeout=10).get("entries", ())
+                    except (OSError, ValueError,
+                            http.client.HTTPException):
+                        still.append((i, ns, key))
+                        continue
+                    if not any(r.get("key") == key for r in rows):
+                        still.append((i, ns, key))
+                missing = still
+            result["coverage_missing"] = [
+                f"n{i}:{ns}/{key}" for i, ns, key in missing]
+            if missing:
+                result["coverage_ok"] = ok = False
+
+        # -- the healed fleet must serve the whole pool again,
+        # bit-identically, with affinity routing and zero errors
+        final_s, _l, final_counts, _fs2 = replay_fleet(
+            ports, unique, args.threads, depth=args.pipeline)
+        result["final_replay_errors"] = final_counts["error"]
+        if final_counts["error"]:
+            result["final_replay_ok"] = ok = False
+        parity_ok, parity_ep = (True, None) if args.skip_parity \
+            else check_parity(ports[0], unique, args.seed,
+                              port_for=_non_owner_port(ports))
+        result["parity_ok"] = parity_ok
+        if not parity_ok:
+            result["parity_endpoint"] = parity_ep
+            ok = False
+
+        # -- overload with the net faults still armed: shedding must
+        # keep the admitted p99 within 2x the chaos-free bound
+        if args.admission and args.overload_queries:
+            oburst = overload_burst(args.overload_queries, args.seed)
+            o_s, o_lat, o_counts = replay_counted(
+                ports[0], oburst, args.overload_threads,
+                procs=args.client_procs)
+            answered = sum(o_counts.values())
+            o_p99_ms = pct(o_lat, 0.99) * 1e3 if o_lat else 0.0
+            result.update({
+                "overload_admitted": o_counts["ok"],
+                "overload_shed": o_counts["shed"],
+                "overload_errors": o_counts["error"],
+                "overload_p99_ms": round(o_p99_ms, 2),
+            })
+            if answered != len(oburst) or o_counts["error"]:
+                result["overload_answered_ok"] = ok = False
+            if o_p99_ms > 2 * args.max_overload_p99_ms:
+                result["overload_p99_ok"] = ok = False
+        if args.dump_forensics:
+            dump_fleet_forensics(ports, args.dump_forensics)
+    finally:
+        injector.close()
+        if net:
+            os.environ.pop(NET_ENV, None)
+        fleet.cleanup()
+    print(json.dumps(result))
+    record_safely(result)
+    return 0 if ok else 1
+
+
+def route_key_for(ep: str, body: dict) -> str:
+    from simumax_tpu.service.router import route_key
+
+    return route_key(ep, body)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--queries", type=int, default=1000,
@@ -1211,6 +1688,21 @@ def main(argv=None):
                          "(0 = record without gating; CI passes "
                          "0.8*N on multi-core runners — the gate "
                          "needs >= nodes+1 cores to mean anything)")
+    ap.add_argument("--chaos", metavar="SCENARIO",
+                    help="fleet-siege chaos mode: replay the named "
+                         "fault scenario (a configs/faults/ "
+                         "simumax-service-chaos-v1 JSON, or a path) "
+                         "against the live fleet and gate on the "
+                         "self-healing invariants — no admitted "
+                         "request lost, ring convergence within the "
+                         "probe bound, quarantine + re-replication "
+                         "coverage, parity under churn (needs "
+                         "--siege and --nodes >= 2)")
+    ap.add_argument("--max-converge-s", type=float, default=15.0,
+                    metavar="S",
+                    help="chaos mode: wall-clock bound for ring "
+                         "membership convergence after a kill or "
+                         "rejoin (default 15)")
     ap.add_argument("--dump-forensics", metavar="DIR",
                     help="write the final /stats + /metrics bodies "
                          "to DIR (CI uploads them on gate failure)")
@@ -1221,8 +1713,17 @@ def main(argv=None):
 
         get_tracer().configure(enabled=True)
 
+    if args.chaos and not (args.siege and args.nodes
+                           and args.nodes > 1):
+        print(json.dumps({
+            "error": "--chaos needs --siege and --nodes >= 2 (the "
+                     "scenario injects faults into a live fleet)",
+        }))
+        return 2
     if args.siege:
         if args.nodes and args.nodes > 1:
+            if args.chaos:
+                return run_chaos_siege(args)
             return run_fleet_siege(args)
         return run_siege(args)
     if args.nodes:

@@ -1257,7 +1257,9 @@ def cmd_serve(args):
         from simumax_tpu.service.node import attach_fleet
 
         attach_fleet(srv, node_id, ring_spec,
-                     replicate_s=args.replicate_s)
+                     replicate_s=args.replicate_s,
+                     probe_s=args.probe_s,
+                     probe_seed=args.probe_seed)
     host, port = srv.server_address[:2]
     cache_desc = (
         planner.store.root if planner.enabled else "disabled"
@@ -1312,6 +1314,14 @@ def cmd_cache(args):
             f"{c['corrupt_dropped']} corrupt dropped",
             event="cache_counters", **c,
         )
+        if report.get("quarantine_entries"):
+            log.info(
+                f"  quarantined: {report['quarantine_entries']} "
+                f"entries under .quarantine/ (inspect, then clear "
+                f"the directory to reclaim the bytes)",
+                event="cache_quarantine",
+                entries=report["quarantine_entries"],
+            )
     elif args.action == "ls":
         entries = store.entries(args.namespace)
         report = {"entries": entries}
@@ -1332,7 +1342,8 @@ def cmd_cache(args):
         log.info(
             f"verified {report['checked']} entries: {report['ok']} ok, "
             f"{len(report['corrupt'])} corrupt"
-            + (" (dropped)" if args.drop and report["corrupt"] else ""),
+            + (" (quarantined under .quarantine/)"
+               if args.drop and report["corrupt"] else ""),
             event="cache_verify", checked=report["checked"],
             ok=report["ok"], corrupt=len(report["corrupt"]),
         )
@@ -1891,6 +1902,20 @@ def main(argv=None):
         help="fleet mode: pull read-only replicas of peer-owned "
              "store entries every SEC seconds (default 0: replicate "
              "only on POST /ring/replicate)",
+    )
+    psv.add_argument(
+        "--probe-s", type=float, default=0, metavar="SEC",
+        help="fleet mode: heartbeat every peer over /ring/ping about "
+             "every SEC seconds (seeded jitter); consecutive misses "
+             "mark a peer suspect then down, removing it from the "
+             "live ring until it answers again (docs/service.md "
+             "'Failure semantics'). Default 0: no failure detection",
+    )
+    psv.add_argument(
+        "--probe-seed", type=int, default=0, metavar="N",
+        help="seed of the failure detector's jittered probe "
+             "schedule (same seed = same relative probe times; "
+             "default 0)",
     )
     _add_cache_args(psv)
     _add_log_args(psv)

@@ -164,7 +164,7 @@ METRICS: Dict[str, Dict[str, str]] = {
         "type": "counter",
         "help": "Speculative cache-warming jobs, by outcome (warmed/"
                 "duplicate/dropped/skipped_headroom/skipped_remote/"
-                "error).",
+                "skipped_degraded/error).",
     },
     "ring_nodes": {
         "type": "gauge",
@@ -201,6 +201,38 @@ METRICS: Dict[str, Dict[str, str]] = {
         "type": "counter",
         "help": "Requests shed with 429 by admission control, by "
                 "priority class.",
+    },
+    "ring_epoch": {
+        "type": "gauge",
+        "help": "Membership version of this node's live ring view "
+                "(bumped on every failure-detector remove/rejoin).",
+    },
+    "ring_member_state": {
+        "type": "gauge",
+        "help": "Failure-detector verdict per fleet peer "
+                "(0=up, 1=suspect, 2=down), by node.",
+    },
+    "router_hop_timeouts_total": {
+        "type": "counter",
+        "help": "Forward hops abandoned because the peer accepted "
+                "the connection but exceeded the per-hop read "
+                "deadline, by destination node.",
+    },
+    "hedged_requests_total": {
+        "type": "counter",
+        "help": "Hedged second sends for slow read-only forwards, by "
+                "outcome (won/lost/failed).",
+    },
+    "store_quarantined_total": {
+        "type": "counter",
+        "help": "Corrupt/torn store entries moved into .quarantine/ "
+                "(read-path drops, verify --drop, and the start-time "
+                "recovery sweep all route here).",
+    },
+    "chaos_injections_total": {
+        "type": "counter",
+        "help": "Fault injections fired by the chaos harness, by "
+                "kind (kill/stop/drop/delay/corrupt).",
     },
     "faults_scenarios_total": {
         "type": "counter",

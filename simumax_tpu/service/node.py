@@ -35,15 +35,36 @@ dead leader re-evaluates (lease expiry abandons the claim; abandoning
 wakes waiters with ``outcome=None``), and a dead owner's requests
 retry down the ring successors (``router.py``) — correctness never
 depends on another node being alive, only deduplication does.
+
+L20 makes the degradation *detected and reversible* instead of
+silent and permanent: a :class:`FailureDetector` heartbeats every
+peer over ``/ring/ping`` on a seeded jittered schedule, walks each
+peer through ``up -> suspect -> down`` on consecutive probe misses
+(``ring_member_state``), and edits the **live** ring — a down peer is
+removed (its arcs, an expected 1/N of the keyspace, remap to
+successors; ``ring_epoch`` bumps) and a rejoining peer is added back,
+triggering a delta re-replication round (the manifest stamps make a
+pull round after a rejoin pull only what changed). The router, this
+node's authoritative flight table, and the replicator all share the
+one live :class:`~simumax_tpu.service.ring.HashRing` object, so an
+epoch bump is observed by every placement decision immediately:
+in-flight sweeps publish to the *current* owner (fail-open re-claim),
+forwards stop trying the corpse, and ``Replicator._wants`` tracks the
+new replica sets. At start, :func:`attach_fleet` also runs the
+store's crash-recovery sweep (``store.recover()``) so a torn shard is
+quarantined before the first request, then re-pulls what quarantine
+removed from the replicas.
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from simumax_tpu.core.errors import ConfigError
 from simumax_tpu.observe.telemetry import get_registry
 from simumax_tpu.service.coalesce import CellFlightTable
 from simumax_tpu.service.ring import (
@@ -77,6 +98,18 @@ REMOTE_LEASE_S = 240.0
 #: replicas per key beyond the owner (owner + 1 successor)
 REPLICA_COUNT = 1
 
+#: failure-detector defaults: a probe round lands every
+#: ``interval * [1.0, 1.5)`` seconds (seeded jitter — rounds never
+#: synchronize fleet-wide), a peer is *suspect* after this many
+#: consecutive misses and *down* (removed from the live ring) after
+#: ``DOWN_AFTER`` — so membership converges on a dead peer within
+#: ``DOWN_AFTER`` probe rounds, the documented convergence bound the
+#: chaos oracles (and the CI smoke gate) check against
+PROBE_INTERVAL_S = 1.0
+PROBE_TIMEOUT_S = 2.0
+SUSPECT_AFTER = 2
+DOWN_AFTER = 4
+
 RING_CLAIM = "/ring/cells/claim"
 RING_PUBLISH = "/ring/cells/publish"
 RING_ABANDON = "/ring/cells/abandon"
@@ -85,6 +118,7 @@ RING_ENTRIES = "/ring/entries"
 RING_ENTRY = "/ring/entry"
 RING_REPLICATE = "/ring/replicate"
 RING_STATE = "/ring/state"
+RING_PING = "/ring/ping"
 
 
 def _rpc(members: Dict[str, Tuple[str, int]], node: str, path: str,
@@ -164,10 +198,16 @@ class FleetCellFlightTable:
                  members: Dict[str, Tuple[str, int]],
                  local: Optional[CellFlightTable] = None,
                  registry=None, authoritative: bool = True,
-                 vnodes: int = DEFAULT_VNODES):
+                 vnodes: int = DEFAULT_VNODES,
+                 ring: Optional[HashRing] = None):
         self.node_id = node_id
         self.members = dict(members)
-        self.ring = HashRing(sorted(members), vnodes=vnodes)
+        #: a shared ring (the node's live view) observes failure-
+        #: detector epoch bumps: claims and publishes follow ownership
+        #: as it moves. A private ring (pool workers) stays at the
+        #: fork-time membership — fail-open RPC errors cover the gap.
+        self.ring = ring if ring is not None \
+            else HashRing(sorted(members), vnodes=vnodes)
         self.registry = registry or get_registry()
         self.local = local if local is not None \
             else CellFlightTable(registry=self.registry)
@@ -214,7 +254,13 @@ class FleetCellFlightTable:
             led = key in self._remote_led
             self._remote_led.discard(key)
         if led:
+            # owner recomputed at publish time on the live ring: if
+            # membership changed mid-flight the outcome lands at the
+            # *current* owner (fail-open re-claim — the old owner's
+            # lease expiry wakes its own waiters)
             owner = self.ring.owner(key)
+            if self.authoritative and owner == self.node_id:
+                return  # ownership moved to us; local publish done
             if _rpc(self.members, owner, RING_PUBLISH,
                     {"key": key, "outcome": outcome},
                     RPC_TIMEOUT_S) is None:
@@ -227,6 +273,8 @@ class FleetCellFlightTable:
             self._remote_led.discard(key)
         if led:
             owner = self.ring.owner(key)
+            if self.authoritative and owner == self.node_id:
+                return
             if _rpc(self.members, owner, RING_ABANDON, {"key": key},
                     RPC_TIMEOUT_S) is None:
                 self._count("rpc_errors")
@@ -397,6 +445,178 @@ class Replicator:
             return dict(self.counters, seen=len(self._seen))
 
 
+class FailureDetector:
+    """Deterministic heartbeat prober over the ``/ring/ping`` RPC.
+
+    Each round probes every peer (sorted order — SIM003) with a small
+    timeout; consecutive misses walk a peer ``up -> suspect -> down``
+    and a down verdict **removes the peer from the live ring** (epoch
+    bump — an expected 1/N of the keyspace remaps to successors). The
+    first successful probe of a down peer adds it back (another bump)
+    and kicks one background replica-pull round, which the manifest
+    stamps turn into a delta: only entries the peer wrote or missed
+    while partitioned actually transfer.
+
+    The schedule is seeded: round gaps are
+    ``interval * (1 + rng.random()/2)`` off one ``random.Random(seed)``
+    stream, so a fleet's probe traffic never phase-locks yet every
+    run with the same seed probes at the same relative times — the
+    property the chaos harness's serial-reproducibility oracle leans
+    on. Tests drive :meth:`probe_once` synchronously instead of
+    starting the thread.
+    """
+
+    STATE_GAUGE = {"up": 0, "suspect": 1, "down": 2}
+
+    def __init__(self, node: "FleetNode",
+                 interval_s: float = PROBE_INTERVAL_S,
+                 probe_timeout_s: float = PROBE_TIMEOUT_S,
+                 suspect_after: int = SUSPECT_AFTER,
+                 down_after: int = DOWN_AFTER,
+                 seed: int = 0):
+        self.node = node
+        self.interval_s = float(interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.suspect_after = int(suspect_after)
+        self.down_after = int(down_after)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        peers = [p for p in sorted(node.members)
+                 if p != node.node_id]
+        self._fails: Dict[str, int] = {p: 0 for p in peers}
+        self._state: Dict[str, str] = {p: "up" for p in peers}
+        self.counters = {"rounds": 0, "probes": 0, "misses": 0,
+                         "removed": 0, "rejoined": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._publish_gauges()
+
+    # -- one probe round ---------------------------------------------------
+    def probe_once(self) -> dict:
+        """Probe every peer once and apply state transitions; returns
+        the round's verdict map (also the forensics view)."""
+        transitions: List[dict] = []
+        for peer in sorted(self._fails):
+            resp = _rpc(self.node.members, peer, RING_PING,
+                        {"from": self.node.node_id},
+                        self.probe_timeout_s)
+            with self._lock:
+                self.counters["probes"] += 1
+            if resp is not None and resp.get("ok"):
+                self._mark_up(peer, transitions)
+            else:
+                with self._lock:
+                    self.counters["misses"] += 1
+                self._mark_miss(peer, transitions)
+        with self._lock:
+            self.counters["rounds"] += 1
+        self._publish_gauges()
+        return {"states": self.states(), "transitions": transitions,
+                "epoch": self.node.ring.epoch}
+
+    def _mark_up(self, peer: str, transitions: List[dict]):
+        with self._lock:
+            was = self._state[peer]
+            self._fails[peer] = 0
+            self._state[peer] = "up"
+        if was == "down":
+            try:
+                self.node.ring.add_node(peer)
+            except ConfigError:
+                pass  # raced another path re-adding it
+            with self._lock:
+                self.counters["rejoined"] += 1
+            transitions.append({"node": peer, "from": was,
+                                "to": "up",
+                                "epoch": self.node.ring.epoch})
+            # delta re-replication: the stamps in _seen make this
+            # round pull only what changed while the peer was away
+            t = threading.Thread(
+                target=self._pull_safely, daemon=True,
+                name="planner-rejoin-pull")
+            t.start()
+        elif was != "up":
+            transitions.append({"node": peer, "from": was,
+                                "to": "up",
+                                "epoch": self.node.ring.epoch})
+
+    def _mark_miss(self, peer: str, transitions: List[dict]):
+        with self._lock:
+            self._fails[peer] += 1
+            fails = self._fails[peer]
+            was = self._state[peer]
+            if fails >= self.down_after:
+                self._state[peer] = "down"
+            elif fails >= self.suspect_after:
+                self._state[peer] = "suspect"
+            now = self._state[peer]
+        if now == was:
+            return
+        if now == "down":
+            try:
+                self.node.ring.remove_node(peer)
+            except ConfigError:
+                pass  # already removed
+            with self._lock:
+                self.counters["removed"] += 1
+        transitions.append({"node": peer, "from": was, "to": now,
+                            "epoch": self.node.ring.epoch})
+
+    def _pull_safely(self):
+        try:
+            self.node.replicator.pull_once()
+        except Exception:
+            # a failed opportunistic pull is re-attempted by the
+            # periodic loop; record it on the replicator's counter
+            with self.node.replicator._lock:
+                self.node.replicator.counters["peer_errors"] += 1
+
+    def _publish_gauges(self):
+        reg = self.node.registry
+        with self._lock:
+            states = dict(self._state)
+        for peer, state in sorted(states.items()):
+            reg.gauge("ring_member_state", node=peer).set(
+                self.STATE_GAUGE[state])
+        reg.gauge("ring_epoch").set(self.node.ring.epoch)
+        reg.gauge("ring_nodes").set(len(self.node.ring))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        def loop():
+            while True:
+                gap = self.interval_s * (1.0 + self._rng.random() / 2)
+                if self._stop.wait(gap):
+                    return
+                try:
+                    self.probe_once()
+                except Exception:
+                    # a probe round must never kill the loop; the
+                    # miss counter records that something went wrong
+                    with self._lock:
+                        self.counters["misses"] += 1
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="planner-failure-detector")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._state)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+        out["states"] = self.states()
+        out["suspect_after"] = self.suspect_after
+        out["down_after"] = self.down_after
+        out["interval_s"] = self.interval_s
+        return out
+
+
 class FleetNode:
     """One node's fleet state: ring + router + owner-side flight
     surface + replicator, attached to a ``PlannerHTTPServer`` by
@@ -422,15 +642,26 @@ class FleetNode:
                         planner.cell_flights)
         self.flights = FleetCellFlightTable(
             node_id, members, local=local, registry=self.registry,
-            authoritative=True, vnodes=vnodes)
+            authoritative=True, vnodes=vnodes, ring=self.ring)
         planner.cell_flights = self.flights
         self.replicator = Replicator(node_id, members, self.ring,
                                      self.store,
                                      registry=self.registry)
+        #: heartbeat prober editing the live ring; created idle —
+        #: attach_fleet starts the thread when probing is enabled,
+        #: tests drive probe_once() synchronously
+        self.detector = FailureDetector(self)
         #: owner-side leases on claims granted to remote leaders
         self._leases: Dict[str, threading.Timer] = {}
         self._lease_lock = threading.Lock()
+        #: crash-recovery sweep BEFORE the first request: quarantine
+        #: anything torn while this node was down, then let the next
+        #: replica pull restore the owned keys it removed
+        self.recovery = (self.store.recover()
+                         if self.store is not None else
+                         {"checked": 0, "ok": 0, "quarantined": []})
         self.registry.gauge("ring_nodes").set(len(self.ring))
+        self.registry.gauge("ring_epoch").set(self.ring.epoch)
 
     @property
     def local_flights(self) -> CellFlightTable:
@@ -491,6 +722,12 @@ class FleetNode:
             return 200, self.replicator.pull_once()
         if path == RING_STATE:
             return 200, self.state()
+        if path == RING_PING:
+            # the heartbeat: proof of life plus this node's membership
+            # view, so forensics can line up epoch divergence
+            return 200, {"ok": True, "node_id": self.node_id,
+                         "epoch": self.ring.epoch,
+                         "nodes": list(self.ring.nodes())}
         return 404, {"error": f"unknown ring path {path}"}
 
     def _claim(self, q: dict):
@@ -564,10 +801,15 @@ class FleetNode:
             "router": self.router.stats(),
             "flights": self.flights.stats(),
             "replicator": self.replicator.stats(),
+            "detector": self.detector.stats(),
+            "recovery": self.recovery,
+            "quarantine": (self.store.quarantined()
+                           if self.store is not None else []),
             "leases": len(self._leases),
         }
 
     def close(self):
+        self.detector.close()
         self.replicator.close()
         self.router.close()
         with self._lease_lock:
@@ -590,17 +832,18 @@ def warm_route_filter(node: FleetNode) -> Callable[[dict], bool]:
 
 def attach_fleet(server, node_id: str, ring_spec: str,
                  replicate_s: float = 0.0,
-                 vnodes: int = DEFAULT_VNODES) -> FleetNode:
+                 vnodes: int = DEFAULT_VNODES,
+                 probe_s: float = 0.0,
+                 probe_seed: int = 0) -> FleetNode:
     """Turn one built ``PlannerHTTPServer`` into a fleet node: parse
     the membership spec, wrap the planner's flight table for the
     wire, mount the router and the ``/ring/*`` surface, gate the
-    warmer to owned sweeps, and (optionally) start the background
-    replica pull. Returns the :class:`FleetNode` (also at
+    warmer to owned sweeps, run the store's crash-recovery sweep, and
+    (optionally) start the background replica pull and the failure
+    detector (``--probe-s``). Returns the :class:`FleetNode` (also at
     ``server.fleet``)."""
     members = parse_ring_spec(ring_spec)
     if node_id not in members:
-        from simumax_tpu.core.errors import ConfigError
-
         raise ConfigError(
             f"--join {node_id!r} is not a member of the ring "
             f"({format_ring_spec(members)})")
@@ -610,6 +853,24 @@ def attach_fleet(server, node_id: str, ring_spec: str,
     server.router = node.router
     if server.warmer is not None:
         server.warmer.route_filter = warm_route_filter(node)
+        server.warmer.degraded = lambda: any(
+            s == "down" for s in node.detector.states().values())
     if replicate_s > 0:
         node.replicator.start(replicate_s)
+    # bench-only fault injection: no-op unless SIMUMAX_CHAOS_NET is
+    # exported (the chaos harness sets it before forking fleet nodes)
+    from simumax_tpu.service.chaos import maybe_install_net_chaos
+    maybe_install_net_chaos(node.router)
+    if probe_s > 0:
+        node.detector.interval_s = float(probe_s)
+        node.detector._rng = random.Random(probe_seed)
+        node.detector.start()
+    if node.recovery.get("quarantined"):
+        # recovery removed entries this node serves: pull them back
+        # from the replicas as soon as peers answer (one-shot,
+        # fail-open — the periodic pull and the detector's rejoin
+        # pull retry later if peers are still starting)
+        threading.Thread(
+            target=node.detector._pull_safely, daemon=True,
+            name="planner-recovery-pull").start()
     return node

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import bisect
 import hashlib
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from simumax_tpu.core.errors import ConfigError
@@ -53,6 +54,14 @@ class HashRing:
     points over ``nodes x vnodes`` labels) — O(N·V·log(N·V)) on a
     change that happens ~never per request, buying a lookup that is
     one sha256 + one bisect.
+
+    Membership is *live* (L20): the failure detector removes a down
+    member and re-adds it on rejoin while routers and flight tables
+    keep placing keys. Lookups therefore read one immutable
+    ``(nodes, points, owners)`` table snapshot, swapped atomically
+    under ``_lock`` on every change, and every post-construction
+    change bumps ``epoch`` — observers compare epochs instead of
+    diffing membership lists.
     """
 
     def __init__(self, nodes: Sequence[str] = (),
@@ -61,57 +70,70 @@ class HashRing:
             raise ConfigError(
                 f"ring vnodes must be >= 1, got {vnodes}")
         self.vnodes = int(vnodes)
-        self._nodes: List[str] = []
-        self._points: List[int] = []
-        self._owners: List[str] = []
+        self._lock = threading.Lock()
+        # one immutable snapshot; readers bind it to a local so a
+        # concurrent swap can never mix points from one membership
+        # with owners from another
+        self._table: Tuple[Tuple[str, ...], Tuple[int, ...],
+                           Tuple[str, ...]] = ((), (), ())
         for n in nodes:
             self.add_node(n)
+        #: membership version. 0 is the as-constructed ring; every
+        #: later add/remove bumps it by one.
+        self.epoch = 0
 
     # -- membership --------------------------------------------------------
     def add_node(self, node_id: str):
         if not node_id:
             raise ConfigError("ring node id must be non-empty")
-        if node_id in self._nodes:
-            raise ConfigError(f"ring already has node {node_id!r}")
-        self._nodes.append(node_id)
-        self._nodes.sort()
-        self._rebuild()
+        with self._lock:
+            nodes = self._table[0]
+            if node_id in nodes:
+                raise ConfigError(f"ring already has node {node_id!r}")
+            self._swap(sorted(nodes + (node_id,)))
 
     def remove_node(self, node_id: str):
-        if node_id not in self._nodes:
-            raise ConfigError(f"ring has no node {node_id!r}")
-        self._nodes.remove(node_id)
-        self._rebuild()
+        with self._lock:
+            nodes = self._table[0]
+            if node_id not in nodes:
+                raise ConfigError(f"ring has no node {node_id!r}")
+            self._swap([n for n in nodes if n != node_id])
 
-    def _rebuild(self):
+    def _swap(self, nodes: Sequence[str]):
+        """Rebuild and atomically publish the lookup table (callers
+        hold ``_lock``)."""
         pairs: List[Tuple[int, str]] = []
-        for node_id in self._nodes:
+        for node_id in nodes:
             for i in range(self.vnodes):
                 pairs.append((_point(f"{node_id}#{i}"), node_id))
         # ties (astronomically unlikely 64-bit collisions) break on the
         # node id so every process agrees
         pairs.sort()
-        self._points = [p for p, _ in pairs]
-        self._owners = [n for _, n in pairs]
+        self._table = (tuple(nodes),
+                       tuple(p for p, _ in pairs),
+                       tuple(n for _, n in pairs))
+        if hasattr(self, "epoch"):
+            self.epoch += 1
 
     def nodes(self) -> Tuple[str, ...]:
-        return tuple(self._nodes)
+        return self._table[0]
 
     def __len__(self) -> int:
-        return len(self._nodes)
+        return len(self._table[0])
 
     def __contains__(self, node_id: str) -> bool:
-        return node_id in self._nodes
+        return node_id in self._table[0]
 
     # -- placement ---------------------------------------------------------
     def owner(self, key: str) -> str:
         """The node owning ``key`` (first virtual point clockwise)."""
-        if not self._nodes:
+        nodes, points, owners = self._table
+        if not nodes:
             raise ConfigError("ring is empty: no nodes to own keys")
-        i = bisect.bisect_right(self._points, key_point(key))
-        if i == len(self._points):
+        i = bisect.bisect_right(points, key_point(key))
+        if i == len(points):
             i = 0
-        return self._owners[i]
+        return owners[i]
 
     def successors(self, key: str, count: Optional[int] = None
                    ) -> List[str]:
@@ -119,14 +141,15 @@ class HashRing:
         owner first, then each next-distinct point clockwise. This is
         both the replica set (owner + the next ``R`` entries) and the
         router's retry order when the owner is unreachable."""
-        if not self._nodes:
+        nodes, points, owners = self._table
+        if not nodes:
             raise ConfigError("ring is empty: no nodes to own keys")
-        want = len(self._nodes) if count is None \
-            else min(int(count), len(self._nodes))
-        start = bisect.bisect_right(self._points, key_point(key))
+        want = len(nodes) if count is None \
+            else min(int(count), len(nodes))
+        start = bisect.bisect_right(points, key_point(key))
         out: List[str] = []
-        for step in range(len(self._points)):
-            node = self._owners[(start + step) % len(self._points)]
+        for step in range(len(points)):
+            node = owners[(start + step) % len(points)]
             if node not in out:
                 out.append(node)
                 if len(out) >= want:
@@ -138,16 +161,20 @@ class HashRing:
         """Fraction of a uniform keyspace owned per node, estimated by
         placing ``samples`` deterministic probe keys — the forensics
         view behind ``/ring/state`` (and the balance test)."""
-        counts: Dict[str, int] = {n: 0 for n in self._nodes}
+        nodes = self._table[0]
+        counts: Dict[str, int] = {n: 0 for n in nodes}
         for i in range(samples):
-            counts[self.owner(f"balance-probe-{i}")] += 1
-        return {n: counts[n] / float(samples) for n in self._nodes}
+            probe = self.owner(f"balance-probe-{i}")
+            counts[probe] = counts.get(probe, 0) + 1
+        return {n: counts.get(n, 0) / float(samples) for n in nodes}
 
     def stats(self) -> dict:
+        nodes, points, _ = self._table
         return {
-            "nodes": list(self._nodes),
+            "nodes": list(nodes),
+            "epoch": self.epoch,
             "vnodes": self.vnodes,
-            "points": len(self._points),
+            "points": len(points),
             "balance": self.balance(),
         }
 

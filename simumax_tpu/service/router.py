@@ -27,13 +27,31 @@ replica (``service/node.py`` replica pull). Once response bytes have
 been relayed the request is never retried (no double-answer); a
 forwarded 429 passes through verbatim, so admission composes across
 the router hop and the owner's pool.
+
+L20 adds deadline budgets and hedging on top: an
+``X-SimuMax-Deadline`` millisecond budget (client-supplied or derived
+from the hop timeout) shrinks across hops — each hop's connect+read
+deadline is ``min(FORWARD_TIMEOUT_S, remaining)`` and the peer
+receives the *remaining* budget, so a wedged peer that accepts the
+connection and then goes silent costs one bounded hop
+(``router_hop_timeouts_total``), never a full client timeout. For
+idempotent read forwards the router also **hedges**: if the owner has
+not produced its first response byte within a p99-derived delay, the
+same request is sent to the next successor and whichever connection
+turns readable first is relayed — the loser is torn down unread
+(``hedged_requests_total{outcome}``). Writes (``/v1/search`` sweeps,
+anything that populates the owner's shard) are never hedged: the
+single-writer discipline of the store is worth more than its tail.
 """
 
 from __future__ import annotations
 
+import collections
 import http.client
+import select
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Deque, Dict, List, Optional, Tuple
 
 from simumax_tpu.observe.telemetry import get_registry, get_tracer
 from simumax_tpu.service.ring import HashRing
@@ -75,6 +93,29 @@ FORWARD_REQ_HEADERS = (
 #: where it lands — two nodes with momentarily different ring views
 #: must never bounce a request between each other
 FORWARDED_HEADER = "X-SimuMax-Forwarded"
+
+#: per-request deadline budget in integer milliseconds. The client
+#: (or the first node) sets it; every hop forwards the *remaining*
+#: budget and bounds its own connect+read wait by it, so the budget
+#: is a fleet-wide contract, not a per-socket knob.
+DEADLINE_HEADER = "X-SimuMax-Deadline"
+
+#: below this many observed forward latencies the hedge delay is
+#: undefined and hedging stays off — a p99 of three samples is noise
+HEDGE_MIN_SAMPLES = 32
+
+#: forward-latency window the hedge delay is derived from (response
+#: head seen, i.e. what first-byte-wins races against)
+HEDGE_WINDOW = 512
+
+#: hedging never fires faster than this, whatever the p99 says — a
+#: warm cache answers in microseconds and hedging those would double
+#: fleet traffic for nothing
+HEDGE_MIN_DELAY_S = 0.05
+
+#: leftover budget below which another hop attempt is pointless (the
+#: peer could not even parse the request before the client gives up)
+MIN_HOP_BUDGET_S = 0.01
 
 
 def route_key(endpoint: str, q: dict) -> str:
@@ -127,8 +168,27 @@ class Router:
         self._lock = threading.Lock()
         self._conns: Dict[str, List[http.client.HTTPConnection]] = {}
         self.counters = {"forwards": 0, "local": 0, "retries": 0,
-                         "failed": 0}
+                         "failed": 0, "hop_timeouts": 0, "hedges": 0}
+        #: recent forward latencies (request sent -> response head
+        #: readable), the sample the hedge delay's p99 is cut from
+        self._lat: Deque[float] = collections.deque(maxlen=HEDGE_WINDOW)
         self.registry.gauge("ring_nodes").set(len(ring))
+
+    # -- hedging ------------------------------------------------------------
+    def _record_latency(self, dt: float):
+        with self._lock:
+            self._lat.append(dt)
+
+    def hedge_delay_s(self) -> Optional[float]:
+        """The p99 of recent forward latencies — how long a read
+        forward waits for the owner's first byte before racing a
+        successor. None (hedging off) until enough samples exist."""
+        with self._lock:
+            if len(self._lat) < HEDGE_MIN_SAMPLES:
+                return None
+            lat = sorted(self._lat)
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        return max(p99, HEDGE_MIN_DELAY_S)
 
     # -- placement ---------------------------------------------------------
     def owner_for(self, endpoint: str, q: dict) -> str:
@@ -179,15 +239,72 @@ class Router:
             c.close()
 
     # -- forwarding --------------------------------------------------------
+    def _send(self, node: str, endpoint: str, raw_body: bytes,
+              headers: dict, hop_timeout: float
+              ) -> Optional[http.client.HTTPConnection]:
+        """Issue one request and return the connection with its read
+        deadline armed, or None on a connection-level send failure
+        (counted as a retry by the caller)."""
+        conn = self._checkout(node)
+        conn.timeout = hop_timeout  # bounds a fresh connect
+        try:
+            conn.request("POST", endpoint, body=raw_body,
+                         headers=headers)
+            if conn.sock is not None:
+                conn.sock.settimeout(hop_timeout)
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            return None
+        return conn
+
+    @staticmethod
+    def _first_readable(pending: list, wait_s: float) -> Optional[int]:
+        """Index of the first in-flight connection with response bytes
+        (or a hangup) to read — the literal first-byte-wins arbiter —
+        or None when ``wait_s`` elapses with every peer silent."""
+        socks = [c.sock for c, _node, _role, _t in pending]
+        if any(s is None for s in socks):
+            return next(i for i, s in enumerate(socks) if s is None)
+        try:
+            readable, _w, _x = select.select(socks, [], [], wait_s)
+        except (OSError, ValueError):
+            return 0  # a socket died mid-wait; surface via getresponse
+        if not readable:
+            return None
+        for i, s in enumerate(socks):
+            if s in readable:
+                return i
+        return None
+
+    def _hop_timed_out(self, node: str):
+        with self._lock:
+            self.counters["hop_timeouts"] += 1
+        self.registry.counter("router_hop_timeouts_total",
+                              node=node).inc()
+
     def forward(self, endpoint: str, raw_body: bytes,
-                req_headers, q: Optional[dict] = None
-                ) -> Optional[Forwarded]:
-        """Relay one request to the first reachable candidate node.
+                req_headers, q: Optional[dict] = None,
+                deadline_s: Optional[float] = None,
+                hedge: bool = False) -> Optional[Forwarded]:
+        """Relay one request to the first candidate node that answers.
 
         Returns the open :class:`Forwarded` (the caller relays
         ``response`` and calls :meth:`finish`), or None when every
-        candidate is unreachable — the caller serves locally (any node
-        can evaluate; the shard only places the cache)."""
+        candidate is unreachable or the deadline budget ran out — the
+        caller serves locally (any node can evaluate; the shard only
+        places the cache).
+
+        ``deadline_s`` is the remaining request budget: each hop's
+        connect+read wait is bounded by it, and the peer receives what
+        is left via ``X-SimuMax-Deadline``. A peer that accepts the
+        connection and then stalls past its hop deadline is abandoned
+        and counted (``router_hop_timeouts_total``) — the successor is
+        tried with the remaining budget.
+
+        ``hedge=True`` (read-only endpoints) arms first-byte-wins
+        hedging: once the first peer is ``hedge_delay_s()`` quiet, the
+        same bytes go to the next successor and both race; the loser
+        is closed unread."""
         headers = {FORWARDED_HEADER: self.node_id}
         for name in FORWARD_REQ_HEADERS:
             value = req_headers.get(name)
@@ -203,22 +320,108 @@ class Router:
             if tid:
                 headers["X-SimuMax-Trace"] = tid
         body = q if q is not None else json_loads_safe(raw_body)
-        for attempt, node in enumerate(
-                self.candidates(endpoint, body)):
-            conn = self._checkout(node)
-            try:
+        cands = self.candidates(endpoint, body)
+        deadline_end = (None if deadline_s is None
+                        else time.monotonic() + deadline_s)
+        delay = self.hedge_delay_s() if hedge else None
+        #: in-flight legs: (conn, node, role, sent_at)
+        pending: List[tuple] = []
+        next_i = 0
+        attempt = 0
+        hedged = False
+        while True:
+            remaining = (None if deadline_end is None
+                         else deadline_end - time.monotonic())
+            if remaining is not None and remaining <= MIN_HOP_BUDGET_S:
+                # budget exhausted: whatever is in flight has already
+                # eaten its read deadline without a byte
+                for conn, node, _role, _t in pending:
+                    self._hop_timed_out(node)
+                    conn.close()
+                pending = []
+                break
+            hop_timeout = (FORWARD_TIMEOUT_S if remaining is None
+                           else min(FORWARD_TIMEOUT_S, remaining))
+            if not pending:
+                if next_i >= len(cands):
+                    break
+                node = cands[next_i]
+                next_i += 1
+                hdrs = dict(headers)
+                if remaining is not None:
+                    hdrs[DEADLINE_HEADER] = str(
+                        max(1, int(remaining * 1000)))
                 with tracer.span("router_forward", node=node,
                                  endpoint=endpoint, attempt=attempt):
-                    conn.request("POST", endpoint, body=raw_body,
-                                 headers=headers)
-                    resp = conn.getresponse()
+                    conn = self._send(node, endpoint, raw_body, hdrs,
+                                      hop_timeout)
+                attempt += 1
+                if conn is None:
+                    # connection-level failure before any response
+                    # byte: safe to retry on the successor
+                    with self._lock:
+                        self.counters["retries"] += 1
+                    continue
+                pending.append((conn, node, "primary",
+                                time.monotonic()))
+            # hedge only while exactly the primary leg is in flight,
+            # a successor remains, and the delay beats the hop budget
+            can_hedge = (delay is not None and len(pending) == 1
+                         and pending[0][2] == "primary" and not hedged
+                         and next_i < len(cands)
+                         and delay < hop_timeout)
+            wait_s = delay if can_hedge else hop_timeout
+            idx = self._first_readable(pending, wait_s)
+            if idx is None:
+                if can_hedge:
+                    # primary is p99-slow: race the next successor
+                    node = cands[next_i]
+                    next_i += 1
+                    hdrs = dict(headers)
+                    if remaining is not None:
+                        hdrs[DEADLINE_HEADER] = str(
+                            max(1, int(remaining * 1000)))
+                    with tracer.span("router_hedge", node=node,
+                                     endpoint=endpoint,
+                                     attempt=attempt):
+                        conn = self._send(node, endpoint, raw_body,
+                                          hdrs, hop_timeout)
+                    attempt += 1
+                    hedged = True
+                    with self._lock:
+                        self.counters["hedges"] += 1
+                    if conn is None:
+                        self.registry.counter(
+                            "hedged_requests_total",
+                            outcome="failed").inc()
+                    else:
+                        pending.append((conn, node, "hedge",
+                                        time.monotonic()))
+                    continue
+                # per-hop read deadline: every in-flight peer accepted
+                # the connection and then stalled — abandon and move on
+                for conn, node, _role, _t in pending:
+                    self._hop_timed_out(node)
+                    conn.close()
+                pending = []
+                continue
+            conn, node, role, sent_at = pending.pop(idx)
+            try:
+                resp = conn.getresponse()
             except (OSError, http.client.HTTPException):
-                # connection-level failure before any response byte:
-                # safe to retry on the successor
                 conn.close()
                 with self._lock:
                     self.counters["retries"] += 1
-                continue
+                continue  # the other leg (if any) or the successor
+            self._record_latency(time.monotonic() - sent_at)
+            for loser_conn, _n, _r, _t in pending:
+                loser_conn.close()  # torn down unread
+            pending = []
+            if hedged:
+                self.registry.counter(
+                    "hedged_requests_total",
+                    outcome="won" if role == "hedge" else "lost"
+                ).inc()
             with self._lock:
                 self.counters["forwards"] += 1
             self.registry.counter("router_forwards_total",
@@ -232,6 +435,9 @@ class Router:
                 (resp.headers.get("Transfer-Encoding") or "").lower()
             return Forwarded(resp.status, relay, resp, conn, node,
                              chunked)
+        if hedged:
+            self.registry.counter("hedged_requests_total",
+                                  outcome="failed").inc()
         with self._lock:
             self.counters["failed"] += 1
         return None
@@ -241,7 +447,9 @@ class Router:
             out = dict(self.counters)
         out["node_id"] = self.node_id
         out["ring"] = {"nodes": list(self.ring.nodes()),
+                       "epoch": self.ring.epoch,
                        "vnodes": self.ring.vnodes}
+        out["hedge_delay_s"] = self.hedge_delay_s()
         return out
 
 

@@ -452,7 +452,18 @@ class _Handler(BaseHTTPRequestHandler):
         # the fleet control plane (service/node.py; fleet nodes only)
         "/ring/state", "/ring/cells/claim", "/ring/cells/publish",
         "/ring/cells/abandon", "/ring/cells/wait", "/ring/entries",
-        "/ring/entry", "/ring/replicate",
+        "/ring/entry", "/ring/replicate", "/ring/ping",
+    })
+
+    #: endpoints the router may hedge (duplicate to a successor on a
+    #: p99-slow owner): idempotent reads whose response is a pure
+    #: function of the request. ``/v1/search`` is the write path — a
+    #: sweep populates the owner's shard through the flight table, and
+    #: two racing writers would break the single-writer discipline —
+    #: so it is NEVER hedged (pinned by tests/test_service_chaos.py).
+    HEDGE_SAFE_ENDPOINTS = frozenset({
+        "/v1/estimate", "/v1/explain", "/v1/faults",
+        "/v1/simulate", "/v1/fleet",
     })
 
     def _metric_endpoint(self, endpoint: str) -> str:
@@ -530,6 +541,21 @@ class _Handler(BaseHTTPRequestHandler):
         ``low``), defaulting to ``normal``."""
         p = (self.headers.get("X-SimuMax-Priority") or "normal").lower()
         return p if p in PRIORITY_HEADROOM else "normal"
+
+    def _deadline_s(self) -> Optional[float]:
+        """Remaining request budget in seconds from the
+        ``X-SimuMax-Deadline`` millisecond header (clients set it;
+        router hops forward the decremented remainder). None = no
+        budget — the per-hop ``FORWARD_TIMEOUT_S`` still bounds
+        forwards."""
+        raw = self.headers.get("X-SimuMax-Deadline")
+        if not raw:
+            return None
+        try:
+            ms = int(raw)
+        except ValueError:
+            return None
+        return max(ms, 1) / 1000.0
 
     #: endpoints eligible for the raw-body memcache fast path: the
     #: exact request bytes of a hot repeat map straight to the cached
@@ -928,7 +954,10 @@ class _Handler(BaseHTTPRequestHandler):
         the ring only places the cache)."""
         router = self.server.router
         raw = getattr(self, "_raw_body", None) or b"{}"
-        fwd = router.forward(endpoint, raw, self.headers, q=q)
+        fwd = router.forward(
+            endpoint, raw, self.headers, q=q,
+            deadline_s=self._deadline_s(),
+            hedge=endpoint in self.HEDGE_SAFE_ENDPOINTS)
         if fwd is None:
             return None
         try:
@@ -992,6 +1021,10 @@ class _Handler(BaseHTTPRequestHandler):
             status, payload, meta = pool.serve(
                 endpoint, q, priority=self._priority(),
                 trace_ids=trace_ids,
+                # the deadline budget crosses the dispatch boundary
+                # too: a budgeted request never queues past its
+                # deadline (the pool answers 504, the client moves on)
+                timeout=self._deadline_s(),
                 raw=self._raw_body
                 if endpoint in self.FAST_PATH_ENDPOINTS else None,
                 accept_gzip=self._accepts_gzip(),

@@ -22,9 +22,12 @@ that contract:
   block-kind profile cache;
 * **integrity**: every read re-hashes the payload bytes against the
   header digest; a mismatching (torn, bit-rotted, hand-edited) entry
-  is dropped and reported as a miss, never served.
+  is quarantined (moved into ``<root>/.quarantine/``, counted, never
+  deleted outright) and reported as a miss, never served.
   ``simumax_tpu cache verify`` runs the same check over the whole
-  store;
+  store and ``--drop`` routes through the same quarantine path;
+  :meth:`ContentStore.recover` is the crash-recovery sweep a fleet
+  node runs at start so a torn shard never reaches the serving path;
 * **eviction**: the store is size-bounded; when a put pushes the total
   payload bytes over ``max_bytes`` the least-recently-used entries
   (file mtime, bumped on every hit) are deleted until the store is
@@ -59,6 +62,13 @@ NAMESPACES = ("estimate", "explain", "sweep", "profiles", "des",
 DEFAULT_MAX_BYTES = 512 * 1024 * 1024
 
 _ENTRY_SUFFIX = ".entry"
+
+#: corrupt entries are moved here (under the store root) instead of
+#: deleted: forensics can inspect the torn bytes, the fleet node can
+#: count what recovery removed and re-pull exactly those keys, and
+#: ``_walk`` prunes the directory so quarantined entries are invisible
+#: to every read/manifest/eviction path.
+_QUARANTINE_DIR = ".quarantine"
 
 
 def code_version() -> str:
@@ -153,6 +163,7 @@ class ContentStore:
         self.counters: Dict[str, int] = {
             "hits": 0, "misses": 0, "puts": 0,
             "evictions": 0, "corrupt_dropped": 0,
+            "quarantined": 0,
         }
 
     # -- paths -------------------------------------------------------------
@@ -261,10 +272,99 @@ class ContentStore:
 
     def _drop_corrupt(self, path: str, exc: Exception):
         self._count("corrupt_dropped")
+        self._quarantine(path, exc)
+
+    def _quarantine(self, path: str, exc: Exception) -> Optional[str]:
+        """Move one corrupt/torn entry into ``.quarantine/<ns>/``
+        (atomic rename — the entry vanishes from the serving namespace
+        and its bytes survive for forensics), count it, and drop a
+        sidecar ``.reason`` note. Returns the quarantine path, or None
+        if the file was already gone."""
+        rel = os.path.relpath(path, self.root)
+        parts = rel.split(os.sep)
+        ns = parts[0] if len(parts) > 1 else "_unknown"
+        dest_dir = os.path.join(self.root, _QUARANTINE_DIR, ns)
+        dest = os.path.join(dest_dir, os.path.basename(path))
         try:
-            os.remove(path)
+            os.makedirs(dest_dir, exist_ok=True)
+            os.replace(path, dest)
+        except OSError:
+            return None
+        try:
+            with open(dest + ".reason", "w", encoding="utf-8") as f:
+                f.write(f"{type(exc).__name__}: {exc}\n")
         except OSError:
             pass
+        self._count("quarantined")
+        self.registry.counter("store_quarantined_total").inc()
+        return dest
+
+    def quarantined(self) -> List[dict]:
+        """Forensics/recovery listing of the quarantine directory: one
+        row per captured entry with the namespace and key it was
+        serving under (recovered from the sharded path layout), sorted
+        for determinism."""
+        qroot = os.path.join(self.root, _QUARANTINE_DIR)
+        out: List[dict] = []
+        if not os.path.isdir(qroot):
+            return out
+        for dirpath, _dirnames, filenames in os.walk(qroot):
+            for fn in filenames:
+                if not fn.endswith(_ENTRY_SUFFIX):
+                    continue
+                path = os.path.join(dirpath, fn)
+                reason = ""
+                try:
+                    with open(path + ".reason", encoding="utf-8") as f:
+                        reason = f.read().strip()
+                except OSError:
+                    pass
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+                out.append({
+                    "namespace": os.path.relpath(dirpath, qroot)
+                    .split(os.sep)[0],
+                    "key": fn[:-len(_ENTRY_SUFFIX)],
+                    "bytes": size,
+                    "reason": reason,
+                })
+        out.sort(key=lambda e: (e["namespace"], e["key"]))
+        return out
+
+    def recover(self) -> dict:
+        """Crash-recovery sweep a node runs before serving: re-hash
+        every entry and quarantine anything torn or corrupt, so a
+        crash mid-``os.replace`` (or plain bit rot accumulated while
+        down) can never surface as a served payload. Returns the
+        checked/ok counts plus the (namespace, key) rows quarantine
+        removed — the fleet node re-pulls exactly those owned keys
+        from its replicas."""
+        checked = 0
+        removed: List[dict] = []
+        for path in list(self._walk()):
+            checked += 1
+            try:
+                self._read_entry(path)
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                rel = os.path.relpath(path, self.root)
+                parts = rel.split(os.sep)
+                fn = os.path.basename(path)
+                if self._quarantine(path, exc) is not None:
+                    removed.append({
+                        "namespace":
+                            parts[0] if len(parts) > 1 else "",
+                        "key": fn[:-len(_ENTRY_SUFFIX)],
+                        "error": str(exc),
+                    })
+        with self._evict_lock:
+            self._approx_bytes = None  # re-anchor on the next put
+        return {
+            "checked": checked,
+            "ok": checked - len(removed),
+            "quarantined": removed,
+        }
 
     def put(self, namespace: str, key: str, payload: Any,
             fmt: str = "json") -> str:
@@ -321,7 +421,11 @@ class ContentStore:
         for r in roots:
             if not os.path.isdir(r):
                 continue
-            for dirpath, _dirnames, filenames in os.walk(r):
+            for dirpath, dirnames, filenames in os.walk(r):
+                # quarantined entries are out of the store: invisible
+                # to reads, manifests, stats, and eviction alike
+                if _QUARANTINE_DIR in dirnames:
+                    dirnames.remove(_QUARANTINE_DIR)
                 for fn in filenames:
                     if fn.endswith(_ENTRY_SUFFIX):
                         yield os.path.join(dirpath, fn)
@@ -455,26 +559,27 @@ class ContentStore:
             "total_bytes": total,
             "namespaces": namespaces,
             "counters": counters,
+            "quarantine_entries": len(self.quarantined()),
         }
 
     def verify(self, namespace: Optional[str] = None,
                drop: bool = False) -> dict:
         """Re-hash every payload against its header digest
         (``cache verify``). Returns checked/ok counts plus the corrupt
-        entry paths; ``drop=True`` also removes them."""
+        entry paths; ``drop=True`` quarantines them (same path as a
+        corrupt read and the start-time :meth:`recover` sweep — the
+        bytes land in ``.quarantine/`` for forensics, never deleted
+        outright)."""
         checked = 0
         corrupt: List[dict] = []
-        for path in self._walk(namespace):
+        for path in list(self._walk(namespace)):
             checked += 1
             try:
                 self._read_entry(path)
             except (OSError, ValueError, json.JSONDecodeError) as exc:
                 corrupt.append({"path": path, "error": str(exc)})
                 if drop:
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
+                    self._quarantine(path, exc)
         return {
             "checked": checked,
             "ok": checked - len(corrupt),

@@ -240,11 +240,17 @@ class Warmer:
         self.counters = {"offered": 0, "warmed_jobs": 0,
                          "warmed_cells": 0, "duplicate": 0,
                          "dropped": 0, "skipped_headroom": 0,
-                         "skipped_remote": 0, "errors": 0}
+                         "skipped_remote": 0, "skipped_degraded": 0,
+                         "errors": 0}
         #: fleet gate (service/node.py): when set, only sweeps this
         #: node OWNS are warmed — warming a remote shard would guess
         #: into a store the owner never reads
         self.route_filter: Optional[Callable[[dict], bool]] = None
+        #: fleet health gate (service/node.py): when set and True, a
+        #: member is down — every core is worth more re-serving the
+        #: dead node's remapped keys than speculating on neighbors,
+        #: so warming pauses until the detector sees the fleet whole
+        self.degraded: Optional[Callable[[], bool]] = None
         #: True while the loop is executing a dequeued job — drain()
         #: must wait this out, not just an empty queue
         self._busy = False
@@ -280,6 +286,15 @@ class Warmer:
     def offer(self, search_body: dict):
         """Queue the neighbor-warming job of one served sweep query.
         Never blocks and never raises into the serving path."""
+        if self.degraded is not None:
+            try:
+                degraded = bool(self.degraded())
+            except Exception:
+                degraded = False  # never let health checks break serving
+            if degraded:
+                self._count("skipped_degraded",
+                            outcome="skipped_degraded")
+                return
         if self.route_filter is not None:
             try:
                 owned = bool(self.route_filter(search_body))

@@ -45,6 +45,7 @@ SCOPE = (
     "simumax_tpu/service/ring.py",
     "simumax_tpu/service/router.py",
     "simumax_tpu/service/node.py",
+    "simumax_tpu/service/chaos.py",
     "simumax_tpu/core/",
     "simumax_tpu/perf.py",
     "simumax_tpu/parallel/",
